@@ -1,0 +1,35 @@
+"""jepsen_tpu — a TPU-native distributed-systems safety-testing framework.
+
+A ground-up rebuild of the capabilities of Jepsen (reference:
+``daschl/jepsen``, a fork of ``jepsen-io/jepsen``; see SURVEY.md) designed
+TPU-first: operation histories are structure-of-arrays int tensors, sequential
+consistency models are int-coded transition tables, and the
+Wing-Gong-Lowe linearizability search is a batched, vmapped, device-shardable
+JAX frontier search instead of a single-threaded JVM DFS.
+
+Layer map (mirrors SURVEY.md §1):
+
+- :mod:`jepsen_tpu.op`, :mod:`jepsen_tpu.history` — L5 history & ops
+  (upstream: ``knossos.op``, ``knossos.history``, op maps in ``jepsen.core``).
+- :mod:`jepsen_tpu.models` — sequential specifications
+  (upstream: ``knossos.model``, ``knossos.model.memo``).
+- :mod:`jepsen_tpu.checkers` — L7 analysis, including the TPU WGL solver
+  (upstream: ``jepsen.checker``, ``knossos.wgl``, ``knossos.linear``,
+  ``knossos.competition``).
+- :mod:`jepsen_tpu.generators` — L3 workload generation
+  (upstream: ``jepsen.generator``).
+- :mod:`jepsen_tpu.client`, :mod:`jepsen_tpu.nemesis`, :mod:`jepsen_tpu.net`,
+  :mod:`jepsen_tpu.control`, :mod:`jepsen_tpu.db` — L0-L4
+  (upstream: ``jepsen.client``, ``jepsen.nemesis``, ``jepsen.net``,
+  ``jepsen.control``, ``jepsen.db``).
+- :mod:`jepsen_tpu.core` — L6 test runtime (upstream: ``jepsen.core``).
+- :mod:`jepsen_tpu.store`, :mod:`jepsen_tpu.web`, :mod:`jepsen_tpu.cli` —
+  L9/L10 persistence, reporting, CLI (upstream: ``jepsen.store``,
+  ``jepsen.web``, ``jepsen.cli``).
+- :mod:`jepsen_tpu.parallel` — device-mesh sharding of the checker search
+  (no upstream analogue; the reference is single-JVM).
+"""
+
+__version__ = "0.1.0"
+
+from jepsen_tpu.op import Op, invoke, ok, fail, info  # noqa: F401

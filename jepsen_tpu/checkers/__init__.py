@@ -1,0 +1,13 @@
+"""Checkers — upstream: ``jepsen/src/jepsen/checker.clj`` plus the Knossos
+library (SURVEY.md §2.1, §2.2). The façade module
+:mod:`jepsen_tpu.checkers.facade` provides the composable ``Checker`` API;
+the linearizability engines live in:
+
+- :mod:`jepsen_tpu.checkers.wgl_ref` — CPU reference Wing-Gong-Lowe search
+  (upstream ``knossos.wgl``), the correctness oracle and CPU baseline.
+- :mod:`jepsen_tpu.checkers.brute` — exhaustive permutation checker for
+  differential testing of tiny histories (no upstream analogue; replaces
+  knossos's recorded-fixture cross-checks at the smallest scale).
+- :mod:`jepsen_tpu.checkers.wgl_tpu` — the batched JAX frontier search
+  (the north star; upstream ``knossos.wgl`` recast for the MXU).
+"""

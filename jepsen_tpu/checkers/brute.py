@@ -1,0 +1,55 @@
+"""Exhaustive linearizability check for tiny histories.
+
+A deliberately independent implementation used only for differential testing
+of the real checkers (SURVEY.md §4: replaces knossos's recorded-fixture
+cross-checks at the smallest scale): enumerate every permutation of every
+admissible subset of operations (all ``ok`` ops, any subset of crashed ops),
+filter by the real-time order (if ``ret(x) < inv(y)`` then x precedes y),
+and replay the model. Exponential — refuse histories beyond ``max_n`` ops.
+"""
+from __future__ import annotations
+
+from itertools import combinations, permutations
+from typing import Any, Dict, Sequence
+
+from jepsen_tpu import history as h
+from jepsen_tpu.models import Model, is_inconsistent
+from jepsen_tpu.op import Op
+
+
+def check(model: Model, history: Sequence[Op], *, max_n: int = 9
+          ) -> Dict[str, Any]:
+    entries = h.analysis_entries(history)
+    n = len(entries)
+    if n > max_n:
+        raise ValueError(f"brute checker limited to {max_n} ops, got {n}")
+    ok_entries = [e for e in entries if not e.crashed]
+    info_entries = [e for e in entries if e.crashed]
+    tried = 0
+    for k in range(len(info_entries) + 1):
+        for extra in combinations(info_entries, k):
+            chosen = ok_entries + list(extra)
+            for perm in permutations(chosen):
+                tried += 1
+                if _real_time_ok(perm) and _model_ok(model, perm):
+                    return {"valid": True, "perms-tried": tried}
+    return {"valid": False, "perms-tried": tried}
+
+
+def _real_time_ok(perm) -> bool:
+    for i in range(len(perm)):
+        for j in range(i + 1, len(perm)):
+            # perm[i] precedes perm[j]; illegal if perm[j] returned before
+            # perm[i] was invoked.
+            if perm[j].ret_ev < perm[i].inv_ev:
+                return False
+    return True
+
+
+def _model_ok(model: Model, perm) -> bool:
+    s = model
+    for e in perm:
+        s = s.step(e.op)
+        if is_inconsistent(s):
+            return False
+    return True

@@ -1,0 +1,83 @@
+"""Model memoization — upstream: ``knossos/src/knossos/model/memo.clj``
+(SURVEY.md §2.2): for a given history, precompute the reachable
+(state × distinct-op) transition table so that states become small ints and
+the search becomes pure table lookups. This table *is* the TPU kernel: the
+device search never steps a Python model, it gathers ``T[state, op_id]``.
+
+``memo(model, packed)`` BFS-enumerates states reachable from ``model`` under
+the history's distinct op alphabet and returns a :class:`Memo` with:
+
+- ``table`` — int32 ``[n_states, n_ops]``; ``-1`` marks an inconsistent
+  (illegal) transition.
+- ``states`` — state id → model object, for reporting.
+- ``entry_op`` — convenience alias of ``packed.op_id``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from jepsen_tpu.history import PackedHistory
+from jepsen_tpu.models import Model, is_inconsistent
+from jepsen_tpu.op import Op
+
+
+class StateExplosion(RuntimeError):
+    """Raised when the reachable state space exceeds ``max_states`` — the
+    caller should fall back to an un-memoized (object-stepping) search."""
+
+
+@dataclass(frozen=True)
+class Memo:
+    table: np.ndarray            # i32[n_states, n_ops]; -1 = inconsistent
+    states: Tuple[Model, ...]    # state id -> model
+    distinct_ops: Tuple[Op, ...]
+    initial: int = 0
+
+    @property
+    def n_states(self) -> int:
+        return len(self.states)
+
+    @property
+    def n_ops(self) -> int:
+        return len(self.distinct_ops)
+
+
+def memo(model: Model, packed: PackedHistory,
+         max_states: int = 1_000_000) -> Memo:
+    """Enumerate reachable states of ``model`` under ``packed.distinct_ops``
+    and build the dense transition table."""
+    return memo_ops(model, packed.distinct_ops, max_states=max_states)
+
+
+def memo_ops(model: Model, distinct_ops: Sequence[Op],
+             max_states: int = 1_000_000) -> Memo:
+    ops = tuple(distinct_ops)
+    state_ids: Dict[Model, int] = {model: 0}
+    states: List[Model] = [model]
+    rows: List[List[int]] = []
+    frontier = [model]
+    while frontier:
+        next_frontier: List[Model] = []
+        for s in frontier:
+            row: List[int] = []
+            for op in ops:
+                s2 = s.step(op)
+                if is_inconsistent(s2):
+                    row.append(-1)
+                    continue
+                if s2 not in state_ids:
+                    if len(states) >= max_states:
+                        raise StateExplosion(
+                            f"more than {max_states} reachable states for "
+                            f"{type(model).__name__} over {len(ops)} ops")
+                    state_ids[s2] = len(states)
+                    states.append(s2)
+                    next_frontier.append(s2)
+                row.append(state_ids[s2])
+            rows.append(row)
+        frontier = next_frontier
+    table = np.asarray(rows, np.int32).reshape(len(states), len(ops))
+    return Memo(table=table, states=tuple(states), distinct_ops=ops)

@@ -1,0 +1,60 @@
+"""Small shared utilities — upstream: ``jepsen/src/jepsen/util.clj``
+(SURVEY.md §2.1). Grows alongside the harness (timeouts, retries,
+majority math); for now the helpers shared by history packing and EDN.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def hashable(v: Any) -> Any:
+    """Deep-freeze a JSON/EDN-style value into a hashable equivalent
+    (lists → tuples, dicts → sorted kv-tuples, sets → frozensets)."""
+    if isinstance(v, list):
+        return tuple(hashable(x) for x in v)
+    if isinstance(v, tuple):
+        return tuple(hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted(((hashable(k), hashable(x)) for k, x in v.items()),
+                            key=repr))
+    if isinstance(v, (set, frozenset)):
+        return frozenset(hashable(x) for x in v)
+    return v
+
+
+def majority(n: int) -> int:
+    """Smallest majority of ``n`` nodes (upstream ``jepsen.util/majority``)."""
+    return n // 2 + 1
+
+
+def relative_time_nanos(start: float) -> int:
+    """Nanoseconds since ``start`` (a ``time.monotonic()`` instant) —
+    upstream ``jepsen.util/relative-time-nanos``."""
+    return int((time.monotonic() - start) * 1e9)
+
+
+def with_retry(fn: Callable[[], T], retries: int = 3,
+               delay: float = 0.1,
+               exceptions: tuple = (Exception,)) -> T:
+    """Call ``fn``, retrying on failure (upstream ``jepsen.util/with-retry``)."""
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except exceptions as e:  # noqa: PERF203
+            last = e
+            if attempt < retries:
+                time.sleep(delay * (2 ** attempt))
+    assert last is not None
+    raise last
+
+
+def meh(fn: Callable[[], T]) -> Optional[T]:
+    """Run ``fn``, swallowing exceptions (upstream ``jepsen.util/meh``)."""
+    try:
+        return fn()
+    except Exception:
+        return None

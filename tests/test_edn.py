@@ -1,0 +1,60 @@
+"""EDN reader/writer tests, including regression cases for discard forms,
+composite map keys, and non-keyword-safe string keys."""
+import pytest
+
+from jepsen_tpu import edn
+
+
+def test_basic_forms():
+    assert edn.loads("nil") is None
+    assert edn.loads("[1 2.5 true false]") == [1, 2.5, True, False]
+    assert edn.loads('{:a 1, :b "x"}') == {"a": 1, "b": "x"}
+    assert edn.loads("#{1 2}") == {1, 2}
+    assert edn.loads(":read") == "read"
+
+
+def test_comments_and_discard():
+    assert edn.loads_all("; header\n1 2") == [1, 2]
+    assert edn.loads_all("1 #_2") == [1]
+    assert edn.loads_all("#_1") == []
+    assert edn.loads("[1 #_2 3]") == [1, 3]
+    assert edn.loads("[1 #_2]") == [1]
+    assert edn.loads("{:a #_:skipped 1}") == {"a": 1}
+
+
+def test_discard_nothing_raises():
+    with pytest.raises(ValueError):
+        edn.loads_all("1 #_")
+
+
+def test_tagged_literal_keeps_value():
+    assert edn.loads('#inst "2016-01-01"') == "2016-01-01"
+
+
+def test_composite_map_keys():
+    v = edn.loads("{[1 2] :x}")
+    assert v == {(1, 2): "x"}
+    assert edn.to_plain(v) == {(1, 2): "x"}
+
+
+def test_to_plain_nested():
+    v = edn.loads('{:ops [{:f :read}]}')
+    assert edn.to_plain(v) == {"ops": [{"f": "read"}]}
+
+
+def test_dumps_non_keyword_safe_key_stays_string():
+    s = edn.dumps({"error msg": 1})
+    assert s == '{"error msg" 1}'
+    assert edn.loads(s) == {"error msg": 1}
+
+
+def test_dumps_roundtrip_op_map():
+    d = {"process": 0, "type": "invoke", "f": "cas", "value": [1, 2]}
+    s = edn.dumps(d)
+    assert ":process" in s and ":cas" in s
+    back = edn.to_plain(edn.loads(s))
+    assert back == d
+
+
+def test_string_escapes():
+    assert edn.loads(edn.dumps({"a": 'x "y" \\z'})) == {"a": 'x "y" \\z'}

@@ -1,0 +1,119 @@
+"""History preprocessing tests — modeled on upstream
+``knossos/test/knossos/history_test.clj`` style: hand-written op vectors,
+asserted pairing/completion/packing (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu.op import Op, fail, info, invoke, ok
+
+
+def hist(*ops):
+    return h.index(list(ops))
+
+
+def test_index_assigns_dense_indices():
+    ops = hist(invoke(0, "read"), ok(0, "read", 1))
+    assert [op.index for op in ops] == [0, 1]
+
+
+def test_pair_matches_by_process():
+    ops = hist(
+        invoke(0, "write", 1),
+        invoke(1, "read"),
+        ok(1, "read", None),
+        ok(0, "write", 1),
+    )
+    pairs = h.pair(ops)
+    assert len(pairs) == 2
+    assert pairs[0].invoke.process == 0 and pairs[0].complete.index == 3
+    assert pairs[1].invoke.process == 1 and pairs[1].complete.index == 2
+
+
+def test_pair_dangling_invoke_is_crashed():
+    ops = hist(invoke(0, "write", 1))
+    [p] = h.pair(ops)
+    assert p.crashed and p.complete is None
+
+
+def test_pair_info_completion_is_crashed():
+    ops = hist(invoke(0, "write", 1), info(0, "write", 1))
+    [p] = h.pair(ops)
+    assert p.crashed
+
+
+def test_pair_rejects_double_invoke():
+    ops = hist(invoke(0, "read"), invoke(0, "read"))
+    with pytest.raises(ValueError):
+        h.pair(ops)
+
+
+def test_analysis_entries_strips_fails_and_nemesis():
+    ops = hist(
+        invoke("nemesis", "start"),
+        invoke(0, "write", 1),
+        fail(0, "write", 1),
+        invoke(1, "read"),
+        ok(1, "read", None),
+        ok("nemesis", "start"),
+    )
+    entries = h.analysis_entries(ops)
+    assert len(entries) == 1
+    assert entries[0].op.f == "read"
+
+
+def test_analysis_entries_completes_read_value_from_ok():
+    ops = hist(invoke(0, "read"), ok(0, "read", 5))
+    [e] = h.analysis_entries(ops)
+    assert e.op.value == 5
+
+
+def test_analysis_entries_crashed_ret_is_inf():
+    ops = hist(invoke(0, "write", 1), info(0, "write", 1),
+               invoke(1, "read"), ok(1, "read", 1))
+    entries = h.analysis_entries(ops)
+    assert entries[0].crashed
+    assert entries[0].ret_ev > entries[1].ret_ev
+
+
+def test_pack_distinct_ops_and_arrays():
+    ops = hist(
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "write", 1), ok(1, "write", 1),
+        invoke(0, "read"), ok(0, "read", 1),
+    )
+    p = h.pack(ops)
+    assert p.n == 3
+    # two distinct ops: write 1 (shared) and read 1
+    assert len(p.distinct_ops) == 2
+    assert p.op_id[0] == p.op_id[1]
+    assert p.n_ok == 3
+    assert np.all(p.inv_ev < p.ret_ev)
+
+
+def test_jsonl_roundtrip(tmp_path):
+    ops = hist(invoke(0, "cas", [1, 2]), ok(0, "cas", [1, 2]))
+    path = str(tmp_path / "h.jsonl")
+    h.save_jsonl(ops, path)
+    back = h.load_jsonl(path)
+    assert len(back) == 2
+    assert back[0].f == "cas" and back[0].value == [1, 2]
+
+
+def test_edn_roundtrip(tmp_path):
+    ops = hist(invoke(0, "read"), ok(0, "read", 3))
+    path = str(tmp_path / "h.edn")
+    h.save_edn(ops, path)
+    back = h.load_edn(path)
+    assert [o.type for o in back] == ["invoke", "ok"]
+    assert back[1].value == 3
+
+
+def test_load_edn_jepsen_style(tmp_path):
+    text = """[{:process 0, :type :invoke, :f :read, :value nil}
+               {:process 0, :type :ok, :f :read, :value 2}]"""
+    path = tmp_path / "jepsen.edn"
+    path.write_text(text)
+    back = h.load_edn(str(path))
+    assert back[0].f == "read" and back[0].type == "invoke"
+    assert back[1].value == 2

@@ -1,0 +1,109 @@
+"""Model tests — modeled on upstream ``knossos/test/knossos/model_test.clj``:
+step each model through legal and illegal ops (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+from jepsen_tpu import models as m
+from jepsen_tpu.models.memo import Memo, StateExplosion, memo_ops
+from jepsen_tpu.op import invoke
+
+
+def step(model, f, value=None):
+    return model.step(invoke(0, f, value))
+
+
+class TestRegister:
+    def test_write_then_read(self):
+        r = step(m.register(), "write", 3)
+        assert not m.is_inconsistent(step(r, "read", 3))
+        assert m.is_inconsistent(step(r, "read", 4))
+
+    def test_nil_read_matches_anything(self):
+        assert not m.is_inconsistent(step(m.register(7), "read", None))
+
+
+class TestCASRegister:
+    def test_cas_success_and_failure(self):
+        r = step(m.cas_register(), "write", 1)
+        r2 = step(r, "cas", [1, 2])
+        assert not m.is_inconsistent(r2)
+        assert r2.value == 2
+        assert m.is_inconsistent(step(r2, "cas", [1, 3]))
+
+    def test_read(self):
+        r = m.cas_register(5)
+        assert not m.is_inconsistent(step(r, "read", 5))
+        assert m.is_inconsistent(step(r, "read", 6))
+
+
+class TestMutex:
+    def test_acquire_release(self):
+        mu = step(m.mutex(), "acquire")
+        assert not m.is_inconsistent(mu)
+        assert m.is_inconsistent(step(mu, "acquire"))
+        mu2 = step(mu, "release")
+        assert not m.is_inconsistent(mu2)
+        assert m.is_inconsistent(step(m.mutex(), "release"))
+
+
+class TestMultiRegister:
+    def test_write_read_per_key(self):
+        r = step(m.multi_register(), "write", {"x": 1, "y": 2})
+        assert not m.is_inconsistent(step(r, "read", {"x": 1}))
+        assert m.is_inconsistent(step(r, "read", {"y": 3}))
+
+
+class TestSetModel:
+    def test_add_and_read(self):
+        s = step(step(m.set_model(), "add", 1), "add", 2)
+        assert not m.is_inconsistent(step(s, "read", [1, 2]))
+        assert m.is_inconsistent(step(s, "read", [1]))
+
+
+class TestFIFOQueue:
+    def test_fifo_order(self):
+        q = step(step(m.fifo_queue(), "enqueue", 1), "enqueue", 2)
+        q2 = step(q, "dequeue", 1)
+        assert not m.is_inconsistent(q2)
+        assert m.is_inconsistent(step(q, "dequeue", 2))
+        assert m.is_inconsistent(step(m.fifo_queue(), "dequeue", 1))
+
+
+class TestUnorderedQueue:
+    def test_any_order(self):
+        q = step(step(m.unordered_queue(), "enqueue", 1), "enqueue", 2)
+        assert not m.is_inconsistent(step(q, "dequeue", 2))
+        assert m.is_inconsistent(step(q, "dequeue", 3))
+
+
+class TestMemo:
+    def ops(self, *fvs):
+        return [invoke(0, f, v) for f, v in fvs]
+
+    def test_cas_register_table(self):
+        ops = self.ops(("write", 1), ("write", 2), ("cas", [1, 2]),
+                       ("read", 1), ("read", 2))
+        mm = memo_ops(m.cas_register(), ops)
+        assert isinstance(mm, Memo)
+        # states: None, 1, 2
+        assert mm.n_states == 3
+        t = mm.table
+        s_none = 0
+        s1 = t[s_none, 0]  # after write 1
+        s2 = t[s_none, 1]  # after write 2
+        assert t[s1, 2] == s2          # cas [1 2] from 1 -> 2
+        assert t[s2, 2] == -1          # cas [1 2] from 2 -> inconsistent
+        assert t[s1, 3] == s1          # read 1 in 1
+        assert t[s1, 4] == -1          # read 2 in 1
+        assert t[s_none, 3] == -1      # read 1 in None
+
+    def test_mutex_table(self):
+        ops = self.ops(("acquire", None), ("release", None))
+        mm = memo_ops(m.mutex(), ops)
+        assert mm.n_states == 2
+        assert np.all(mm.table == np.array([[1, -1], [-1, 0]]))
+
+    def test_state_explosion_guard(self):
+        ops = self.ops(*[("add", i) for i in range(20)])
+        with pytest.raises(StateExplosion):
+            memo_ops(m.set_model(), ops, max_states=100)

@@ -1,0 +1,186 @@
+"""CPU oracle WGL tests — hand-written histories with known verdicts
+(upstream ``knossos/test/knossos/wgl_test.clj`` style) plus differential
+tests against the brute-force permutation checker on random tiny histories
+(SURVEY.md §4)."""
+import pytest
+
+from jepsen_tpu import fixtures
+from jepsen_tpu import models as m
+from jepsen_tpu.checkers import brute, wgl_ref
+from jepsen_tpu.history import index
+from jepsen_tpu.op import fail, info, invoke, ok
+
+
+def hist(*ops):
+    return index(list(ops))
+
+
+class TestHandWritten:
+    def test_empty_history_valid(self):
+        assert wgl_ref.check(m.register(), [])["valid"] is True
+
+    def test_sequential_rw_valid(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        assert wgl_ref.check(m.register(), h)["valid"] is True
+
+    def test_stale_read_invalid(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(0, "write", 2), ok(0, "write", 2),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        res = wgl_ref.check(m.register(), h)
+        assert res["valid"] is False
+        assert res["op"]["f"] == "read"
+
+    def test_concurrent_reads_may_split(self):
+        # write 1 concurrent with two reads seeing old and new values: legal
+        h = hist(
+            invoke(0, "write", 0), ok(0, "write", 0),
+            invoke(0, "write", 1),
+            invoke(1, "read"), ok(1, "read", 0),
+            invoke(2, "read"), ok(2, "read", 1),
+            ok(0, "write", 1),
+        )
+        assert wgl_ref.check(m.register(), h)["valid"] is True
+
+    def test_non_overlapping_order_enforced(self):
+        # read of 0 strictly AFTER write 1 returned: invalid
+        h = hist(
+            invoke(0, "write", 0), ok(0, "write", 0),
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "read"), ok(1, "read", 0),
+        )
+        assert wgl_ref.check(m.register(), h)["valid"] is False
+
+    def test_cas_chain_valid(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "cas", [1, 2]), ok(1, "cas", [1, 2]),
+            invoke(2, "cas", [2, 3]), ok(2, "cas", [2, 3]),
+            invoke(0, "read"), ok(0, "read", 3),
+        )
+        assert wgl_ref.check(m.cas_register(), h)["valid"] is True
+
+    def test_failed_cas_stripped(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "cas", [5, 6]), fail(1, "cas", [5, 6]),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        assert wgl_ref.check(m.cas_register(), h)["valid"] is True
+
+    def test_crashed_write_may_take_effect(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "write", 2), info(1, "write", 2),
+            invoke(0, "read"), ok(0, "read", 2),
+        )
+        assert wgl_ref.check(m.register(), h)["valid"] is True
+
+    def test_crashed_write_may_never_take_effect(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "write", 2), info(1, "write", 2),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        assert wgl_ref.check(m.register(), h)["valid"] is True
+
+    def test_crashed_op_cannot_take_effect_before_invocation(self):
+        # read of 2 returns BEFORE write 2 is invoked (and crashes): invalid
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(2, "read"), ok(2, "read", 2),
+            invoke(1, "write", 2), info(1, "write", 2),
+        )
+        assert wgl_ref.check(m.register(), h)["valid"] is False
+
+    def test_mutex_double_acquire_invalid(self):
+        h = hist(
+            invoke(0, "acquire"), ok(0, "acquire"),
+            invoke(1, "acquire"), ok(1, "acquire"),
+        )
+        assert wgl_ref.check(m.mutex(), h)["valid"] is False
+
+    def test_mutex_handoff_valid(self):
+        h = hist(
+            invoke(0, "acquire"), ok(0, "acquire"),
+            invoke(1, "acquire"),
+            invoke(0, "release"), ok(0, "release"),
+            ok(1, "acquire"),
+        )
+        assert wgl_ref.check(m.mutex(), h)["valid"] is True
+
+    def test_timeout_returns_unknown(self):
+        h = fixtures.gen_history("cas", n_ops=300, processes=8, seed=7)
+        res = wgl_ref.check(m.cas_register(), h, time_limit=0.0,
+                            strategy="bfs")
+        assert res["valid"] == "unknown"
+        assert res["cause"] == "timeout"
+
+    def test_config_explosion_returns_unknown(self):
+        h = fixtures.gen_history("cas", n_ops=400, processes=8, seed=3,
+                                 crash_p=0.1)
+        res = wgl_ref.check(m.cas_register(), h, strategy="bfs",
+                            max_configs=500)
+        assert res["valid"] == "unknown"
+
+    @pytest.mark.parametrize("strategy", ["bfs", "dfs"])
+    def test_strategies_agree(self, strategy):
+        for seed in range(10):
+            h = fixtures.gen_history("cas", n_ops=40, processes=4, seed=seed,
+                                     crash_p=0.1)
+            if seed % 2:
+                h = fixtures.corrupt(h, seed=seed)
+            res = wgl_ref.check(m.cas_register(), h, strategy=strategy)
+            want = wgl_ref.check(m.cas_register(), h,
+                                 strategy="bfs" if strategy == "dfs"
+                                 else "dfs")
+            assert res["valid"] == want["valid"]
+
+
+class TestGeneratedHistories:
+    @pytest.mark.parametrize("kind", ["register", "cas", "mutex", "multi"])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_generated_valid(self, kind, seed):
+        h = fixtures.gen_history(kind, n_ops=60, processes=4, seed=seed,
+                                 crash_p=0.05)
+        res = wgl_ref.check(fixtures.model_for(kind), h)
+        assert res["valid"] is True, res
+
+    @pytest.mark.parametrize("kind", ["register", "cas"])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_corrupted_invalid(self, kind, seed):
+        h = fixtures.gen_history(kind, n_ops=60, processes=4, seed=seed)
+        bad = fixtures.corrupt(h, seed=seed)
+        res = wgl_ref.check(fixtures.model_for(kind), bad)
+        assert res["valid"] is False, res
+
+
+class TestDifferentialVsBrute:
+    """Random tiny histories: wgl_ref must agree with the exhaustive
+    permutation checker on every one (valid and invalid alike)."""
+
+    @pytest.mark.parametrize("kind", ["register", "cas", "mutex"])
+    def test_agreement(self, kind):
+        import random
+        model = fixtures.model_for(kind)
+        checked = 0
+        for seed in range(120):
+            h = fixtures.gen_history(kind, n_ops=7, processes=3, seed=seed,
+                                     crash_p=0.15)
+            # randomly corrupt half the register-family histories
+            if kind != "mutex" and seed % 2 == 0:
+                try:
+                    h = fixtures.corrupt(h, seed=seed)
+                except ValueError:
+                    pass
+            want = brute.check(model, h)["valid"]
+            got = wgl_ref.check(model, h)["valid"]
+            assert got == want, (kind, seed, got, want,
+                                 [o.to_dict() for o in h])
+            checked += 1
+        assert checked == 120
