@@ -1,0 +1,83 @@
+"""Benchmark entry point — run the BASELINE.md ladder's headline config and
+print ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Headline (BASELINE.json north star): verify a 100k-op CAS-register history
+for linearizability in <60 s on TPU; metric is ops verified per second, and
+``vs_baseline`` is measured throughput over the north-star floor
+(100_000 ops / 60 s ≈ 1667 ops/s). The reference publishes no numbers of its
+own (SURVEY.md §6) — CPU Knossos folklore is that 100k-op single-key
+histories simply time out.
+
+Usage: python bench.py [--ops N] [--repeat K] [--engine reach|chunked]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=100_000)
+    ap.add_argument("--processes", type=int, default=5)
+    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--engine", default="reach",
+                    choices=["reach", "chunked", "wgl-cpu"])
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import reach, wgl_ref
+    from jepsen_tpu.history import pack
+
+    t0 = time.monotonic()
+    history = fixtures.gen_history("cas", n_ops=args.ops,
+                                   processes=args.processes, seed=args.seed)
+    gen_s = time.monotonic() - t0
+    model = models.cas_register()
+    packed = pack(history)
+
+    def run():
+        if args.engine == "reach":
+            return reach.check_packed(model, packed)
+        if args.engine == "chunked":
+            return reach.check_chunked(model, packed=packed)
+        return wgl_ref.check_packed(model, packed, time_limit=300)
+
+    # warm-up: first call pays jit compilation; the measurement is steady
+    # state (compile caches persist across runs of the same shapes).
+    res = run()
+    if res["valid"] is not True:
+        print(json.dumps({"metric": "linearize-100k-cas",
+                          "value": 0.0, "unit": "ops/s",
+                          "vs_baseline": 0.0,
+                          "error": f"bad verdict {res.get('valid')}"}))
+        return 1
+    times = []
+    for _ in range(max(1, args.repeat)):
+        t1 = time.monotonic()
+        res = run()
+        times.append(time.monotonic() - t1)
+    best = min(times)
+    ops_per_s = args.ops / best
+    baseline_floor = 100_000 / 60.0
+    out = {
+        "metric": f"linearize-{args.ops // 1000}k-cas",
+        "value": round(ops_per_s, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_s / baseline_floor, 2),
+        "check_s": round(best, 3),
+        "gen_s": round(gen_s, 2),
+        "engine": res.get("engine"),
+        "valid": res.get("valid"),
+        "events": res.get("events"),
+        "slots": res.get("slots"),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

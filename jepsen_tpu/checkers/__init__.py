@@ -3,11 +3,18 @@ library (SURVEY.md §2.1, §2.2). The façade module
 :mod:`jepsen_tpu.checkers.facade` provides the composable ``Checker`` API;
 the linearizability engines live in:
 
+- :mod:`jepsen_tpu.checkers.reach` — the TPU-native dense-reachability
+  search (the north star; upstream ``knossos.linear`` + ``knossos.wgl``
+  recast as a device-resident tensor program).
 - :mod:`jepsen_tpu.checkers.wgl_ref` — CPU reference Wing-Gong-Lowe search
   (upstream ``knossos.wgl``), the correctness oracle and CPU baseline.
 - :mod:`jepsen_tpu.checkers.brute` — exhaustive permutation checker for
   differential testing of tiny histories (no upstream analogue; replaces
   knossos's recorded-fixture cross-checks at the smallest scale).
-- :mod:`jepsen_tpu.checkers.wgl_tpu` — the batched JAX frontier search
-  (the north star; upstream ``knossos.wgl`` recast for the MXU).
+- :mod:`jepsen_tpu.checkers.events` — host-side slot/event-stream
+  preprocessing feeding the device engines.
 """
+from jepsen_tpu.checkers.facade import (  # noqa: F401
+    Checker, check_safe, compose, counter, linearizable, noop_checker,
+    queue, set_checker, stats, total_queue, unbridled_optimism,
+)

@@ -1,0 +1,166 @@
+"""Event-stream preprocessing for the device reachability engine.
+
+Upstream analogue: ``knossos/src/knossos/linear.clj``'s per-event walk and
+``knossos/src/knossos/linear/config.clj``'s packed config sets (SURVEY.md
+§2.2). Where the upstream advances an explicit *set of configuration
+objects* per history event, the TPU engine (:mod:`.reach`) advances a dense
+boolean reachability tensor indexed by ⟨model-state, linearized-pending
+bitmask⟩. This module builds the static, int-only event stream that tensor
+program consumes:
+
+- Each analysis entry contributes an ``invoke`` event and (unless crashed)
+  a ``return`` event, ordered by their history ranks.
+- Pending operations are assigned **slots** (lowest free slot at invoke,
+  freed at return). The slot count ``W`` bounds concurrency; the device
+  bitmask axis has size ``2**W``. Crashed ops hold their slot forever —
+  they may linearize at any later time — except crashed ops whose
+  transition is a no-op in every model state (e.g. a crashed blind read),
+  which are provably irrelevant and dropped here.
+
+Everything produced is a NumPy int array; only these (plus the memoized
+transition table) cross to the device.
+"""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu.history import PackedHistory
+from jepsen_tpu.models.memo import Memo
+
+KIND_INVOKE = 0
+KIND_RETURN = 1
+KIND_PAD = 2
+
+
+class ConcurrencyOverflow(RuntimeError):
+    """Raised when the history needs more pending-op slots than ``max_slots``
+    — the dense ``2**W`` bitmask axis would not fit on device. Callers fall
+    back to the CPU search (upstream behaviour: knossos.linear dies on
+    config-set explosion and the competition falls back to WGL)."""
+
+
+@dataclass(frozen=True)
+class EventStream:
+    """Static event stream for one history.
+
+    ``kind``/``slot``/``opid``/``entry`` are parallel ``i32[E]`` arrays;
+    ``opid`` is -1 for returns. ``W`` is the slot count (bitmask width).
+    ``n_events`` may be < len(kind) when padded for batching.
+    """
+    kind: np.ndarray
+    slot: np.ndarray
+    opid: np.ndarray
+    entry: np.ndarray
+    W: int
+    n_events: int
+    n_entries: int          # entries surviving preprocessing (incl. crashed)
+    n_dropped_crashed: int  # crashed no-op entries dropped
+
+    @property
+    def E(self) -> int:
+        return len(self.kind)
+
+
+def _noop_column(table: np.ndarray, oid: int) -> bool:
+    """True if op ``oid`` never changes any state: every transition is either
+    identity or inconsistent. Firing such an op is unobservable, so a crashed
+    instance of it (never constrained by a return) is irrelevant."""
+    col = table[:, oid]
+    states = np.arange(table.shape[0], dtype=col.dtype)
+    return bool(np.all((col == states) | (col == -1)))
+
+
+def build(packed: PackedHistory, memo: Memo, *,
+          max_slots: int = 20,
+          drop_noop_crashed: bool = True) -> EventStream:
+    """Assign slots and linearize the (invoke, return) events of ``packed``
+    into a flat stream. Raises :class:`ConcurrencyOverflow` if more than
+    ``max_slots`` ops are ever pending at once."""
+    n = packed.n
+    dropped = 0
+    # (rank, is_return, entry) triples; returns sort after invokes via rank
+    # (ranks are distinct history indices, so no ties are possible).
+    evs = []
+    for i in range(n):
+        crashed = bool(packed.crashed[i])
+        if crashed and drop_noop_crashed and \
+                _noop_column(memo.table, int(packed.op_id[i])):
+            dropped += 1
+            continue
+        evs.append((int(packed.inv_ev[i]), KIND_INVOKE, i))
+        if not crashed:
+            evs.append((int(packed.ret_ev[i]), KIND_RETURN, i))
+    evs.sort()
+    E = len(evs)
+    kind = np.full(E, KIND_PAD, np.int32)
+    slot = np.zeros(E, np.int32)
+    opid = np.full(E, -1, np.int32)
+    entry = np.zeros(E, np.int32)
+    free: list = []             # min-heap: reuse lowest slots first
+    hi = 0                      # next never-used slot
+    slot_of = {}
+    for e, (_, k, i) in enumerate(evs):
+        kind[e] = k
+        entry[e] = i
+        if k == KIND_INVOKE:
+            s = heapq.heappop(free) if free else hi
+            if s == hi:
+                hi += 1
+                if hi > max_slots:
+                    raise ConcurrencyOverflow(
+                        f"history needs >{max_slots} pending-op slots")
+            slot_of[i] = s
+            slot[e] = s
+            opid[e] = int(packed.op_id[i])
+        else:
+            s = slot_of.pop(i)
+            slot[e] = s
+            heapq.heappush(free, s)
+    return EventStream(kind=kind, slot=slot, opid=opid, entry=entry,
+                       W=hi, n_events=E, n_entries=n - dropped,
+                       n_dropped_crashed=dropped)
+
+
+def pad(stream: EventStream, E: int, W: Optional[int] = None) -> EventStream:
+    """Pad a stream to ``E`` events (kind=PAD) and widen to ``W`` slots, for
+    batching several keys' streams into one vmapped device call."""
+    W = stream.W if W is None else W
+    if W < stream.W or E < stream.n_events:
+        raise ValueError("cannot shrink a stream")
+    ext = E - stream.E
+
+    def _p(a: np.ndarray, fill: int) -> np.ndarray:
+        return np.concatenate([a, np.full(ext, fill, a.dtype)])
+
+    return EventStream(
+        kind=_p(stream.kind, KIND_PAD), slot=_p(stream.slot, 0),
+        opid=_p(stream.opid, -1), entry=_p(stream.entry, 0),
+        W=W, n_events=stream.n_events, n_entries=stream.n_entries,
+        n_dropped_crashed=stream.n_dropped_crashed)
+
+
+def chunk_slot_maps(stream: EventStream, n_ops: int,
+                    boundaries: np.ndarray) -> np.ndarray:
+    """For chunked (history-parallel) checking: the ``slot -> op id`` map in
+    force at the start of each chunk (i32[n_chunks, W]; -1 = free slot).
+    ``boundaries[c]`` is the first event index of chunk ``c``."""
+    W = stream.W
+    maps = np.full((len(boundaries), max(W, 1)), -1, np.int32)
+    cur = np.full(max(W, 1), -1, np.int32)
+    b = 0
+    for e in range(stream.E):
+        while b < len(boundaries) and boundaries[b] == e:
+            maps[b] = cur
+            b += 1
+        if stream.kind[e] == KIND_INVOKE:
+            cur[stream.slot[e]] = stream.opid[e]
+        elif stream.kind[e] == KIND_RETURN:
+            cur[stream.slot[e]] = -1
+    while b < len(boundaries):
+        maps[b] = cur
+        b += 1
+    return maps

@@ -1,0 +1,417 @@
+"""The composable ``Checker`` API — upstream ``jepsen/src/jepsen/checker.clj``
+(SURVEY.md §2.1): ``linearizable`` (delegating to the search engines, as the
+upstream delegates to Knossos via ``knossos.competition/analysis``), the
+data-invariant checkers (``set``, ``counter``, ``queue``, ``total-queue``),
+``compose``, ``noop``, ``unbridled-optimism``, and ``stats``.
+
+API shape: ``checker.check(test, history, opts) -> dict`` with at least a
+``"valid"`` key (``True`` / ``False`` / ``"unknown"``), mirroring the
+upstream protocol ``(check checker test model history)`` with the model
+carried by the checker (or the test map) instead of a positional argument.
+``check_safe`` converts a crashing checker into ``{"valid": "unknown"}``
+exactly like ``jepsen.checker/check-safe``.
+"""
+from __future__ import annotations
+
+import threading
+from collections import Counter as _Counter
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu import history as h
+from jepsen_tpu.models import Model
+from jepsen_tpu.op import FAIL, INFO, INVOKE, OK, Op
+from jepsen_tpu.util import hashable
+
+
+class Checker:
+    """Base checker (upstream ``jepsen.checker/Checker`` protocol)."""
+
+    name = "checker"
+
+    def check(self, test: Optional[Mapping], history: Sequence[Op],
+              opts: Optional[Mapping] = None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+def check_safe(checker: Checker, test: Optional[Mapping],
+               history: Sequence[Op],
+               opts: Optional[Mapping] = None) -> Dict[str, Any]:
+    """Run a checker, turning exceptions into ``{"valid": "unknown"}``
+    (upstream ``jepsen.checker/check-safe``)."""
+    try:
+        return checker.check(test, history, opts)
+    except Exception as e:                              # noqa: BLE001
+        return {"valid": "unknown", "error": f"{type(e).__name__}: {e}"}
+
+
+def _model_from(model: Optional[Model], test: Optional[Mapping]) -> Model:
+    if model is not None:
+        return model
+    if test is not None and test.get("model") is not None:
+        return test["model"]
+    raise ValueError("no model given (checker or test['model'])")
+
+
+@dataclass
+class Linearizable(Checker):
+    """Linearizability via the search engines (upstream
+    ``jepsen.checker/linearizable`` → ``knossos.competition/analysis``).
+
+    ``algorithm``:
+
+    - ``"auto"`` (default): the TPU dense-reachability engine; falls back to
+      the CPU WGL search when the history does not fit the dense config
+      space (state explosion / too many concurrent pending ops).
+    - ``"reach"`` / ``"reach-chunked"`` — device engine, sequential or
+      history-parallel (:mod:`jepsen_tpu.checkers.reach`).
+    - ``"wgl-cpu"`` — the CPU oracle (:mod:`jepsen_tpu.checkers.wgl_ref`).
+    - ``"competition"`` — device engine raced against the CPU search on a
+      thread, first verdict wins (upstream ``knossos.competition``).
+    """
+    model: Optional[Model] = None
+    algorithm: str = "auto"
+    opts: Dict[str, Any] = field(default_factory=dict)
+    name = "linearizable"
+
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.checkers import reach, wgl_ref
+        from jepsen_tpu.checkers.events import ConcurrencyOverflow
+        from jepsen_tpu.models.memo import StateExplosion
+
+        model = _model_from(self.model, test)
+        kw = dict(self.opts)
+        if opts:
+            kw.update({k: v for k, v in opts.items() if k != "model"})
+        algorithm = kw.pop("algorithm", self.algorithm)
+        if algorithm == "reach":
+            return reach.check(model, history, **_engine_kw(kw, _REACH_KW))
+        if algorithm == "reach-chunked":
+            return reach.check_chunked(model, history,
+                                       **_engine_kw(kw, _CHUNKED_KW))
+        if algorithm == "wgl-cpu":
+            return wgl_ref.check(model, history, **_engine_kw(kw, _WGL_KW))
+        if algorithm == "auto":
+            try:
+                return reach.check(model, history,
+                                   **_engine_kw(kw, _REACH_KW))
+            except (reach.DenseOverflow, ConcurrencyOverflow,
+                    StateExplosion):
+                res = wgl_ref.check(model, history,
+                                    **_engine_kw(kw, _WGL_KW))
+                res["engine"] = "wgl-cpu-fallback"
+                return res
+        if algorithm == "competition":
+            return _competition(model, history, kw)
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+
+
+# keyword subsets understood by each engine; user opts are filtered so one
+# checker config can carry opts for every algorithm it may route to.
+_REACH_KW = ("max_states", "max_slots", "max_dense")
+_CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
+_WGL_KW = ("time_limit", "max_configs", "strategy", "should_abort")
+
+
+def _engine_kw(kw: Mapping, allowed: Sequence[str]) -> Dict[str, Any]:
+    return {k: v for k, v in kw.items() if k in allowed}
+
+
+def _competition(model: Model, history: Sequence[Op],
+                 kw: Dict[str, Any]) -> Dict[str, Any]:
+    """Race the device engine against the CPU search on threads; the first
+    definitive verdict wins and the CPU search is aborted (upstream
+    ``knossos.competition/analysis``). If one engine errors, the other's
+    verdict is used."""
+    import queue
+
+    from jepsen_tpu.checkers import reach, wgl_ref
+
+    done = threading.Event()
+    verdicts: "queue.Queue" = queue.Queue()
+
+    def run_cpu():
+        try:
+            r = wgl_ref.check(model, history, should_abort=done.is_set,
+                              **_engine_kw(kw, _WGL_KW))
+            verdicts.put(("wgl-cpu", r))
+        except Exception as e:                          # noqa: BLE001
+            verdicts.put(("wgl-cpu", {"valid": "unknown",
+                                      "error": str(e)}))
+
+    def run_tpu():
+        try:
+            r = reach.check(model, history, **_engine_kw(kw, _REACH_KW))
+            verdicts.put(("reach", r))
+        except Exception as e:                          # noqa: BLE001
+            verdicts.put(("reach", {"valid": "unknown", "error": str(e)}))
+
+    threads = [threading.Thread(target=run_cpu, daemon=True),
+               threading.Thread(target=run_tpu, daemon=True)]
+    for t in threads:
+        t.start()
+    winner: Optional[Dict[str, Any]] = None
+    for _ in threads:
+        name, r = verdicts.get()
+        if r.get("valid") in (True, False):
+            winner = dict(r)
+            winner["winner"] = name
+            break
+        winner = winner or r                 # keep an unknown as last resort
+    done.set()                               # abort the losing CPU search
+    return winner or {"valid": "unknown"}
+
+
+def linearizable(model: Optional[Model] = None,
+                 algorithm: str = "auto", **opts: Any) -> Linearizable:
+    return Linearizable(model=model, algorithm=algorithm, opts=opts)
+
+
+@dataclass
+class Compose(Checker):
+    """Run several named checkers; valid iff all are (upstream
+    ``jepsen.checker/compose``)."""
+    checkers: Dict[str, Checker]
+    name = "compose"
+
+    def check(self, test, history, opts=None):
+        results = {name: check_safe(c, test, history, opts)
+                   for name, c in self.checkers.items()}
+        valids = [r.get("valid") for r in results.values()]
+        if all(v is True for v in valids):
+            valid: Any = True
+        elif any(v is False for v in valids):
+            valid = False
+        else:
+            valid = "unknown"
+        return {"valid": valid, "results": results}
+
+
+def compose(checkers: Dict[str, Checker]) -> Compose:
+    return Compose(checkers)
+
+
+class NoopChecker(Checker):
+    """Always valid (upstream ``jepsen.checker/noop``)."""
+    name = "noop"
+
+    def check(self, test, history, opts=None):
+        return {"valid": True}
+
+
+class UnbridledOptimism(Checker):
+    """Everything is awesome (upstream
+    ``jepsen.checker/unbridled-optimism``)."""
+    name = "unbridled-optimism"
+
+    def check(self, test, history, opts=None):
+        return {"valid": True}
+
+
+def noop_checker() -> NoopChecker:
+    return NoopChecker()
+
+
+def unbridled_optimism() -> UnbridledOptimism:
+    return UnbridledOptimism()
+
+
+@dataclass
+class SetChecker(Checker):
+    """Grow-only set workload: ``add`` ops followed by a final ``read``
+    returning the set contents (upstream ``jepsen.checker/set``). Valid iff
+    every acknowledged add is present and nothing never-attempted is."""
+    name = "set"
+
+    def check(self, test, history, opts=None):
+        attempts = set()
+        acked = set()
+        final_read = None
+        for op in history:
+            if op.process == "nemesis":
+                continue
+            if op.f == "add":
+                v = hashable(op.value)
+                if op.type == INVOKE:
+                    attempts.add(v)
+                elif op.type == OK:
+                    acked.add(v)
+            elif op.f == "read" and op.type == OK:
+                final_read = {hashable(v) for v in (op.value or [])}
+        if final_read is None:
+            return {"valid": "unknown", "error": "no final read"}
+        lost = acked - final_read
+        unexpected = final_read - attempts
+        recovered = (final_read & attempts) - acked
+        return {
+            "valid": not lost and not unexpected,
+            "attempt-count": len(attempts), "acknowledged-count": len(acked),
+            "ok-count": len(final_read & acked),
+            "lost-count": len(lost), "lost": sorted(lost, key=repr),
+            "unexpected-count": len(unexpected),
+            "unexpected": sorted(unexpected, key=repr),
+            "recovered-count": len(recovered),
+            "recovered": sorted(recovered, key=repr),
+        }
+
+
+def set_checker() -> SetChecker:
+    return SetChecker()
+
+
+@dataclass
+class CounterChecker(Checker):
+    """Counter workload: ``add`` deltas (possibly failing or crashing) and
+    ``read`` observations (upstream ``jepsen.checker/counter``). Each ok
+    read must lie within the interval of possible counter values given
+    which adds had definitely / possibly taken effect at that moment."""
+    name = "counter"
+
+    def check(self, test, history, opts=None):
+        pairs = h.pair(h.index(list(history))
+                       if history and history[0].index < 0 else list(history))
+        adds, reads = [], []
+        INF = 1 << 60
+        for p in pairs:
+            if p.failed:
+                continue
+            op = p.invoke
+            ret = p.complete.index if not p.crashed else INF
+            if op.f == "add":
+                adds.append((op.index, ret, op.value or 0, p.crashed))
+            elif op.f == "read" and not p.crashed:
+                v = p.complete.value
+                if v is not None:
+                    reads.append((op.index, ret, v))
+        if not reads:
+            return {"valid": True, "reads-checked": 0}
+        a_inv = np.array([a[0] for a in adds], np.int64).reshape(-1, 1)
+        a_ret = np.array([a[1] for a in adds], np.int64).reshape(-1, 1)
+        a_d = np.array([a[2] for a in adds], np.float64).reshape(-1, 1)
+        a_crash = np.array([a[3] for a in adds], bool).reshape(-1, 1)
+        bad = []
+        lo_all = hi_all = 0.0
+        for chunk in range(0, len(reads), 4096):
+            rs = reads[chunk:chunk + 4096]
+            r_inv = np.array([r[0] for r in rs], np.int64)
+            r_ret = np.array([r[1] for r in rs], np.int64)
+            r_v = np.array([r[2] for r in rs], np.float64)
+            if len(adds):
+                # definitely applied: acked and returned before the read began
+                exact = (~a_crash) & (a_ret < r_inv)
+                # possibly applied: invoked before the read returned
+                maybe = (a_inv < r_ret) & ~exact
+                base = (a_d * exact).sum(axis=0)
+                lo = base + (np.minimum(a_d, 0) * maybe).sum(axis=0)
+                hi = base + (np.maximum(a_d, 0) * maybe).sum(axis=0)
+            else:
+                lo = hi = np.zeros(len(rs))
+            out = (r_v < lo) | (r_v > hi)
+            for i in np.nonzero(out)[0]:
+                bad.append({"value": rs[i][2], "index": int(rs[i][0]),
+                            "possible": [float(lo[i]), float(hi[i])]})
+        return {"valid": not bad, "reads-checked": len(reads),
+                "errors": bad[:32], "error-count": len(bad)}
+
+
+def counter() -> CounterChecker:
+    return CounterChecker()
+
+
+@dataclass
+class QueueChecker(Checker):
+    """Queue dequeues must come from somewhere: no value dequeued more times
+    than it was enqueue-attempted (upstream ``jepsen.checker/queue``)."""
+    name = "queue"
+
+    def check(self, test, history, opts=None):
+        enq = _Counter()
+        deq = _Counter()
+        for op in history:
+            if op.f == "enqueue" and op.type == INVOKE:
+                enq[hashable(op.value)] += 1
+            elif op.f == "dequeue" and op.type == OK:
+                deq[hashable(op.value)] += 1
+        overdrawn = {v: c - enq[v] for v, c in deq.items() if c > enq[v]}
+        return {"valid": not overdrawn,
+                "dequeued-count": sum(deq.values()),
+                "overdrawn": dict(sorted(overdrawn.items(),
+                                         key=lambda kv: repr(kv[0]))[:32])}
+
+
+def queue() -> QueueChecker:
+    return QueueChecker()
+
+
+@dataclass
+class TotalQueueChecker(Checker):
+    """Every acknowledged enqueue is dequeued exactly once; nothing is
+    dequeued that was never enqueued (upstream
+    ``jepsen.checker/total-queue``)."""
+    name = "total-queue"
+
+    def check(self, test, history, opts=None):
+        attempts = _Counter()
+        acked = _Counter()
+        deq = _Counter()
+        for op in history:
+            if op.f == "enqueue" and op.type == INVOKE:
+                attempts[hashable(op.value)] += 1
+            elif op.f == "enqueue" and op.type == OK:
+                acked[hashable(op.value)] += 1
+            elif op.f == "dequeue" and op.type == OK:
+                deq[hashable(op.value)] += 1
+        lost = {v: c - deq[v] for v, c in acked.items() if c > deq[v]}
+        unexpected = {v: c for v, c in deq.items() if v not in attempts}
+        duplicated = {v: c - attempts[v] for v, c in deq.items()
+                      if v in attempts and c > attempts[v]}
+        recovered = {v: c for v, c in deq.items()
+                     if v in attempts and v not in acked}
+        return {
+            "valid": not lost and not unexpected,
+            "attempt-count": sum(attempts.values()),
+            "acknowledged-count": sum(acked.values()),
+            "ok-count": sum((deq & acked).values()),
+            "lost-count": sum(lost.values()),
+            "lost": dict(list(lost.items())[:32]),
+            "unexpected-count": sum(unexpected.values()),
+            "unexpected": dict(list(unexpected.items())[:32]),
+            "duplicated-count": sum(duplicated.values()),
+            "recovered-count": sum(recovered.values()),
+        }
+
+
+def total_queue() -> TotalQueueChecker:
+    return TotalQueueChecker()
+
+
+@dataclass
+class StatsChecker(Checker):
+    """Op counts by function and completion type (later-era
+    ``jepsen.checker/stats``); valid unless some function had zero
+    successes."""
+    name = "stats"
+
+    def check(self, test, history, opts=None):
+        by_f: Dict[Any, _Counter] = {}
+        for op in history:
+            if op.type == INVOKE or op.process == "nemesis":
+                continue
+            by_f.setdefault(op.f, _Counter())[op.type] += 1
+        out = {}
+        valid = True
+        for f, c in sorted(by_f.items(), key=lambda kv: repr(kv[0])):
+            n_ok, n_fail, n_info = c[OK], c[FAIL], c[INFO]
+            ok_frac = n_ok / max(1, n_ok + n_fail + n_info)
+            f_valid = n_ok > 0
+            valid = valid and f_valid
+            out[f] = {"valid": f_valid, "count": n_ok + n_fail + n_info,
+                      "ok-count": n_ok, "fail-count": n_fail,
+                      "info-count": n_info, "ok-fraction": round(ok_frac, 4)}
+        return {"valid": valid if out else True, "by-f": out}
+
+
+def stats() -> StatsChecker:
+    return StatsChecker()

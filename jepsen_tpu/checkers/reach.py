@@ -1,0 +1,439 @@
+"""Dense-reachability linearizability engine — the TPU-native search.
+
+Upstream analogue: ``knossos/src/knossos/linear.clj`` (Lowe's just-in-time
+linearization) raced against ``knossos/src/knossos/wgl.clj`` by
+``knossos/src/knossos/competition.clj`` (SURVEY.md §2.2, §3.2). This is NOT a
+port of either: where the upstream maintains an explicit, heap-allocated
+*set* of configurations ⟨model-state, linearized-pending-ops⟩ and dies when
+it explodes, this engine observes that the config space is the product
+``states × 2**W`` (W = max concurrently-pending ops, small in real
+histories) and represents the *entire reachable set* as one dense boolean
+tensor ``R[state, mask]``. The search becomes a single ``lax.while_loop``
+over the history's event stream:
+
+- **fire** (linearize a pending op): a vectorized transition applied to all
+  configs at once — a gather through the memoized transition table plus a
+  scatter-or into the bit-set half of the mask axis. Between events, ops may
+  linearize in any order; the engine runs fire passes to a fixpoint
+  (monotone, so ≤ pending+1 passes), which covers every interleaving.
+- **invoke**: records the op in its slot (a loop-carried ``i32[W]`` map).
+- **return**: configs that never linearized the returning op are killed
+  (boolean mask); its slot bit is cleared and freed. An empty ``R`` is a
+  linearizability violation at exactly that event — the same minimal
+  evidence knossos reports.
+
+Closure passes are only needed immediately before return events: a fire
+deferred across intervening invokes is still legal (pending sets only grow
+between returns), so the reachable set at each return is unchanged — this
+is Lowe's just-in-time idea expressed as dataflow.
+
+Crashed (``info``) ops hold a slot forever and may fire at any later point
+or never — both covered by the optional fire. Crashed ops whose transitions
+are no-ops everywhere are dropped in preprocessing (:mod:`.events`).
+
+Scaling axes (SURVEY.md §2.4):
+
+- **Per-key batch** (``jepsen.independent``): :func:`check_many` vmaps the
+  walk over keys — embarrassingly parallel, shard the key axis over the
+  device mesh.
+- **History-length parallelism** (the sequence-parallel analogue):
+  :func:`check_chunked` splits the event stream into chunks and runs the
+  walk *batched over all D = states·2**W basis configs* per chunk —
+  computing each chunk's boolean transfer matrix in parallel — then
+  composes the matrices. Chunks shard across devices
+  (:mod:`jepsen_tpu.parallel`); composition is a tiny boolean matmul chain.
+
+Exact, not probabilistic: unlike a hashed memo table (fingerprint
+collisions could silently declare a non-linearizable history valid), the
+dense set cannot produce false verdicts.
+"""
+from __future__ import annotations
+
+import functools
+import time as _time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers import events as ev
+from jepsen_tpu.models import Model
+from jepsen_tpu.models.memo import Memo, StateExplosion, memo as build_memo
+from jepsen_tpu.op import Op
+
+
+class DenseOverflow(RuntimeError):
+    """The dense config tensor would exceed the configured budget; callers
+    should fall back to another engine."""
+
+
+# -- device program ----------------------------------------------------------
+
+def _fire_pass(R, slot_op, T):
+    """One pass of 'linearize one more pending op', vectorized over all
+    configs: for each slot j (static unroll), configs with bit j clear fire
+    the slot's op through the transition table into the bit-set half."""
+    import jax.numpy as jnp
+
+    S, M = R.shape
+    W = slot_op.shape[0]
+    n_cols = T.shape[1]
+    for j in range(W):
+        o = jnp.where(slot_op[j] < 0, n_cols - 1, slot_op[j])
+        col = T[:, o]                          # i32[S]; -1 = illegal
+        tgt = jnp.where(col < 0, S, col)       # row S = discard
+        Rr = R.reshape(S, M >> (j + 1), 2, 1 << j)
+        lo = Rr[:, :, 0, :]                    # configs with bit j clear
+        fired = jnp.zeros((S + 1,) + lo.shape[1:], jnp.bool_)
+        fired = fired.at[tgt].max(lo)
+        Rr = Rr.at[:, :, 1, :].set(Rr[:, :, 1, :] | fired[:S])
+        R = Rr.reshape(S, M)
+    return R
+
+
+def _closure(R, slot_op, T):
+    """Fixpoint of :func:`_fire_pass` — covers every linearization order of
+    any subset of pending ops (monotone ⇒ converges in ≤ pending+1 passes)."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    R1 = _fire_pass(R, slot_op, T)
+
+    def cond(c):
+        prev, cur = c
+        return jnp.any(prev != cur)
+
+    def body(c):
+        _, cur = c
+        return cur, _fire_pass(cur, slot_op, T)
+
+    _, Rf = lax.while_loop(cond, body, (R, R1))
+    return Rf
+
+
+def _project_return(R, j):
+    """Return of the op in (dynamic) slot ``j``: keep configs that fired it,
+    clearing bit j so the slot can be reused."""
+    import jax.numpy as jnp
+
+    S, M = R.shape
+    idx = jnp.arange(M)
+    src = idx | (1 << j)
+    clear = ((idx >> j) & 1) == 0
+    return jnp.where(clear[None, :], R[:, src], False)
+
+
+def _walk(T, kind, slot, opid, R0, slot_op0):
+    """Drive the event stream over the dense config set. Returns
+    ``(ptr, R, alive)``; ``alive=False`` means the set emptied at event
+    ``ptr-1`` (a violation witness)."""
+    from jax import lax
+    import jax.numpy as jnp
+
+    E = kind.shape[0]
+
+    def cond(c):
+        ptr, R, slot_op, alive = c
+        return (ptr < E) & alive
+
+    def body(c):
+        ptr, R, slot_op, alive = c
+        k, j, o = kind[ptr], slot[ptr], opid[ptr]
+
+        def on_invoke(R, slot_op):
+            return R, slot_op.at[j].set(o)
+
+        def on_return(R, slot_op):
+            Rc = _closure(R, slot_op, T)
+            return _project_return(Rc, j), slot_op.at[j].set(-1)
+
+        def on_pad(R, slot_op):
+            return R, slot_op
+
+        R, slot_op = lax.switch(k, [on_invoke, on_return, on_pad], R, slot_op)
+        return ptr + 1, R, slot_op, jnp.any(R)
+
+    init = (jnp.int32(0), R0, slot_op0, jnp.any(R0))
+    ptr, R, _, alive = lax.while_loop(cond, body, init)
+    return ptr, R, alive
+
+
+@functools.cache
+def _jitted_walk():
+    import jax
+    return jax.jit(_walk)
+
+
+@functools.cache
+def _jitted_walk_batch():
+    """vmap over a leading key axis on every operand (per-key transition
+    tables, event streams, and config sets)."""
+    import jax
+    return jax.jit(jax.vmap(_walk))
+
+
+@functools.cache
+def _jitted_basis_walk():
+    """vmap over (chunk, basis-config): computes per-chunk boolean transfer
+    matrices for history-length parallelism."""
+    import jax
+    # inner vmap: basis axis on R0 only; outer vmap: chunk axis on events,
+    # initial slot maps, and the basis block.
+    inner = jax.vmap(_walk, in_axes=(None, None, None, None, 0, None))
+    outer = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0, 0))
+    return jax.jit(outer)
+
+
+# -- host orchestration ------------------------------------------------------
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+def _pad_table(memo: Memo, S_pad: int, O_pad: int) -> np.ndarray:
+    """Transition table padded to [S_pad, O_pad+1]; everything outside the
+    real region (including the sentinel last column for opid=-1) is -1."""
+    S, O = memo.table.shape
+    T = np.full((S_pad, O_pad + 1), -1, np.int32)
+    T[:S, :O] = memo.table
+    return T
+
+
+def _prep(model: Model, packed: h.PackedHistory, *,
+          max_states: int, max_slots: int, max_dense: int,
+          e_bucket: int = 64):
+    """Shared host-side pipeline: memo table + slotted event stream, padded
+    to power-of-two buckets so jit compilations are reused across histories
+    of similar size."""
+    memo = build_memo(model, packed, max_states=max_states)
+    stream = ev.build(packed, memo, max_slots=max_slots)
+    S = memo.n_states
+    S_pad = max(2, _next_pow2(S))
+    M = 1 << stream.W
+    if S_pad * M > max_dense:
+        raise DenseOverflow(
+            f"dense config space {S_pad}x{M} exceeds budget {max_dense}")
+    O_pad = max(2, _next_pow2(memo.n_ops))
+    E_pad = max(e_bucket, _next_pow2(stream.E))
+    stream = ev.pad(stream, E_pad)
+    T = _pad_table(memo, S_pad, O_pad)
+    return memo, stream, T, S_pad, M
+
+
+def _result_valid(engine: str, stream: ev.EventStream, memo: Memo,
+                  elapsed: float) -> Dict[str, Any]:
+    return {"valid": True, "engine": engine, "events": stream.n_events,
+            "slots": stream.W, "states": memo.n_states,
+            "dropped-crashed-noops": stream.n_dropped_crashed,
+            "time-s": elapsed}
+
+
+def _result_invalid(engine: str, stream: ev.EventStream, memo: Memo,
+                    packed: h.PackedHistory, dead_event: int,
+                    elapsed: float) -> Dict[str, Any]:
+    entry = packed.entries[int(stream.entry[dead_event])]
+    linearized = int(np.sum(
+        stream.kind[:dead_event] == ev.KIND_RETURN))
+    return {"valid": False, "engine": engine, "op": entry.op.to_dict(),
+            "max-linearized": linearized, "events": stream.n_events,
+            "slots": stream.W, "states": memo.n_states,
+            "dead-event": int(dead_event), "time-s": elapsed}
+
+
+def check(model: Model, history: Sequence[Op], *,
+          max_states: int = 100_000, max_slots: int = 20,
+          max_dense: int = 1 << 22) -> Dict[str, Any]:
+    """Check one history on device. Raises :class:`DenseOverflow`,
+    :class:`~jepsen_tpu.checkers.events.ConcurrencyOverflow`, or
+    :class:`~jepsen_tpu.models.memo.StateExplosion` when the history does
+    not fit this engine — the :func:`jepsen_tpu.checkers.linearizable`
+    facade catches these and falls back to the CPU search."""
+    packed = h.pack(history)
+    return check_packed(model, packed, max_states=max_states,
+                        max_slots=max_slots, max_dense=max_dense)
+
+
+def check_packed(model: Model, packed: h.PackedHistory, *,
+                 max_states: int = 100_000, max_slots: int = 20,
+                 max_dense: int = 1 << 22) -> Dict[str, Any]:
+    import jax.numpy as jnp
+
+    t0 = _time.monotonic()
+    if packed.n == 0 or packed.n_ok == 0:
+        return {"valid": True, "engine": "reach", "events": 0,
+                "time-s": 0.0}
+    memo, stream, T, S_pad, M = _prep(
+        model, packed, max_states=max_states, max_slots=max_slots,
+        max_dense=max_dense)
+    R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
+    slot_op0 = jnp.full((max(stream.W, 1),), -1, jnp.int32)
+    ptr, _, alive = _jitted_walk()(
+        jnp.asarray(T), jnp.asarray(stream.kind), jnp.asarray(stream.slot),
+        jnp.asarray(stream.opid), R0, slot_op0)
+    elapsed = _time.monotonic() - t0
+    if bool(alive):
+        return _result_valid("reach", stream, memo, elapsed)
+    return _result_invalid("reach", stream, memo, packed,
+                           int(ptr) - 1, elapsed)
+
+
+def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
+               max_states: int = 100_000, max_slots: int = 20,
+               max_dense: int = 1 << 22) -> List[Dict[str, Any]]:
+    """Batched per-key checking (the ``independent`` checker's hot path):
+    one vmapped device call over all keys, padded to common shapes. Keys
+    whose history does not fit the dense engine raise; callers split those
+    out first via :func:`fits`."""
+    import jax.numpy as jnp
+
+    t0 = _time.monotonic()
+    preps = []
+    for packed in packed_list:
+        if packed.n == 0 or packed.n_ok == 0:
+            preps.append(None)
+            continue
+        preps.append(_prep(model, packed, max_states=max_states,
+                           max_slots=max_slots, max_dense=max_dense))
+    live = [i for i, p in enumerate(preps) if p is not None]
+    results: List[Optional[Dict[str, Any]]] = [
+        None if p is not None else
+        {"valid": True, "engine": "reach-batch", "events": 0, "time-s": 0.0}
+        for p in preps]
+    if live:
+        S_pad = max(p[3] for i, p in enumerate(preps) if p is not None)
+        W = max(preps[i][1].W for i in live)
+        M = 1 << W
+        if S_pad * M > max_dense:
+            # padding every key to the common (S_pad, W) can overflow even
+            # when each key fits individually
+            raise DenseOverflow(
+                f"batched dense config space {S_pad}x{M} exceeds budget "
+                f"{max_dense}")
+        E_pad = max(preps[i][1].E for i in live)
+        O_pad = max(preps[i][2].shape[1] for i in live) - 1
+        Ts, kinds, slots, opids, R0s, slot0s, streams = [], [], [], [], [], [], []
+        for i in live:
+            memo, stream, _, _, _ = preps[i]
+            stream = ev.pad(stream, E_pad, W)
+            streams.append(stream)
+            Ts.append(_pad_table(memo, S_pad, O_pad))
+            kinds.append(stream.kind)
+            slots.append(stream.slot)
+            opids.append(stream.opid)
+            R0 = np.zeros((S_pad, M), bool)
+            R0[0, 0] = True
+            R0s.append(R0)
+            slot0s.append(np.full(max(W, 1), -1, np.int32))
+        ptrs, _, alives = _jitted_walk_batch()(
+            jnp.asarray(np.stack(Ts)), jnp.asarray(np.stack(kinds)),
+            jnp.asarray(np.stack(slots)), jnp.asarray(np.stack(opids)),
+            jnp.asarray(np.stack(R0s)), jnp.asarray(np.stack(slot0s)))
+        elapsed = _time.monotonic() - t0
+        ptrs = np.asarray(ptrs)
+        alives = np.asarray(alives)
+        for k, i in enumerate(live):
+            memo, stream = preps[i][0], streams[k]
+            if bool(alives[k]):
+                results[i] = _result_valid("reach-batch", stream, memo,
+                                           elapsed)
+            else:
+                results[i] = _result_invalid(
+                    "reach-batch", stream, memo, packed_list[i],
+                    int(ptrs[k]) - 1, elapsed)
+    return results  # type: ignore[return-value]
+
+
+def check_chunked(model: Model, history: Sequence[Op] = (), *,
+                  packed: Optional[h.PackedHistory] = None,
+                  n_chunks: int = 8, max_states: int = 100_000,
+                  max_slots: int = 20, max_dense: int = 1 << 22,
+                  max_matrix: int = 1 << 26,
+                  devices: Optional[Sequence] = None) -> Dict[str, Any]:
+    """History-length-parallel check: split the event stream into
+    ``n_chunks`` chunks, compute each chunk's D×D boolean transfer matrix by
+    running the walk over all D basis configs (vmapped; chunks run in
+    parallel and shard across ``devices``), then fold the matrices.
+
+    The per-chunk basis walk costs D× the sequential walk's work but has
+    1/n_chunks the sequential depth — the winning trade on a mesh when D is
+    small (register-family models). Requires ``D**2 <= max_matrix``."""
+    import jax.numpy as jnp
+
+    t0 = _time.monotonic()
+    if packed is None:
+        packed = h.pack(history)
+    if packed.n == 0 or packed.n_ok == 0:
+        return {"valid": True, "engine": "reach-chunked", "events": 0,
+                "time-s": 0.0}
+    memo, stream, T, S_pad, M = _prep(
+        model, packed, max_states=max_states, max_slots=max_slots,
+        max_dense=max_dense)
+    D = S_pad * M
+    if D * D > max_matrix:
+        raise DenseOverflow(
+            f"chunk transfer matrix {D}x{D} exceeds budget {max_matrix}")
+    E = stream.E
+    n_chunks = max(1, min(n_chunks, E))
+    # chunk boundaries on the padded stream; padding events are no-ops so
+    # uneven trailing chunks are harmless.
+    per = -(-E // n_chunks)
+    E_chunk = per
+    bounds = np.arange(n_chunks) * per
+    slot_maps = ev.chunk_slot_maps(stream, memo.n_ops, bounds)
+
+    def _chunk(a: np.ndarray) -> np.ndarray:
+        out = np.full((n_chunks, E_chunk), ev.KIND_PAD
+                      if a is stream.kind else 0, a.dtype)
+        for c in range(n_chunks):
+            seg = a[bounds[c]:min(bounds[c] + per, E)]
+            out[c, :len(seg)] = seg
+        return out
+
+    kinds = _chunk(stream.kind)
+    slots = _chunk(stream.slot)
+    opids = _chunk(stream.opid)
+    opids[kinds == ev.KIND_PAD] = -1
+    # basis: R0[b] = one-hot config b = (state, mask)
+    basis = np.zeros((D, S_pad, M), bool)
+    idx = np.arange(D)
+    basis[idx, idx // M, idx % M] = True
+    basis_c = np.broadcast_to(basis, (n_chunks, D, S_pad, M))
+
+    args = (jnp.asarray(T), jnp.asarray(kinds), jnp.asarray(slots),
+            jnp.asarray(opids), jnp.asarray(basis_c),
+            jnp.asarray(slot_maps))
+    if devices is not None and len(devices) > 1:
+        from jepsen_tpu.parallel import chunked_transfer
+        mats = chunked_transfer(args, devices)
+    else:
+        _, R, _ = _jitted_basis_walk()(*args)
+        mats = np.asarray(R).reshape(n_chunks, D, D)
+    # fold: v0 through each chunk's transfer matrix
+    v = np.zeros(D, bool)
+    v[0] = True                                  # state 0, mask 0
+    dead_chunk = -1
+    for c in range(n_chunks):
+        v = (v[:, None] & mats[c]).any(axis=0)
+        if not v.any():
+            dead_chunk = c
+            break
+    elapsed = _time.monotonic() - t0
+    if dead_chunk < 0:
+        out = _result_valid("reach-chunked", stream, memo, elapsed)
+        out["chunks"] = n_chunks
+        return out
+    # coarse localization: re-walk the failing prefix sequentially to find
+    # the exact event (still device work, bounded by one chunk).
+    import jax.numpy as jnp2
+    hi = min(int(bounds[dead_chunk] + per), E)
+    R0 = jnp2.zeros((S_pad, M), jnp2.bool_).at[0, 0].set(True)
+    slot_op0 = jnp2.full((max(stream.W, 1),), -1, jnp2.int32)
+    ptr, _, alive = _jitted_walk()(
+        jnp2.asarray(T), jnp2.asarray(stream.kind[:hi]),
+        jnp2.asarray(stream.slot[:hi]), jnp2.asarray(stream.opid[:hi]),
+        R0, slot_op0)
+    elapsed = _time.monotonic() - t0
+    out = _result_invalid("reach-chunked", stream, memo, packed,
+                          int(ptr) - 1, elapsed)
+    out["chunks"] = n_chunks
+    return out

@@ -30,7 +30,7 @@ alphabets tractable without the full BFS table of
 from __future__ import annotations
 
 import time as _time
-from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from jepsen_tpu import history as h
 from jepsen_tpu.models import Model, is_inconsistent
@@ -42,7 +42,9 @@ INF = 1 << 60
 def check(model: Model, history: Sequence[Op], *,
           time_limit: Optional[float] = None,
           max_configs: int = 5_000_000,
-          strategy: str = "dfs") -> Dict[str, Any]:
+          strategy: str = "dfs",
+          should_abort: Optional[Callable[[], bool]] = None
+          ) -> Dict[str, Any]:
     """Check ``history`` against ``model``. Returns a knossos-style map:
     ``{"valid": True|False|"unknown", "configs-explored": int, ...}``; on
     failure adds ``"op"`` (the op that could not be linearized) and
@@ -56,13 +58,16 @@ def check(model: Model, history: Sequence[Op], *,
     entries = h.analysis_entries(history)
     packed = h.pack_entries(entries)
     return check_packed(model, packed, time_limit=time_limit,
-                        max_configs=max_configs, strategy=strategy)
+                        max_configs=max_configs, strategy=strategy,
+                        should_abort=should_abort)
 
 
 def check_packed(model: Model, packed: h.PackedHistory, *,
                  time_limit: Optional[float] = None,
                  max_configs: int = 5_000_000,
-                 strategy: str = "dfs") -> Dict[str, Any]:
+                 strategy: str = "dfs",
+                 should_abort: Optional[Callable[[], bool]] = None
+                 ) -> Dict[str, Any]:
     n = packed.n
     if n == 0:
         return {"valid": True, "configs-explored": 0}
@@ -139,6 +144,9 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         return out
 
     def over_budget() -> Optional[Dict[str, Any]]:
+        if should_abort is not None and should_abort():
+            return {"valid": "unknown", "cause": "aborted",
+                    "configs-explored": explored}
         if time_limit is not None and _time.monotonic() - start > time_limit:
             return {"valid": "unknown", "cause": "timeout",
                     "configs-explored": explored}

@@ -1,0 +1,112 @@
+"""Key-independent workloads — upstream ``jepsen/src/jepsen/independent.clj``
+(SURVEY.md §2.1, §3.5): lift a single-key workload/checker over N independent
+keys. Op values become ``[key, subvalue]`` tuples; the checker splits the
+history per key, runs the inner checker on each sub-history, and merges.
+
+TPU-first difference: per-key sub-histories are an *embarrassingly parallel
+batch dimension* (SURVEY.md §2.4). When the inner checker is
+``linearizable``, all keys that fit the dense engine are checked in ONE
+vmapped device call (:func:`jepsen_tpu.checkers.reach.check_many`) — the
+upstream runs per-key Knossos analyses on a thread pool.
+
+Generator-side combinators (``sequential_generator``,
+``concurrent_generator``) live in :mod:`jepsen_tpu.generators`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers.facade import Checker, Linearizable, check_safe
+from jepsen_tpu.op import Op
+from jepsen_tpu.util import hashable
+
+
+def ktuple(key: Any, value: Any) -> List[Any]:
+    """An independent op value ``[key, subvalue]`` (upstream
+    ``jepsen.independent/tuple``)."""
+    return [key, value]
+
+
+def is_ktuple(value: Any) -> bool:
+    return isinstance(value, (list, tuple)) and len(value) == 2
+
+
+def split_history(history: Sequence[Op]) -> Dict[Any, List[Op]]:
+    """Group ops by key, unwrapping ``[key, subvalue]`` values. Ops without
+    tuple values (e.g. nemesis) are dropped, as upstream."""
+    out: Dict[Any, List[Op]] = {}
+    for op in history:
+        if op.process == "nemesis" or not is_ktuple(op.value):
+            continue
+        k, v = op.value
+        out.setdefault(hashable(k), []).append(op.with_(value=v))
+    return {k: h.index(ops) for k, ops in out.items()}
+
+
+class IndependentChecker(Checker):
+    """Apply ``inner`` to each key's sub-history; valid iff every key is
+    (upstream ``jepsen.independent/checker``)."""
+    name = "independent"
+
+    def __init__(self, inner: Checker):
+        self.inner = inner
+
+    def check(self, test: Optional[Mapping], history: Sequence[Op],
+              opts: Optional[Mapping] = None) -> Dict[str, Any]:
+        subs = split_history(history)
+        keys = sorted(subs.keys(), key=repr)
+        results: Dict[Any, Dict[str, Any]] = {}
+        if isinstance(self.inner, Linearizable) and \
+                self.inner.algorithm in ("auto", "reach"):
+            results = self._check_batched(test, subs, keys, opts)
+        else:
+            for k in keys:
+                results[k] = check_safe(self.inner, test, subs[k], opts)
+        valids = [r.get("valid") for r in results.values()]
+        if all(v is True for v in valids):
+            valid: Any = True
+        elif any(v is False for v in valids):
+            valid = False
+        else:
+            valid = "unknown"
+        failures = [k for k, r in results.items() if r.get("valid") is False]
+        return {"valid": valid, "key-count": len(keys),
+                "failures": failures, "results": results}
+
+    def _check_batched(self, test, subs, keys, opts):
+        """One vmapped device call for every key that fits the dense
+        engine; per-key fallback for the rest."""
+        from jepsen_tpu.checkers import reach
+        from jepsen_tpu.checkers.events import ConcurrencyOverflow
+        from jepsen_tpu.models.memo import StateExplosion
+
+        from jepsen_tpu.checkers.facade import (_REACH_KW, _engine_kw,
+                                                _model_from)
+        model = _model_from(self.inner.model, test)
+        kw = dict(self.inner.opts)
+        if opts:
+            kw.update(opts)
+        kw = _engine_kw(kw, _REACH_KW)
+        packs, fits, results = {}, [], {}
+        for k in keys:
+            try:
+                packs[k] = h.pack(subs[k])
+                fits.append(k)
+            except Exception as e:                      # noqa: BLE001
+                results[k] = {"valid": "unknown",
+                              "error": f"{type(e).__name__}: {e}"}
+        try:
+            batch = reach.check_many(model, [packs[k] for k in fits], **kw)
+            for k, r in zip(fits, batch):
+                results[k] = r
+        except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion):
+            # some key (or the common padding) is too big for the dense
+            # engine: per-key checking, each falling back as needed
+            for k in fits:
+                results[k] = check_safe(self.inner, test, subs[k], opts)
+        return results
+
+
+def checker(inner: Checker) -> IndependentChecker:
+    return IndependentChecker(inner)

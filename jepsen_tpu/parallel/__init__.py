@@ -1,0 +1,85 @@
+"""Device-mesh parallelism for the checker searches.
+
+No upstream analogue: the reference's analysis is single-JVM
+(``knossos.competition`` merely races two algorithms on two threads —
+SURVEY.md §2.4). Here the scaling axes are native to the hardware:
+
+- **key axis** — per-key sub-histories (``jepsen.independent`` semantics)
+  are independent searches: shard the batch over the mesh, one vmapped walk
+  per device, no communication until the final validity reduction.
+- **chunk axis** — a single long history splits into event chunks whose
+  boolean transfer matrices are computed in parallel (basis-batched walks)
+  and composed; the composition is associative, so chunks shard cleanly
+  and combine with an all-gather of small D×D matrices over ICI.
+
+Collectives ride XLA (``psum`` for validity reductions, ``all_gather`` for
+matrix combination); there is no NCCL/MPI-style backend to port — the mesh
+IS the communication layer.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def devices(platform: Optional[str] = None) -> list:
+    import jax
+    return jax.devices(platform)
+
+
+def mesh(axis: str = "shard", devs: Optional[Sequence] = None):
+    """A 1-D mesh over ``devs`` (default: all devices)."""
+    import jax
+    from jax.sharding import Mesh
+    devs = list(devs) if devs is not None else jax.devices()
+    return Mesh(np.array(devs), (axis,))
+
+
+def shard_leading_axis(arrays, devs: Optional[Sequence] = None):
+    """Place each array with its leading axis sharded across ``devs``
+    (padding to a multiple of the device count is the caller's job)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    m = mesh("shard", devs)
+    s = NamedSharding(m, P("shard"))
+    return [jax.device_put(a, s) for a in arrays]
+
+
+def chunked_transfer(args, devs: Sequence):
+    """Compute per-chunk transfer matrices with the chunk axis sharded over
+    ``devs`` via ``shard_map``. ``args`` = (T, kinds, slots, opids, basis_c,
+    slot_maps) as built by :func:`jepsen_tpu.checkers.reach.check_chunked`;
+    the transition table is replicated, everything else is chunk-sharded.
+    Returns a host ndarray [n_chunks, D, D]."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from jepsen_tpu.checkers import reach
+
+    T, kinds, slots, opids, basis_c, slot_maps = args
+    n_chunks = kinds.shape[0]
+    n_dev = len(devs)
+    if n_chunks % n_dev:
+        raise ValueError(f"n_chunks {n_chunks} not divisible by "
+                         f"{n_dev} devices")
+    m = mesh("chunks", devs)
+
+    def local(T, kinds, slots, opids, basis_c, slot_maps):
+        inner = jax.vmap(reach._walk,
+                         in_axes=(None, None, None, None, 0, None))
+        outer = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0, 0))
+        _, R, _ = outer(T, kinds, slots, opids, basis_c, slot_maps)
+        return R
+
+    sm = jax.shard_map(
+        local, mesh=m,
+        in_specs=(P(), P("chunks"), P("chunks"), P("chunks"), P("chunks"),
+                  P("chunks")),
+        out_specs=P("chunks"),
+        # the replicated transition table mixes invariant/variant operands
+        # inside control flow; skip the varying-axes check
+        check_vma=False)
+    R = jax.jit(sm)(T, kinds, slots, opids, basis_c, slot_maps)
+    D = R.shape[1]
+    return np.asarray(R).reshape(n_chunks, D, D)

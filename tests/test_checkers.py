@@ -1,0 +1,246 @@
+"""Checker façade tests — synthetic-history pure-data tests in the style of
+``jepsen/test/jepsen/checker_test.clj`` (SURVEY.md §4)."""
+import os
+
+import pytest
+
+from jepsen_tpu import fixtures, independent
+from jepsen_tpu import models as m
+from jepsen_tpu.checkers import (
+    check_safe, compose, counter, linearizable, noop_checker, queue,
+    set_checker, stats, total_queue, unbridled_optimism,
+)
+from jepsen_tpu.checkers import perf, timeline
+from jepsen_tpu.history import index
+from jepsen_tpu.op import fail, info, invoke, ok
+
+
+def hist(*ops):
+    return index(list(ops))
+
+
+class TestLinearizable:
+    @pytest.mark.parametrize("algorithm",
+                             ["auto", "reach", "wgl-cpu", "competition"])
+    def test_valid_history(self, algorithm):
+        h = fixtures.gen_history("cas", n_ops=40, processes=4, seed=5)
+        c = linearizable(m.cas_register(), algorithm=algorithm)
+        assert c.check(None, h)["valid"] is True
+
+    @pytest.mark.parametrize("algorithm",
+                             ["auto", "reach", "wgl-cpu", "competition"])
+    def test_invalid_history(self, algorithm):
+        h = fixtures.corrupt(
+            fixtures.gen_history("cas", n_ops=40, processes=4, seed=5),
+            seed=5)
+        c = linearizable(m.cas_register(), algorithm=algorithm)
+        assert c.check(None, h)["valid"] is False
+
+    def test_model_from_test_map(self):
+        h = fixtures.gen_history("register", n_ops=20, processes=3, seed=0)
+        res = linearizable().check({"model": m.register()}, h)
+        assert res["valid"] is True
+
+    def test_auto_falls_back_on_overflow(self):
+        # 12 concurrent processes with a tiny dense budget: reach engine
+        # can't fit, CPU search must still answer.
+        h = fixtures.gen_history("register", n_ops=30, processes=3, seed=2)
+        c = linearizable(m.register(), max_dense=2)
+        res = c.check(None, h)
+        assert res["valid"] is True
+        assert res["engine"] == "wgl-cpu-fallback"
+
+    def test_check_safe_catches(self):
+        class Boom(type(noop_checker())):
+            def check(self, *a, **k):
+                raise RuntimeError("boom")
+        res = check_safe(Boom(), None, [])
+        assert res["valid"] == "unknown"
+        assert "boom" in res["error"]
+
+
+class TestSetChecker:
+    def test_ok_and_lost(self):
+        h = hist(
+            invoke(0, "add", 1), ok(0, "add", 1),
+            invoke(1, "add", 2), ok(1, "add", 2),
+            invoke(2, "add", 3), info(2, "add", 3),
+            invoke(0, "read"), ok(0, "read", [1, 3]),
+        )
+        res = set_checker().check(None, h)
+        assert res["valid"] is False
+        assert res["lost"] == [2]
+        assert res["recovered"] == [3]
+        assert res["unexpected"] == []
+
+    def test_unexpected(self):
+        h = hist(invoke(0, "read"), ok(0, "read", [9]))
+        res = set_checker().check(None, h)
+        assert res["valid"] is False
+        assert res["unexpected"] == [9]
+
+    def test_no_read_unknown(self):
+        h = hist(invoke(0, "add", 1), ok(0, "add", 1))
+        assert set_checker().check(None, h)["valid"] == "unknown"
+
+
+class TestCounter:
+    def test_simple_valid(self):
+        h = hist(
+            invoke(0, "add", 2), ok(0, "add", 2),
+            invoke(0, "read"), ok(0, "read", 2),
+            invoke(1, "add", 3), ok(1, "add", 3),
+            invoke(0, "read"), ok(0, "read", 5),
+        )
+        assert counter().check(None, h)["valid"] is True
+
+    def test_concurrent_add_read_range(self):
+        # read concurrent with add 5: interval bound [0, 5] (the upstream
+        # counter checker is interval-approximate, not exact-set)
+        for seen, want in [(0, True), (5, True), (3, True), (7, False),
+                           (-1, False)]:
+            h = hist(
+                invoke(0, "add", 5),
+                invoke(1, "read"), ok(1, "read", seen),
+                ok(0, "add", 5),
+            )
+            assert counter().check(None, h)["valid"] is want, seen
+
+    def test_crashed_add_maybe(self):
+        for seen in (0, 5):
+            h = hist(
+                invoke(0, "add", 5), info(0, "add", 5),
+                invoke(1, "read"), ok(1, "read", seen),
+            )
+            assert counter().check(None, h)["valid"] is True, seen
+
+    def test_impossible_read(self):
+        h = hist(
+            invoke(0, "add", 1), ok(0, "add", 1),
+            invoke(1, "read"), ok(1, "read", 9),
+        )
+        res = counter().check(None, h)
+        assert res["valid"] is False
+        assert res["error-count"] == 1
+
+
+class TestQueues:
+    def test_queue_overdraw(self):
+        h = hist(
+            invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+            invoke(1, "dequeue"), ok(1, "dequeue", 1),
+            invoke(2, "dequeue"), ok(2, "dequeue", 1),
+        )
+        res = queue().check(None, h)
+        assert res["valid"] is False
+
+    def test_total_queue_lost(self):
+        h = hist(
+            invoke(0, "enqueue", 1), ok(0, "enqueue", 1),
+            invoke(0, "enqueue", 2), ok(0, "enqueue", 2),
+            invoke(1, "dequeue"), ok(1, "dequeue", 1),
+        )
+        res = total_queue().check(None, h)
+        assert res["valid"] is False
+        assert res["lost-count"] == 1
+
+    def test_total_queue_recovered(self):
+        h = hist(
+            invoke(0, "enqueue", 1), info(0, "enqueue", 1),
+            invoke(1, "dequeue"), ok(1, "dequeue", 1),
+        )
+        res = total_queue().check(None, h)
+        assert res["valid"] is True
+        assert res["recovered-count"] == 1
+
+
+class TestComposeStats:
+    def test_compose(self):
+        h = fixtures.gen_history("cas", n_ops=20, processes=3, seed=1)
+        c = compose({"linear": linearizable(m.cas_register()),
+                     "stats": stats(),
+                     "noop": noop_checker()})
+        res = c.check(None, h)
+        assert res["valid"] is True
+        assert set(res["results"]) == {"linear", "stats", "noop"}
+
+    def test_compose_invalid_if_any(self):
+        h = fixtures.corrupt(
+            fixtures.gen_history("cas", n_ops=20, processes=3, seed=1),
+            seed=1)
+        c = compose({"linear": linearizable(m.cas_register()),
+                     "optimism": unbridled_optimism()})
+        assert c.check(None, h)["valid"] is False
+
+    def test_stats(self):
+        h = hist(
+            invoke(0, "read"), ok(0, "read", None),
+            invoke(0, "write", 1), fail(0, "write", 1),
+        )
+        res = stats().check(None, h)
+        assert res["valid"] is False            # write never succeeded
+        assert res["by-f"]["read"]["valid"] is True
+
+
+class TestIndependent:
+    def _multi_key_history(self, n_keys=4, corrupt_key=None):
+        ops = []
+        for k in range(n_keys):
+            h = fixtures.gen_history("cas", n_ops=15, processes=3, seed=k)
+            if k == corrupt_key:
+                h = fixtures.corrupt(h, seed=k)
+            for op in h:
+                ops.append(op.with_(value=independent.ktuple(k, op.value),
+                                    index=-1))
+        # interleaving across keys is irrelevant to per-key checking;
+        # concatenation keeps each key's internal order.
+        from jepsen_tpu.history import index as idx
+        return idx(ops)
+
+    def test_all_keys_valid(self):
+        h = self._multi_key_history()
+        c = independent.checker(linearizable(m.cas_register()))
+        res = c.check(None, h)
+        assert res["valid"] is True
+        assert res["key-count"] == 4
+
+    def test_one_bad_key(self):
+        h = self._multi_key_history(corrupt_key=2)
+        c = independent.checker(linearizable(m.cas_register()))
+        res = c.check(None, h)
+        assert res["valid"] is False
+        assert res["failures"] == [2]
+        assert res["results"][2]["valid"] is False
+
+    def test_non_linearizable_inner(self):
+        h = self._multi_key_history()
+        c = independent.checker(stats())
+        assert c.check(None, h)["valid"] is True
+
+
+class TestReporting:
+    def test_timeline_writes_html(self, tmp_path):
+        h = fixtures.gen_history("cas", n_ops=20, processes=3, seed=0)
+        res = timeline.html().check({"name": "t", "store_dir": str(tmp_path)},
+                                    h)
+        assert res["valid"] is True
+        body = open(res["file"]).read()
+        assert "<html" in body and "process" in body
+
+    def test_perf_graphs_write_pngs(self, tmp_path):
+        h = [op.with_(time=op.index * 1_000_000)
+             for op in fixtures.gen_history("cas", n_ops=30, processes=3,
+                                            seed=0)]
+        for chk, fname in [(perf.latency_graph(), "latency-raw.png"),
+                           (perf.rate_graph(), "rate.png")]:
+            res = chk.check({"store_dir": str(tmp_path)}, h)
+            assert res["valid"] is True
+            assert os.path.exists(os.path.join(str(tmp_path), fname))
+
+    def test_latency_points(self):
+        h = hist(
+            invoke(0, "read").with_(time=0),
+            ok(0, "read", 1).with_(time=5_000_000),
+        )
+        pts = perf.latency_points(h)
+        assert pts["ok"] == [(0.0, 5.0)]

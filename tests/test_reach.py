@@ -1,0 +1,184 @@
+"""TPU dense-reachability engine tests: hand-written verdicts, differential
+agreement with the CPU WGL oracle and the brute-force checker, batched
+multi-key checking, and chunked (history-parallel) equivalence — the
+TPU-vs-CPU differential tier SURVEY.md §4 calls for."""
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures
+from jepsen_tpu import models as m
+from jepsen_tpu.checkers import brute, reach, wgl_ref
+from jepsen_tpu.history import index, pack
+from jepsen_tpu.op import fail, info, invoke, ok
+
+
+def hist(*ops):
+    return index(list(ops))
+
+
+class TestHandWritten:
+    def test_empty_valid(self):
+        assert reach.check(m.register(), [])["valid"] is True
+
+    def test_sequential_rw_valid(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        assert reach.check(m.register(), h)["valid"] is True
+
+    def test_stale_read_invalid(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(0, "write", 2), ok(0, "write", 2),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        res = reach.check(m.register(), h)
+        assert res["valid"] is False
+        assert res["op"]["f"] == "read"
+        assert res["op"]["value"] == 1
+
+    def test_concurrent_reads_may_split(self):
+        h = hist(
+            invoke(0, "write", 0), ok(0, "write", 0),
+            invoke(0, "write", 1),
+            invoke(1, "read"), ok(1, "read", 0),
+            invoke(2, "read"), ok(2, "read", 1),
+            ok(0, "write", 1),
+        )
+        assert reach.check(m.register(), h)["valid"] is True
+
+    def test_crashed_write_both_branches(self):
+        base = [
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "write", 2), info(1, "write", 2),
+            invoke(0, "read"),
+        ]
+        for seen in (1, 2):
+            h = hist(*base, ok(0, "read", seen))
+            assert reach.check(m.register(), h)["valid"] is True, seen
+
+    def test_crashed_op_cannot_fire_before_invocation(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(2, "read"), ok(2, "read", 2),
+            invoke(1, "write", 2), info(1, "write", 2),
+        )
+        assert reach.check(m.register(), h)["valid"] is False
+
+    def test_failed_op_stripped(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "cas", [5, 6]), fail(1, "cas", [5, 6]),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        assert reach.check(m.cas_register(), h)["valid"] is True
+
+    def test_mutex_double_acquire_invalid(self):
+        h = hist(
+            invoke(0, "acquire"), ok(0, "acquire"),
+            invoke(1, "acquire"), ok(1, "acquire"),
+        )
+        assert reach.check(m.mutex(), h)["valid"] is False
+
+    def test_mutex_handoff_valid(self):
+        h = hist(
+            invoke(0, "acquire"), ok(0, "acquire"),
+            invoke(1, "acquire"),
+            invoke(0, "release"), ok(0, "release"),
+            ok(1, "acquire"),
+        )
+        assert reach.check(m.mutex(), h)["valid"] is True
+
+    def test_all_crashed_valid(self):
+        h = hist(
+            invoke(0, "write", 1), info(0, "write", 1),
+            invoke(1, "write", 2), info(1, "write", 2),
+        )
+        assert reach.check(m.register(), h)["valid"] is True
+
+    def test_overflow_raises(self):
+        h = fixtures.gen_history("cas", n_ops=60, processes=12, seed=0)
+        with pytest.raises((reach.DenseOverflow, Exception)):
+            reach.check(m.cas_register(), h, max_dense=4)
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("kind", ["register", "cas", "mutex"])
+    def test_vs_oracle(self, kind):
+        model = fixtures.model_for(kind)
+        for seed in range(40):
+            h = fixtures.gen_history(kind, n_ops=30, processes=4, seed=seed,
+                                     crash_p=0.1)
+            if kind != "mutex" and seed % 2 == 0:
+                try:
+                    h = fixtures.corrupt(h, seed=seed)
+                except ValueError:
+                    pass
+            want = wgl_ref.check(model, h)["valid"]
+            got = reach.check(model, h)["valid"]
+            assert got == want, (kind, seed, got, want)
+
+    @pytest.mark.parametrize("kind", ["register", "cas", "mutex"])
+    def test_vs_brute_tiny(self, kind):
+        model = fixtures.model_for(kind)
+        for seed in range(60):
+            h = fixtures.gen_history(kind, n_ops=7, processes=3, seed=seed,
+                                     crash_p=0.15)
+            if kind != "mutex" and seed % 2 == 0:
+                try:
+                    h = fixtures.corrupt(h, seed=seed)
+                except ValueError:
+                    pass
+            want = brute.check(model, h)["valid"]
+            got = reach.check(model, h)["valid"]
+            assert got == want, (kind, seed, got, want)
+
+
+class TestBatched:
+    def test_check_many_matches_single(self):
+        model = fixtures.model_for("cas")
+        packs, singles = [], []
+        for seed in range(12):
+            h = fixtures.gen_history("cas", n_ops=25, processes=3, seed=seed)
+            if seed % 3 == 0:
+                h = fixtures.corrupt(h, seed=seed)
+            packs.append(pack(h))
+            singles.append(reach.check(model, h)["valid"])
+        results = reach.check_many(model, packs)
+        assert [r["valid"] for r in results] == singles
+
+    def test_check_many_empty_key(self):
+        model = fixtures.model_for("cas")
+        h = fixtures.gen_history("cas", n_ops=10, processes=2, seed=1)
+        results = reach.check_many(model, [pack([]), pack(h)])
+        assert results[0]["valid"] is True
+        assert results[1]["valid"] is True
+
+
+class TestChunked:
+    @pytest.mark.parametrize("n_chunks", [1, 3, 8])
+    def test_matches_sequential(self, n_chunks):
+        model = fixtures.model_for("cas")
+        for seed in range(4):
+            h = fixtures.gen_history("cas", n_ops=40, processes=4, seed=seed,
+                                     crash_p=0.05)
+            if seed % 2 == 0:
+                h = fixtures.corrupt(h, seed=seed)
+            want = reach.check(model, h)["valid"]
+            got = reach.check_chunked(model, h, n_chunks=n_chunks)["valid"]
+            assert got == want, (seed, n_chunks)
+
+    def test_sharded_over_mesh(self):
+        import jax
+        model = fixtures.model_for("cas")
+        devs = jax.devices()
+        assert len(devs) == 8, "conftest should force 8 virtual devices"
+        for seed in (0, 1):
+            h = fixtures.gen_history("cas", n_ops=60, processes=4, seed=seed)
+            if seed:
+                h = fixtures.corrupt(h, seed=seed)
+            want = reach.check(model, h)["valid"]
+            got = reach.check_chunked(model, h, n_chunks=8,
+                                      devices=devs)["valid"]
+            assert got == want, seed
