@@ -1,0 +1,4 @@
+from jepsen_tpu.cli import main
+import sys
+
+sys.exit(main())

@@ -99,7 +99,7 @@ class LatencyGraph(Checker):
 
     def check(self, test: Optional[Mapping], history: Sequence[Op],
               opts: Optional[Mapping] = None) -> Dict[str, Any]:
-        out_dir = (opts or {}).get("dir") or (test or {}).get("store_dir")
+        out_dir = (opts or {}).get("dir") or (test or {}).get("dir") or (test or {}).get("store_dir")
         if not out_dir:
             return {"valid": True, "skipped": "no store dir"}
         path = os.path.join(out_dir, "latency-raw.png")
@@ -113,7 +113,7 @@ class RateGraph(Checker):
 
     def check(self, test: Optional[Mapping], history: Sequence[Op],
               opts: Optional[Mapping] = None) -> Dict[str, Any]:
-        out_dir = (opts or {}).get("dir") or (test or {}).get("store_dir")
+        out_dir = (opts or {}).get("dir") or (test or {}).get("dir") or (test or {}).get("store_dir")
         if not out_dir:
             return {"valid": True, "skipped": "no store dir"}
         path = os.path.join(out_dir, "rate.png")

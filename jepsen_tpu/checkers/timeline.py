@@ -76,7 +76,7 @@ class TimelineChecker(Checker):
 
     def check(self, test: Optional[Mapping], history: Sequence[Op],
               opts: Optional[Mapping] = None) -> Dict[str, Any]:
-        out_dir = (opts or {}).get("dir") or (test or {}).get("store_dir")
+        out_dir = (opts or {}).get("dir") or (test or {}).get("dir") or (test or {}).get("store_dir")
         doc = render(history, title=str((test or {}).get("name", "timeline")))
         if out_dir:
             import os

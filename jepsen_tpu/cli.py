@@ -1,0 +1,143 @@
+"""Command-line interface — upstream ``jepsen/src/jepsen/cli.clj``
+(SURVEY.md §2.1, L10): ``run`` (execute a test), ``serve`` (results
+browser), plus this framework's ``recheck`` (offline re-analysis of a
+stored history — the checkpoint/resume path of SURVEY.md §5) and
+``bench`` shortcut.
+
+``python -m jepsen_tpu run --suite register --mode sloppy ...``
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence
+
+
+def _add_common(ap: argparse.ArgumentParser) -> None:
+    """The upstream shared option set (``--nodes``, ``--concurrency``,
+    ``--time-limit``, ``--test-count``, ssh opts)."""
+    ap.add_argument("--nodes", default=None,
+                    help="comma-separated node names")
+    ap.add_argument("--nodes-file", default=None)
+    ap.add_argument("--username", default="root")
+    ap.add_argument("--password", default=None)
+    ap.add_argument("--ssh-private-key", default=None)
+    ap.add_argument("--concurrency", type=int, default=5)
+    ap.add_argument("--time-limit", type=float, default=10.0)
+    ap.add_argument("--test-count", type=int, default=1)
+    ap.add_argument("--store-root", default="store")
+    ap.add_argument("--seed", type=int, default=None)
+
+
+def _nodes_from(args) -> Optional[list]:
+    if args.nodes:
+        return args.nodes.split(",")
+    if args.nodes_file:
+        with open(args.nodes_file) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    return None
+
+
+def _cmd_run(args) -> int:
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import register
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    nodes = _nodes_from(args)                 # the fake cluster must be
+    builders: Dict[str, Callable[..., Dict[str, Any]]] = {  # built over them
+        "register": lambda: register.register_test(
+            mode=args.mode, time_limit=args.time_limit,
+            concurrency=args.concurrency, seed=args.seed,
+            with_nemesis=not args.no_nemesis, store=True,
+            algorithm=args.algorithm, nodes=nodes or 5),
+        "register-independent": lambda: register.independent_test(
+            mode=args.mode, concurrency=args.concurrency,
+            seed=args.seed, store=True),
+    }
+    if args.suite not in builders:
+        print(f"unknown suite {args.suite!r}; have {sorted(builders)}",
+              file=sys.stderr)
+        return 2
+    ok = True
+    for i in range(args.test_count):
+        test = builders[args.suite]()
+        test["store-root"] = args.store_root
+        test["ssh"] = {"username": args.username,
+                       "password": args.password,
+                       "private-key-path": args.ssh_private_key}
+        done = core.run(test)
+        valid = done["results"].get("valid")
+        print(json.dumps({"run": i, "name": done["name"], "valid": valid,
+                          "dir": done.get("dir"),
+                          "ops": len(done["history"])}))
+        ok = ok and valid is True
+    return 0 if ok else 1
+
+
+def _cmd_serve(args) -> int:
+    from jepsen_tpu import web
+    web.serve(root=args.store_root, port=args.port)
+    return 0
+
+
+def _cmd_recheck(args) -> int:
+    """Re-analyze a stored history offline — the TPU solver's entry point
+    for existing Jepsen runs (reads our store dirs, bare history.jsonl
+    paths, or upstream EDN histories)."""
+    import os
+
+    from jepsen_tpu import history as h
+    from jepsen_tpu import models
+    from jepsen_tpu.checkers import facade
+
+    if os.path.isdir(args.path):
+        from jepsen_tpu import store
+        history = store.load_history(args.path)
+    elif args.path.endswith(".edn"):
+        history = h.load_edn(args.path)
+    else:
+        history = h.load_jsonl(args.path)
+    model = getattr(models, args.model.replace("-", "_"))()
+    checker = facade.linearizable(model, algorithm=args.algorithm)
+    res = facade.check_safe(checker, {"model": model}, history)
+    print(json.dumps(res, indent=2, default=str))
+    return 0 if res.get("valid") is True else 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jepsen-tpu",
+        description="TPU-native distributed-systems safety testing")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    runp = sub.add_parser("run", help="run a test suite")
+    _add_common(runp)
+    runp.add_argument("--suite", default="register")
+    runp.add_argument("--mode", default="linearizable",
+                      choices=["linearizable", "sloppy"])
+    runp.add_argument("--algorithm", default="auto")
+    runp.add_argument("--no-nemesis", action="store_true")
+    runp.set_defaults(fn=_cmd_run)
+
+    servep = sub.add_parser("serve", help="browse results over HTTP")
+    servep.add_argument("--store-root", default="store")
+    servep.add_argument("--port", type=int, default=8080)
+    servep.set_defaults(fn=_cmd_serve)
+
+    rp = sub.add_parser("recheck",
+                        help="re-analyze a stored history offline")
+    rp.add_argument("path", help="run dir, history.jsonl, or history.edn")
+    rp.add_argument("--model", default="cas-register")
+    rp.add_argument("--algorithm", default="auto")
+    rp.set_defaults(fn=_cmd_recheck)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

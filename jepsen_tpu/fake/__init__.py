@@ -1,0 +1,8 @@
+"""In-process fake distributed systems for E2E testing without SSH or
+docker (SURVEY.md §4 "implication for the rebuild" #4): a deliberately
+configurable replicated KV store with injectable partitions, pauses,
+kills, latency, loss, and clock skew.
+"""
+from jepsen_tpu.fake.cluster import FakeCluster, Unavailable
+
+__all__ = ["FakeCluster", "Unavailable"]

@@ -1,0 +1,4 @@
+"""Per-DB test suites — upstream top-level dirs (``etcd/``, ``zookeeper/``
+…, SURVEY.md §2.5), each a small project wiring client + db + generator +
+checker into a test map. Here: exemplar suites against the in-proc fake
+cluster (and real systems when reachable)."""

@@ -1,0 +1,88 @@
+"""Results browser — upstream ``jepsen/src/jepsen/web.clj``
+(SURVEY.md §2.1, L9): a tiny HTTP server over the store directory listing
+runs and serving their artifacts. stdlib ``http.server``; no http-kit.
+"""
+from __future__ import annotations
+
+import html
+import json
+import os
+import urllib.parse
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from jepsen_tpu import store
+
+
+def _index_html(root: str) -> str:
+    rows = []
+    for name, runs in store.tests(root).items():
+        for run in reversed(runs):
+            valid = ""
+            res_path = os.path.join(run, "results.json")
+            if os.path.exists(res_path):
+                try:
+                    with open(res_path) as f:
+                        valid = str(json.load(f).get("valid"))
+                except Exception:                       # noqa: BLE001
+                    valid = "?"
+            color = {"True": "#6db66d", "False": "#d66"}.get(valid, "#d6a76d")
+            rel = urllib.parse.quote(os.path.relpath(run, root))
+            rows.append(
+                f"<tr><td><a href='/files/{rel}/'>{html.escape(name)}</a>"
+                f"</td><td>{html.escape(os.path.basename(run))}</td>"
+                f"<td style='color:{color}'>{valid}</td></tr>")
+    return ("<!doctype html><title>jepsen-tpu results</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}td,th{padding:4px 12px;"
+            "border-bottom:1px solid #eee;text-align:left}</style>"
+            "<h1>jepsen-tpu results</h1><table>"
+            "<tr><th>test</th><th>run</th><th>valid?</th></tr>"
+            + "".join(rows) + "</table>")
+
+
+class _Handler(SimpleHTTPRequestHandler):
+    store_root = "store"
+
+    def do_GET(self):                                   # noqa: N802
+        if self.path in ("/", "/index.html"):
+            body = _index_html(self.store_root).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path.startswith("/files/"):
+            rel = urllib.parse.unquote(self.path[len("/files/"):])
+            self.path = "/" + rel
+            return SimpleHTTPRequestHandler.do_GET(self)
+        self.send_error(404)
+
+    def translate_path(self, path):
+        path = urllib.parse.urlparse(path).path
+        safe = os.path.normpath(urllib.parse.unquote(path)).lstrip("/")
+        full = os.path.join(os.path.abspath(self.store_root), safe)
+        if not full.startswith(os.path.abspath(self.store_root)):
+            return os.path.abspath(self.store_root)
+        return full
+
+    def log_message(self, *args):                       # quiet
+        pass
+
+
+def serve(root: str = "store", port: int = 8080,
+          block: bool = True) -> Optional[ThreadingHTTPServer]:
+    """Serve the store (upstream ``jepsen.web/serve!`` / CLI ``serve``)."""
+    handler = type("Handler", (_Handler,), {"store_root": root})
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    print(f"jepsen-tpu web: http://localhost:{port}/ (store root {root})")
+    if block:
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return None
+    import threading
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

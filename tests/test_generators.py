@@ -1,0 +1,160 @@
+"""Generator combinator tests — modeled on upstream
+``jepsen/test/jepsen/generator_test.clj`` (SURVEY.md §4): drive generators
+with a fake test map and fake process ids, no cluster."""
+import threading
+
+from jepsen_tpu import generators as g
+
+TEST = {"concurrency": 2}
+
+
+def drain(gen, process=0, test=TEST, cap=10_000):
+    out = []
+    for _ in range(cap):
+        sketch = gen.op(test, process)
+        if sketch is None:
+            return out
+        out.append(sketch)
+    raise AssertionError("generator did not exhaust")
+
+
+def test_once_emits_single_op():
+    gen = g.gen({"f": "read"})
+    assert drain(gen) == [{"f": "read"}]
+
+
+def test_seq_serves_in_order():
+    gen = g.seq({"f": "a"}, {"f": "b"}, {"f": "c"})
+    assert [s["f"] for s in drain(gen)] == ["a", "b", "c"]
+
+
+def test_limit_caps_infinite_generator():
+    gen = g.limit(5, g.Fn(lambda: {"f": "read"}))
+    assert len(drain(gen)) == 5
+
+
+def test_mix_draws_from_all_members():
+    gen = g.limit(200, g.mix(g.Fn(lambda: {"f": "a"}),
+                             g.Fn(lambda: {"f": "b"}), seed=7))
+    fs = {s["f"] for s in drain(gen)}
+    assert fs == {"a", "b"}
+
+
+def test_mix_drops_exhausted_members():
+    gen = g.mix({"f": "once"}, g.limit(3, g.Fn(lambda: {"f": "x"})), seed=1)
+    out = drain(gen)
+    assert sum(1 for s in out if s["f"] == "once") == 1
+    assert sum(1 for s in out if s["f"] == "x") == 3
+
+
+def test_time_limit_expires():
+    import time
+    gen = g.time_limit(0.05, g.Fn(lambda: {"f": "read"}))
+    out = drain(gen, cap=1_000_000)
+    assert out                          # got some ops before expiry
+    assert gen.op(TEST, 0) is None      # stays exhausted
+
+
+def test_repeat_n():
+    assert len(drain(g.Repeat({"f": "r"}, 4))) == 4
+
+
+def test_each_gives_every_process_the_full_sequence():
+    gen = g.each(lambda: g.seq({"f": "a"}, {"f": "b"}))
+    assert [s["f"] for s in drain(gen, process=0)] == ["a", "b"]
+    assert [s["f"] for s in drain(gen, process=1)] == ["a", "b"]
+
+
+def test_on_routes_by_process():
+    gen = g.on(lambda p: p == 1, g.Fn(lambda: {"f": "x"}))
+    assert gen.op(TEST, 0) is None
+    assert gen.op(TEST, 1) == {"f": "x"}
+
+
+def test_nemesis_and_clients_split():
+    gen = g.nemesis_gen(g.Repeat({"f": "start"}, 1),
+                        g.Repeat({"f": "read"}, 2))
+    assert gen.op(TEST, g.NEMESIS) == {"f": "start"}
+    assert gen.op(TEST, g.NEMESIS) is None
+    assert gen.op(TEST, 0) == {"f": "read"}
+
+
+def test_filter_ops():
+    gen = g.filter_ops(lambda s: s["f"] != "w",
+                       g.seq({"f": "r"}, {"f": "w"}, {"f": "r"}))
+    assert [s["f"] for s in drain(gen)] == ["r", "r"]
+
+
+def test_fmap_rewrites():
+    gen = g.fmap(lambda s: {**s, "value": 1}, g.seq({"f": "w", "value": 0}))
+    assert drain(gen) == [{"f": "w", "value": 1}]
+
+
+def test_concat_and_then():
+    gen = g.then(g.seq({"f": "a"}), g.seq({"f": "b"}))
+    assert [s["f"] for s in drain(gen)] == ["a", "b"]
+
+
+def test_cycle_with_factory():
+    gen = g.limit(6, g.cycle(lambda: g.seq({"f": "a"}, {"f": "b"})))
+    assert [s["f"] for s in drain(gen)] == ["a", "b"] * 3
+
+
+def test_stagger_delays_but_passes_through():
+    gen = g.stagger(0.001, g.limit(3, g.Fn(lambda: {"f": "r"})))
+    assert len(drain(gen)) == 3
+
+
+def test_sleep_directive():
+    assert drain(g.sleep(0.5)) == [{"sleep": 0.5}]
+
+
+def test_seq_is_thread_safe():
+    gen = g.Seq([{"f": str(i)} for i in range(500)])
+    seen, lock = [], threading.Lock()
+
+    def worker():
+        while True:
+            s = gen.op(TEST, 0)
+            if s is None:
+                return
+            with lock:
+                seen.append(s["f"])
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sorted(seen, key=int) == [str(i) for i in range(500)]
+
+
+def test_sequential_keys_wraps_values():
+    gen = g.sequential_generator(
+        ["k1", "k2"], lambda k: g.limit(2, g.Fn(lambda: {"f": "w",
+                                                         "value": 9})))
+    out = drain(gen)
+    assert [s["value"] for s in out] == [["k1", 9], ["k1", 9],
+                                         ["k2", 9], ["k2", 9]]
+
+
+def test_concurrent_keys_partitions_processes():
+    gen = g.concurrent_generator(
+        2, ["a", "b", "c", "d"],
+        lambda k: g.limit(1, g.Fn(lambda: {"f": "w", "value": 0})))
+    # group 0 (process 0) serves keys a, c...; group 1 (process 1) b, d
+    v00 = gen.op(TEST, 0)["value"]
+    v10 = gen.op(TEST, 1)["value"]
+    v01 = gen.op(TEST, 2)["value"]          # process 2 → group 0
+    assert v00[0] == "a" and v10[0] == "b" and v01[0] == "c"
+    assert gen.op(TEST, g.NEMESIS) is None
+
+
+def test_synchronize_without_active_set_passes():
+    gen = g.synchronize(g.seq({"f": "a"}))
+    assert gen.op({}, 0) == {"f": "a"}
+
+
+def test_phases_run_in_order():
+    gen = g.phases(g.seq({"f": "a"}), g.seq({"f": "b"}))
+    assert [s["f"] for s in drain(gen, test={})] == ["a", "b"]

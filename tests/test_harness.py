@@ -1,0 +1,298 @@
+"""Harness tests: control sessions, nemeses, fake cluster, the core
+runner end-to-end (generator → client → nemesis → checker → store), and
+the CLI recheck path — the role upstream's docker-cluster integration
+tests play (SURVEY.md §4), with the in-proc fake cluster instead."""
+import json
+import os
+
+import pytest
+
+from jepsen_tpu import control, core, generators as g, models, nemesis, store
+from jepsen_tpu.checkers import facade
+from jepsen_tpu.fake import FakeCluster
+from jepsen_tpu.op import FAIL, INFO, INVOKE, OK, invoke, ok
+from jepsen_tpu.suites import register
+
+
+# -- control ------------------------------------------------------------------
+
+def test_session_exec_and_escape():
+    r = control.FakeRemote(responses={"echo": "hi\n"})
+    s = control.Session(r, "n1")
+    assert s.exec("echo", "a b") == "hi"
+    assert r.commands == [("n1", "echo 'a b'")]
+
+
+def test_session_sudo_and_cd_wrap():
+    r = control.FakeRemote()
+    control.Session(r, "n1").su().cd("/tmp").exec("ls")
+    node, cmd = r.commands[0]
+    assert "sudo" in cmd and "cd /tmp" in cmd and "ls" in cmd
+
+
+def test_session_raises_on_nonzero():
+    r = control.FakeRemote(responses={"bad": (1, "boom")})
+    with pytest.raises(control.RemoteError):
+        control.Session(r, "n1").exec("bad")
+
+
+def test_on_nodes_parallel():
+    r = control.FakeRemote()
+    test = {"nodes": ["n1", "n2", "n3"], "remote": r, "ssh": {}}
+    out = control.on_nodes(test, lambda s, n: s.exec("hostname") or n)
+    assert set(out) == {"n1", "n2", "n3"}
+    assert len(r.commands) == 3
+
+
+def test_local_remote_executes():
+    r = control.LocalRemote()
+    assert control.Session(r, "anywhere").exec("echo", "ok") == "ok"
+
+
+# -- nemesis grudges ----------------------------------------------------------
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def test_bisect_and_complete_grudge():
+    halves = nemesis.bisect(NODES)
+    assert halves == [["n1", "n2"], ["n3", "n4", "n5"]]
+    grudge = nemesis.complete_grudge(halves)
+    assert set(grudge["n1"]) == {"n3", "n4", "n5"}
+    assert set(grudge["n4"]) == {"n1", "n2"}
+
+
+def test_bridge_grudge_keeps_bridge_connected():
+    grudge = nemesis.bridge_grudge(NODES)
+    assert grudge["n3"] == []                      # the bridge hears everyone
+    assert set(grudge["n1"]) == {"n4", "n5"}
+    assert set(grudge["n5"]) == {"n1", "n2"}
+
+
+def test_majorities_ring_every_node_sees_majority():
+    for nodes in (NODES, NODES[:3]):
+        grudge = nemesis.majorities_ring_grudge(nodes)
+        maj = len(nodes) // 2 + 1
+        for node in nodes:
+            visible = len(nodes) - len(grudge[node])
+            assert visible == maj                  # exactly a majority
+            assert grudge[node]                    # nobody sees everyone
+
+
+def test_partitioner_drives_net():
+    cluster = FakeCluster(NODES)
+    test = {"nodes": NODES, "cluster": cluster}
+    nem = nemesis.partition_halves()
+    res = nem.invoke(test, invoke("nemesis", "start"))
+    assert res.type == INFO and cluster.dropped
+    nem.invoke(test, invoke("nemesis", "stop"))
+    assert not cluster.dropped
+
+
+def test_compose_routes_by_f():
+    hits = []
+
+    class N(nemesis.Nemesis):
+        def __init__(self, tag):
+            self.tag = tag
+
+        def invoke(self, test, op):
+            hits.append((self.tag, op.f))
+            return op.with_(type=INFO)
+
+    nem = nemesis.compose({("start", "stop"): N("a"), "scramble": N("b")})
+    nem.invoke({}, invoke("nemesis", "start"))
+    nem.invoke({}, invoke("nemesis", "scramble"))
+    assert hits == [("a", "start"), ("b", "scramble")]
+
+
+# -- fake cluster -------------------------------------------------------------
+
+def test_linearizable_cluster_requires_quorum():
+    c = FakeCluster(NODES, mode="linearizable")
+    c.write("n1", "k", 1)
+    assert c.read("n3", "k") == 1
+    # isolate n1 completely
+    for other in NODES[1:]:
+        c.drop_link("n1", other)
+        c.drop_link(other, "n1")
+    from jepsen_tpu.fake import Unavailable
+    with pytest.raises(Unavailable):
+        c.read("n1", "k")
+    assert c.read("n2", "k") == 1                  # majority side still up
+    c.heal()
+    assert c.read("n1", "k") == 1
+
+
+def test_sloppy_cluster_serves_stale_reads():
+    c = FakeCluster(NODES, mode="sloppy")
+    c.write("n1", "k", 0)
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            c.drop_link(a, b)
+            c.drop_link(b, a)
+    c.write("n1", "k", 1)                          # only n1, n2 see this
+    assert c.read("n3", "k") == 0                  # stale!
+    c.heal()
+
+
+def test_cas_semantics():
+    c = FakeCluster(NODES)
+    c.write("n1", "k", 2)
+    assert c.cas("n2", "k", 2, 3) is True
+    assert c.cas("n2", "k", 2, 4) is False
+    assert c.read("n1", "k") == 3
+
+
+def test_kill_and_pause():
+    c = FakeCluster(NODES)
+    from jepsen_tpu.fake import Unavailable
+    from jepsen_tpu.fake.cluster import FakeTimeout
+    c.kill_node("n1")
+    with pytest.raises(Unavailable):
+        c.read("n1", "k")
+    c.start_node("n1")
+    c.read("n1", "k")
+    c.pause_node("n2")
+    with pytest.raises(FakeTimeout):
+        c.read("n2", "k")
+    c.resume_node("n2")
+    c.read("n2", "k")
+
+
+def test_deterministic_stale_read_is_nonlinearizable():
+    """The cluster + checker integration, deterministically: a write that
+    replicates only to one side of a partition, then a stale read, must be
+    flagged by the linearizability checker."""
+    c = FakeCluster(NODES, mode="sloppy")
+    history = []
+    history.append(invoke(0, "write", 0))
+    c.write("n1", "r", 0)
+    history.append(ok(0, "write", 0))
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            c.drop_link(a, b)
+            c.drop_link(b, a)
+    history.append(invoke(0, "write", 1))
+    c.write("n1", "r", 1)
+    history.append(ok(0, "write", 1))
+    history.append(invoke(0, "read", None))
+    v = c.read("n3", "r")
+    history.append(ok(0, "read", v))
+    assert v == 0
+    res = facade.linearizable(models.register()).check(None, history)
+    assert res["valid"] is False
+
+
+# -- core runner E2E ----------------------------------------------------------
+
+def test_noop_test_runs(tmp_path):
+    from jepsen_tpu.tests_base import noop_test
+    t = noop_test()
+    t["store-root"] = str(tmp_path)
+    t["generator"] = g.limit(3, g.Fn(lambda: {"f": "ping"}))
+    done = core.run(t)
+    assert done["results"]["valid"] is True
+    assert len(done["history"]) == 6               # 3 invokes + 3 oks
+    assert os.path.exists(os.path.join(done["dir"], "history.jsonl"))
+
+
+def test_register_linearizable_run_is_valid():
+    t = register.register_test(mode="linearizable", time_limit=1.0,
+                               seed=3, with_nemesis=True,
+                               nemesis_interval=0.3, store=False)
+    done = core.run(t)
+    assert done["results"]["results"]["linear"]["valid"] is True
+    assert done["results"]["results"]["stats"]["by-f"]
+    history = done["history"]
+    assert any(op.process == "nemesis" for op in history)
+    assert any(op.type == FAIL for op in history)  # quorum-loss fails
+
+
+def test_register_sloppy_run_finds_violation():
+    t = register.register_test(mode="sloppy", time_limit=1.5, seed=11,
+                               with_nemesis=True, nemesis_interval=0.25,
+                               store=False, concurrency=5)
+    done = core.run(t)
+    assert done["results"]["results"]["linear"]["valid"] is False
+
+
+def test_independent_register_run():
+    t = register.independent_test(mode="linearizable", keys=4,
+                                  ops_per_key=20, concurrency=4, seed=5)
+    done = core.run(t)
+    res = done["results"]
+    assert res["valid"] is True
+    assert res["key-count"] == 4
+
+
+def test_worker_crash_bumps_process():
+    """An info completion must kill the logical process; its successor is
+    process + concurrency, and the crashed op stays forever-pending."""
+    class Flaky(register.KVClient):
+        calls = 0
+
+        def invoke(self, test, op):
+            type(self).calls += 1
+            if type(self).calls == 2:
+                raise RuntimeError("connection torn")
+            return super().invoke(test, op)
+
+    t = register.register_test(mode="linearizable", seed=0,
+                               with_nemesis=False, store=False,
+                               concurrency=2)
+    t["client"] = Flaky("r")
+    t["generator"] = g.limit(6, g.Fn(lambda: {"f": "read", "value": None}))
+    done = core.run(t)
+    infos = [op for op in done["history"] if op.type == INFO]
+    assert len(infos) == 1
+    crashed_p = infos[0].process
+    assert any(op.process == crashed_p + 2 for op in done["history"])
+
+
+# -- store + recheck ----------------------------------------------------------
+
+def test_store_roundtrip_and_recheck(tmp_path):
+    t = register.register_test(mode="linearizable", time_limit=0.5,
+                               seed=3, with_nemesis=False, store=True)
+    t["store-root"] = str(tmp_path)
+    done = core.run(t)
+    d = done["dir"]
+    for f in ("test.json", "results.json", "results.edn", "history.jsonl",
+              "history.edn", "history.txt"):
+        assert os.path.exists(os.path.join(d, f)), f
+    # offline re-analysis agrees (the upstream "re-run a checker on a
+    # stored history" path)
+    hist = store.load_history(d)
+    assert len(hist) == len(done["history"])
+    res = facade.linearizable(models.cas_register()).check(None, hist)
+    assert res["valid"] is True
+    # EDN export is readable too
+    from jepsen_tpu import history as h
+    edn_hist = h.load_edn(os.path.join(d, "history.edn"))
+    assert len(edn_hist) == len(hist)
+    # store listing + latest symlink
+    assert store.tests(str(tmp_path))
+    assert store.latest(str(tmp_path)) == os.path.realpath(d)
+
+
+def test_cli_recheck(tmp_path, capsys):
+    from jepsen_tpu import cli
+    t = register.register_test(mode="linearizable", time_limit=0.4,
+                               seed=9, with_nemesis=False, store=True)
+    t["store-root"] = str(tmp_path)
+    done = core.run(t)
+    rc = cli.main(["recheck", done["dir"], "--model", "cas-register"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["valid"] is True
+
+
+def test_timeline_and_perf_artifacts(tmp_path):
+    t = register.register_test(mode="linearizable", time_limit=0.4,
+                               seed=2, with_nemesis=False, store=True)
+    t["store-root"] = str(tmp_path)
+    done = core.run(t)
+    files = os.listdir(done["dir"])
+    assert "timeline.html" in files
+    assert any(f.endswith(".png") for f in files)
