@@ -24,7 +24,7 @@ def main() -> int:
     ap.add_argument("--processes", type=int, default=5)
     ap.add_argument("--repeat", type=int, default=3)
     ap.add_argument("--engine", default="reach",
-                    choices=["reach", "chunked", "wgl-cpu"])
+                    choices=["reach", "chunked", "wgl-cpu", "wgl-native"])
     ap.add_argument("--seed", type=int, default=42)
     args = ap.parse_args()
 
@@ -44,6 +44,9 @@ def main() -> int:
             return reach.check_packed(model, packed)
         if args.engine == "chunked":
             return reach.check_chunked(model, packed=packed)
+        if args.engine == "wgl-native":
+            from jepsen_tpu.checkers import wgl_native
+            return wgl_native.check_packed(model, packed)
         return wgl_ref.check_packed(model, packed, time_limit=300)
 
     # warm-up: first call pays jit compilation; the measurement is steady

@@ -143,24 +143,72 @@ def pad(stream: EventStream, E: int, W: Optional[int] = None) -> EventStream:
         n_dropped_crashed=stream.n_dropped_crashed)
 
 
-def chunk_slot_maps(stream: EventStream, n_ops: int,
-                    boundaries: np.ndarray) -> np.ndarray:
-    """For chunked (history-parallel) checking: the ``slot -> op id`` map in
-    force at the start of each chunk (i32[n_chunks, W]; -1 = free slot).
-    ``boundaries[c]`` is the first event index of chunk ``c``."""
-    W = stream.W
-    maps = np.full((len(boundaries), max(W, 1)), -1, np.int32)
-    cur = np.full(max(W, 1), -1, np.int32)
-    b = 0
-    for e in range(stream.E):
-        while b < len(boundaries) and boundaries[b] == e:
-            maps[b] = cur
-            b += 1
-        if stream.kind[e] == KIND_INVOKE:
+@dataclass(frozen=True)
+class ReturnStream:
+    """Returns-only view of an :class:`EventStream` for the fast device
+    walk (:func:`jepsen_tpu.checkers.reach._walk_returns`).
+
+    Invoke events never change the reachable set — they only update the
+    slot→op map, which is statically known — so the device loop need only
+    execute return events: for return ``r``, ``slot_ops[r]`` is the full
+    pending map (including the returning op) and ``ret_slot[r]`` the slot
+    being returned/freed. ``ret_slot = -1`` marks padding (identity).
+    ``ret_event[r]`` / ``ret_entry[r]`` map back to the original event
+    index / analysis entry for failure reporting.
+    """
+    ret_slot: np.ndarray    # i32[R]
+    slot_ops: np.ndarray    # i32[R, W]
+    ret_event: np.ndarray   # i32[R]
+    ret_entry: np.ndarray   # i32[R]
+    W: int
+    n_returns: int
+
+    @property
+    def R(self) -> int:
+        return len(self.ret_slot)
+
+
+def returns_view(stream: EventStream) -> ReturnStream:
+    """Project an event stream to its return events with per-return
+    pending-op snapshots."""
+    W = max(stream.W, 1)
+    n_ret = int(np.sum(stream.kind[:stream.n_events] == KIND_RETURN))
+    ret_slot = np.full(n_ret, -1, np.int32)
+    slot_ops = np.full((n_ret, W), -1, np.int32)
+    ret_event = np.zeros(n_ret, np.int32)
+    ret_entry = np.zeros(n_ret, np.int32)
+    cur = np.full(W, -1, np.int32)
+    r = 0
+    for e in range(stream.n_events):
+        k = stream.kind[e]
+        if k == KIND_INVOKE:
             cur[stream.slot[e]] = stream.opid[e]
-        elif stream.kind[e] == KIND_RETURN:
-            cur[stream.slot[e]] = -1
-    while b < len(boundaries):
-        maps[b] = cur
-        b += 1
-    return maps
+        elif k == KIND_RETURN:
+            s = stream.slot[e]
+            slot_ops[r] = cur
+            ret_slot[r] = s
+            ret_event[r] = e
+            ret_entry[r] = stream.entry[e]
+            cur[s] = -1
+            r += 1
+    return ReturnStream(ret_slot=ret_slot, slot_ops=slot_ops,
+                        ret_event=ret_event, ret_entry=ret_entry,
+                        W=W, n_returns=n_ret)
+
+
+def pad_returns(rs: ReturnStream, R: int, W: Optional[int] = None
+                ) -> ReturnStream:
+    """Pad to ``R`` returns (identity rows) / widen to ``W`` slots."""
+    W = rs.W if W is None else W
+    if W < rs.W or R < rs.n_returns:
+        raise ValueError("cannot shrink a return stream")
+    ext = R - rs.R
+    wext = W - rs.slot_ops.shape[1]
+    slot_ops = np.pad(rs.slot_ops, ((0, ext), (0, wext)),
+                      constant_values=-1)
+    return ReturnStream(
+        ret_slot=np.pad(rs.ret_slot, (0, ext), constant_values=-1),
+        slot_ops=slot_ops,
+        ret_event=np.pad(rs.ret_event, (0, ext)),
+        ret_entry=np.pad(rs.ret_entry, (0, ext)),
+        W=W, n_returns=rs.n_returns)

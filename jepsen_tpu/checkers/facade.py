@@ -67,9 +67,12 @@ class Linearizable(Checker):
       space (state explosion / too many concurrent pending ops).
     - ``"reach"`` / ``"reach-chunked"`` — device engine, sequential or
       history-parallel (:mod:`jepsen_tpu.checkers.reach`).
-    - ``"wgl-cpu"`` — the CPU oracle (:mod:`jepsen_tpu.checkers.wgl_ref`).
-    - ``"competition"`` — device engine raced against the CPU search on a
-      thread, first verdict wins (upstream ``knossos.competition``).
+    - ``"wgl-native"`` — the C++ WGL search
+      (:mod:`jepsen_tpu.checkers.wgl_native`).
+    - ``"wgl-cpu"`` — the Python oracle (:mod:`jepsen_tpu.checkers.wgl_ref`).
+    - ``"competition"`` — device engine raced against the native (or
+      Python) CPU search on a thread, first definitive verdict wins and
+      the loser is aborted (upstream ``knossos.competition``).
     """
     model: Optional[Model] = None
     algorithm: str = "auto"
@@ -77,7 +80,24 @@ class Linearizable(Checker):
     name = "linearizable"
 
     def check(self, test, history, opts=None):
-        from jepsen_tpu.checkers import reach, wgl_ref
+        res = self._check_impl(test, history, opts)
+        out_dir = (test or {}).get("dir") if hasattr(test, "get") else None
+        if res.get("valid") is False and res.get("op") and out_dir:
+            # render the upstream-style SVG of the failing window
+            # (knossos.linear.report) next to the run's other artifacts
+            import os
+
+            from jepsen_tpu.checkers import linear_report
+            try:
+                path = os.path.join(out_dir, "linear.svg")
+                linear_report.render_analysis(history, res, path)
+                res["report-file"] = path
+            except Exception:                           # noqa: BLE001
+                pass                    # reporting must never mask a verdict
+        return res
+
+    def _check_impl(self, test, history, opts=None):
+        from jepsen_tpu.checkers import reach, wgl_native, wgl_ref
         from jepsen_tpu.checkers.events import ConcurrencyOverflow
         from jepsen_tpu.models.memo import StateExplosion
 
@@ -91,6 +111,9 @@ class Linearizable(Checker):
         if algorithm == "reach-chunked":
             return reach.check_chunked(model, history,
                                        **_engine_kw(kw, _CHUNKED_KW))
+        if algorithm == "wgl-native":
+            return wgl_native.check(model, history,
+                                    **_engine_kw(kw, _NATIVE_KW))
         if algorithm == "wgl-cpu":
             return wgl_ref.check(model, history, **_engine_kw(kw, _WGL_KW))
         if algorithm == "auto":
@@ -99,10 +122,18 @@ class Linearizable(Checker):
                                    **_engine_kw(kw, _REACH_KW))
             except (reach.DenseOverflow, ConcurrencyOverflow,
                     StateExplosion):
-                res = wgl_ref.check(model, history,
-                                    **_engine_kw(kw, _WGL_KW))
-                res["engine"] = "wgl-cpu-fallback"
-                return res
+                pass
+            if wgl_native.available():
+                try:
+                    res = wgl_native.check(model, history,
+                                           **_engine_kw(kw, _NATIVE_KW))
+                    res["engine"] = "wgl-native-fallback"
+                    return res
+                except StateExplosion:
+                    pass            # un-memoizable model: lazy Python path
+            res = wgl_ref.check(model, history, **_engine_kw(kw, _WGL_KW))
+            res["engine"] = "wgl-cpu-fallback"
+            return res
         if algorithm == "competition":
             return _competition(model, history, kw)
         raise ValueError(f"unknown algorithm {algorithm!r}")
@@ -113,6 +144,7 @@ class Linearizable(Checker):
 _REACH_KW = ("max_states", "max_slots", "max_dense")
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
 _WGL_KW = ("time_limit", "max_configs", "strategy", "should_abort")
+_NATIVE_KW = ("time_limit", "max_configs", "max_states", "abort_flag")
 
 
 def _engine_kw(kw: Mapping, allowed: Sequence[str]) -> Dict[str, Any]:
@@ -121,21 +153,33 @@ def _engine_kw(kw: Mapping, allowed: Sequence[str]) -> Dict[str, Any]:
 
 def _competition(model: Model, history: Sequence[Op],
                  kw: Dict[str, Any]) -> Dict[str, Any]:
-    """Race the device engine against the CPU search on threads; the first
-    definitive verdict wins and the CPU search is aborted (upstream
+    """Race the device engine against the CPU search (native C++ when
+    built, else the Python oracle) on threads; the first definitive
+    verdict wins and the CPU search is aborted (upstream
     ``knossos.competition/analysis``). If one engine errors, the other's
     verdict is used."""
     import queue
 
-    from jepsen_tpu.checkers import reach, wgl_ref
+    from jepsen_tpu.checkers import reach, wgl_native, wgl_ref
+    from jepsen_tpu.checkers.search import SearchControl
 
-    done = threading.Event()
+    ctl = SearchControl(time_limit=kw.get("time_limit")).start()
+    native_abort = (ctl.bind_native(wgl_native.AbortFlag())
+                    if wgl_native.available() else None)
     verdicts: "queue.Queue" = queue.Queue()
 
     def run_cpu():
         try:
-            r = wgl_ref.check(model, history, should_abort=done.is_set,
-                              **_engine_kw(kw, _WGL_KW))
+            if native_abort is not None:
+                r = wgl_native.check(model, history,
+                                     abort_flag=native_abort,
+                                     **_engine_kw(kw, ("max_configs",
+                                                       "max_states")))
+                verdicts.put(("wgl-native", r))
+                return
+            r = wgl_ref.check(model, history,
+                              should_abort=ctl.should_abort,
+                              **_engine_kw(kw, ("max_configs", "strategy")))
             verdicts.put(("wgl-cpu", r))
         except Exception as e:                          # noqa: BLE001
             verdicts.put(("wgl-cpu", {"valid": "unknown",
@@ -160,7 +204,8 @@ def _competition(model: Model, history: Sequence[Op],
             winner["winner"] = name
             break
         winner = winner or r                 # keep an unknown as last resort
-    done.set()                               # abort the losing CPU search
+    ctl.abort()                              # stop the losing CPU search
+    ctl.close()
     return winner or {"valid": "unknown"}
 
 

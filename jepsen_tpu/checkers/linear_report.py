@@ -1,0 +1,107 @@
+"""SVG rendering of a non-linearizable window — upstream
+``knossos/src/knossos/linear/report.clj`` (SURVEY.md §2.2): the famous
+timeline diagrams Jepsen analyses embed, showing each process's op bars
+around the operation that could not be linearized.
+
+Independent implementation: plain SVG text, no dependencies. The rendered
+window spans every op whose interval overlaps the failing op's invocation
+(the ops the search could still reorder at the point of death), so a
+reader can trace why no linearization order exists.
+"""
+from __future__ import annotations
+
+import html
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from jepsen_tpu import history as h
+from jepsen_tpu.op import INFO, OK, Op
+
+_LANE_H = 34
+_BAR_H = 22
+_LEFT = 110
+_WIDTH = 900
+_COLORS = {OK: "#7fb77f", INFO: "#d6a76d", "stuck": "#d66a6a",
+           "other": "#9db4c9"}
+
+
+def _fmt(op: Op) -> str:
+    v = op.value
+    return f"{op.f} {v!r}" if v is not None else f"{op.f}"
+
+
+def render_analysis(history: Sequence[Op], result: Mapping[str, Any],
+                    path: Optional[str] = None) -> str:
+    """Render the failing window of ``result`` (a ``{"valid": False, "op":
+    ...}`` verdict from any linearizability engine) over ``history``.
+    Returns the SVG text; writes it to ``path`` when given."""
+    if result.get("valid") is not False or not result.get("op"):
+        raise ValueError("result is not a non-linearizable verdict with op")
+    entries = h.analysis_entries(history)
+    stuck_idx = result["op"].get("index")
+    stuck = next((e for e in entries if e.op.index == stuck_idx), None)
+    if stuck is None:                       # fall back: match on content
+        key = (result["op"].get("process"), result["op"].get("f"))
+        stuck = next((e for e in entries
+                      if (e.op.process, e.op.f) == key), entries[0])
+    # window: entries overlapping the stuck op's interval
+    lo, hi = stuck.inv_ev, stuck.ret_ev
+    window = [e for e in entries
+              if e.inv_ev <= hi and e.ret_ev >= lo]
+    if not window:
+        window = [stuck]
+    t0 = min(e.inv_ev for e in window)
+    t1 = max(min(e.ret_ev, hi + 2) for e in window) + 1
+    span = max(1, t1 - t0)
+    procs = sorted({e.process for e in window}, key=repr)
+    rows = {p: i for i, p in enumerate(procs)}
+    height = _LANE_H * len(procs) + 70
+
+    def x(ev: int) -> float:
+        return _LEFT + (min(ev, t1) - t0) / span * (_WIDTH - _LEFT - 20)
+
+    parts: List[str] = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{_WIDTH}" '
+        f'height="{height}" font-family="sans-serif" font-size="12">',
+        f'<text x="{_LEFT}" y="18" font-size="14" fill="#333">'
+        f'Non-linearizable: {html.escape(_fmt(stuck.op))} '
+        f'(process {html.escape(str(stuck.process))}) cannot be '
+        f'linearized</text>']
+    for p in procs:
+        y = 40 + rows[p] * _LANE_H
+        parts.append(f'<text x="8" y="{y + _BAR_H - 6}" fill="#555">'
+                     f'process {html.escape(str(p))}</text>')
+        parts.append(f'<line x1="{_LEFT}" y1="{y + _LANE_H - 4}" '
+                     f'x2="{_WIDTH - 10}" y2="{y + _LANE_H - 4}" '
+                     f'stroke="#eee"/>')
+    for e in window:
+        y = 40 + rows[e.process] * _LANE_H
+        x0 = x(e.inv_ev)
+        x1 = x(e.ret_ev if e.ret_ev <= t1 else t1)
+        wdt = max(6.0, x1 - x0)
+        if e is stuck:
+            color = _COLORS["stuck"]
+        elif e.crashed:
+            color = _COLORS[INFO]
+        else:
+            color = _COLORS[OK]
+        label = html.escape(_fmt(e.op))
+        parts.append(
+            f'<rect x="{x0:.1f}" y="{y}" width="{wdt:.1f}" '
+            f'height="{_BAR_H}" rx="3" fill="{color}">'
+            f'<title>{label}</title></rect>')
+        parts.append(f'<text x="{x0 + 3:.1f}" y="{y + _BAR_H - 7}" '
+                     f'fill="#fff">{label}</text>')
+        if e.crashed:
+            parts.append(f'<text x="{x1 + 2:.1f}" y="{y + _BAR_H - 7}" '
+                         f'fill="#999">&#8230;</text>')
+    parts.append(
+        f'<text x="{_LEFT}" y="{height - 12}" fill="#888">window events '
+        f'{t0}&#8211;{t1}; green = completed, orange = crashed '
+        f'(forever pending), red = the operation the search got stuck '
+        f'on</text>')
+    parts.append("</svg>")
+    svg = "\n".join(parts)
+    if path:
+        with open(path, "w") as f:
+            f.write(svg)
+    return svg

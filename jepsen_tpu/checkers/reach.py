@@ -158,6 +158,176 @@ def _walk(T, kind, slot, opid, R0, slot_op0):
     return ptr, R, alive
 
 
+# -- fast path: returns-only walk with matrix transitions --------------------
+#
+# Invoke events never change the reachable set — they only update the
+# slot→op map, which is statically known host-side — so the device loop
+# executes RETURN events only (half the iterations), with the pending map
+# gathered per return from a precomputed array. Firing is expressed as a
+# contraction against per-op boolean transition matrices P[o][s, s'] =
+# (T[s, o] == s') instead of scatters: Rx gathers the bit-clear half of
+# every slot's mask axis at once (a static XOR column permutation), one
+# einsum applies all W slot transitions, and a static upper bound of W
+# fire passes replaces the dynamic fixpoint (at most W pending ops can
+# linearize between returns, and passes are monotone).
+
+def _ret_step(P, xor_cols, bitmask, R, j, ops_row):
+    """One return event: W static fire passes (at most W pending ops can
+    linearize between returns; passes are monotone so W passes reach the
+    fixpoint), then projection on the returning slot. ``j < 0`` =
+    padding (identity)."""
+    import jax.numpy as jnp
+
+    W, M = xor_cols.shape
+    n_ops_pad = P.shape[0] - 1
+    G = P[jnp.where(ops_row < 0, n_ops_pad, ops_row)]       # [W, S, S]
+    for _ in range(W):
+        Rx = R[:, xor_cols]                                 # [S, W, M]
+        contrib = jnp.einsum("sjm,jst->tjm", Rx.astype(jnp.float32), G)
+        add = ((contrib > 0.5) & bitmask[None]).any(axis=1)
+        R = R | add
+    jj = jnp.maximum(j, 0)
+    idx = jnp.arange(M)
+    bit = jnp.int32(1) << jj
+    src = idx | bit
+    clear = (idx & bit) == 0
+    Rp = jnp.where(clear[None, :], R[:, src], False)
+    return jnp.where(j >= 0, Rp, R)
+
+
+def _walk_returns(P, xor_cols, bitmask, ret_slot, slot_ops, R0,
+                  unroll: int = 8):
+    """Drive return events over the dense config set. ``P`` f32[O+1,S,S]
+    (row O = sentinel, all-zero); ``xor_cols`` i32[W,M] = m^(1<<j);
+    ``bitmask`` bool[W,M] = bit j set in m. Processes ``unroll`` returns
+    per loop iteration to amortize while-loop overhead (callers pad Rn to
+    a multiple). Returns ``(ptr, R, alive)``: when dead, the set emptied
+    at some return in ``[ptr-unroll, ptr)``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    Rn = ret_slot.shape[0]
+
+    def cond(c):
+        i, R, alive, _ = c
+        return (i < Rn) & alive
+
+    def body(c):
+        i, R, _, _ = c
+        R_block = R                     # carried so callers can refine the
+        for k in range(unroll):         # exact dead return within a block
+            R = _ret_step(P, xor_cols, bitmask, R,
+                          ret_slot[i + k], slot_ops[i + k])
+        return i + unroll, R, jnp.any(R), R_block
+
+    init = (jnp.int32(0), R0, jnp.any(R0), R0)
+    ptr, R, alive, R_block = lax.while_loop(cond, body, init)
+    return ptr, R, alive, R_block
+
+
+def _walk_returns_scan(P, xor_cols, bitmask, ret_slot, slot_ops, R0):
+    """Scan variant (no early exit) for the basis-batched chunk walk —
+    returns only the final R."""
+    from jax import lax
+
+    def step(R, inp):
+        j, ops_row = inp
+        return _ret_step(P, xor_cols, bitmask, R, j, ops_row), None
+
+    R, _ = lax.scan(step, R0, (ret_slot, slot_ops))
+    return R
+
+
+def _build_P(memo: Memo, S_pad: int, O_pad: Optional[int] = None
+             ) -> np.ndarray:
+    """Per-op transition matrices P[o][s, s'] = (table[s, o] == s'), f32,
+    with an all-zero sentinel row at index O_pad."""
+    O = memo.n_ops if O_pad is None else O_pad
+    P = np.zeros((O + 1, S_pad, S_pad), np.float32)
+    s = np.arange(memo.n_states)
+    for o in range(memo.n_ops):
+        col = memo.table[:, o]
+        ok = col >= 0
+        P[o, s[ok], col[ok]] = 1.0
+    return P
+
+
+def _xor_bitmask(W: int, M: int):
+    j = np.arange(W)[:, None]
+    m = np.arange(M)[None, :]
+    return ((m ^ (1 << j)).astype(np.int32),
+            ((m >> j) & 1).astype(bool))
+
+
+_UNROLL = 8
+
+
+@functools.cache
+def _jitted_walk_returns():
+    import jax
+    return jax.jit(functools.partial(_walk_returns, unroll=_UNROLL))
+
+
+@functools.cache
+def _jitted_walk_returns_u1():
+    import jax
+    return jax.jit(functools.partial(_walk_returns, unroll=1))
+
+
+@functools.cache
+def _jitted_walk_returns_batch():
+    """vmap over keys: per-key P, return streams, and config sets."""
+    import jax
+    return jax.jit(jax.vmap(
+        functools.partial(_walk_returns, unroll=_UNROLL),
+        in_axes=(0, None, None, 0, 0, 0)))
+
+
+def _refine_dead(P, xor_cols, bitmask, rs: "ev.ReturnStream",
+                 ptr: int, R_block) -> int:
+    """Exact dead return index: the unrolled walk died somewhere in
+    ``[ptr-unroll, ptr)``; re-walk that block one return at a time from
+    the carried block-start config set."""
+    import jax.numpy as jnp
+
+    W = xor_cols.shape[0]
+    start = max(0, int(ptr) - _UNROLL)
+    tail_slot = np.full(_UNROLL, -1, np.int32)
+    tail_ops = np.full((_UNROLL, W), -1, np.int32)
+    seg = slice(start, min(int(ptr), rs.R))
+    n_seg = seg.stop - seg.start
+    tail_slot[:n_seg] = rs.ret_slot[seg]
+    tail_ops[:n_seg] = rs.slot_ops[seg]
+    ptr1, _, alive, _ = _jitted_walk_returns_u1()(
+        P, xor_cols, bitmask, jnp.asarray(tail_slot),
+        jnp.asarray(tail_ops), R_block)
+    if bool(alive):                     # shouldn't happen; be conservative
+        return int(rs.ret_event[min(int(ptr), rs.n_returns) - 1])
+    return int(rs.ret_event[start + int(ptr1) - 1])
+
+
+@functools.cache
+def _jitted_basis_returns():
+    """vmap over (chunk, basis-config) for history-length parallelism."""
+    import jax
+    inner = jax.vmap(_walk_returns_scan,
+                     in_axes=(None, None, None, None, None, 0))
+    outer = jax.vmap(inner, in_axes=(None, None, None, 0, 0, 0))
+    return jax.jit(outer)
+
+
+# fast path applies while the fire-pass intermediate [S, W, M] AND the
+# per-op transition-matrix tensor [O+1, S, S] stay small; state-rich /
+# op-rich histories keep the event walk (gather through the flat table)
+_FAST_MAX_ELEMS = 1 << 22
+_FAST_MAX_P = 1 << 24
+
+
+def _fast_ok(S_pad: int, W: int, M: int, n_ops: int) -> bool:
+    return (S_pad * max(W, 1) * M <= _FAST_MAX_ELEMS
+            and (n_ops + 1) * S_pad * S_pad <= _FAST_MAX_P)
+
+
 @functools.cache
 def _jitted_walk():
     import jax
@@ -170,18 +340,6 @@ def _jitted_walk_batch():
     tables, event streams, and config sets)."""
     import jax
     return jax.jit(jax.vmap(_walk))
-
-
-@functools.cache
-def _jitted_basis_walk():
-    """vmap over (chunk, basis-config): computes per-chunk boolean transfer
-    matrices for history-length parallelism."""
-    import jax
-    # inner vmap: basis axis on R0 only; outer vmap: chunk axis on events,
-    # initial slot maps, and the basis block.
-    inner = jax.vmap(_walk, in_axes=(None, None, None, None, 0, None))
-    outer = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0, 0))
-    return jax.jit(outer)
 
 
 # -- host orchestration ------------------------------------------------------
@@ -265,8 +423,25 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     memo, stream, T, S_pad, M = _prep(
         model, packed, max_states=max_states, max_slots=max_slots,
         max_dense=max_dense)
+    W = max(stream.W, 1)
+    if _fast_ok(S_pad, W, M, memo.n_ops):
+        rs = ev.returns_view(stream)
+        rs = ev.pad_returns(rs, max(64, _next_pow2(rs.n_returns)))
+        P = jnp.asarray(_build_P(memo, S_pad))
+        xc, bm = _xor_bitmask(W, M)
+        xc, bm = jnp.asarray(xc), jnp.asarray(bm)
+        R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
+        ptr, _, alive, R_block = _jitted_walk_returns()(
+            P, xc, bm, jnp.asarray(rs.ret_slot),
+            jnp.asarray(rs.slot_ops), R0)
+        elapsed = _time.monotonic() - t0
+        if bool(alive):
+            return _result_valid("reach", stream, memo, elapsed)
+        dead_event = _refine_dead(P, xc, bm, rs, int(ptr), R_block)
+        return _result_invalid("reach", stream, memo, packed, dead_event,
+                               elapsed)
     R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
-    slot_op0 = jnp.full((max(stream.W, 1),), -1, jnp.int32)
+    slot_op0 = jnp.full((W,), -1, jnp.int32)
     ptr, _, alive = _jitted_walk()(
         jnp.asarray(T), jnp.asarray(stream.kind), jnp.asarray(stream.slot),
         jnp.asarray(stream.opid), R0, slot_op0)
@@ -301,7 +476,7 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
         for p in preps]
     if live:
         S_pad = max(p[3] for i, p in enumerate(preps) if p is not None)
-        W = max(preps[i][1].W for i in live)
+        W = max(max(preps[i][1].W, 1) for i in live)
         M = 1 << W
         if S_pad * M > max_dense:
             # padding every key to the common (S_pad, W) can overflow even
@@ -309,9 +484,44 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
             raise DenseOverflow(
                 f"batched dense config space {S_pad}x{M} exceeds budget "
                 f"{max_dense}")
+        O_pad = max(preps[i][0].n_ops for i in live)
+        fast = _fast_ok(S_pad, W, M, O_pad)
+        if fast:
+            rss = [ev.returns_view(preps[i][1]) for i in live]
+            R_pad = max(64, _next_pow2(max(r.n_returns for r in rss)))
+            rss = [ev.pad_returns(r, R_pad, W) for r in rss]
+            xor_cols, bitmask = _xor_bitmask(W, M)
+            Ps, R0s = [], []
+            for i in live:
+                Ps.append(_build_P(preps[i][0], S_pad, O_pad))
+                R0 = np.zeros((S_pad, M), bool)
+                R0[0, 0] = True
+                R0s.append(R0)
+            xc, bm = jnp.asarray(xor_cols), jnp.asarray(bitmask)
+            Ps_dev = jnp.asarray(np.stack(Ps))
+            ptrs, _, alives, R_blocks = _jitted_walk_returns_batch()(
+                Ps_dev, xc, bm,
+                jnp.asarray(np.stack([r.ret_slot for r in rss])),
+                jnp.asarray(np.stack([r.slot_ops for r in rss])),
+                jnp.asarray(np.stack(R0s)))
+            elapsed = _time.monotonic() - t0
+            ptrs = np.asarray(ptrs)
+            alives = np.asarray(alives)
+            for k, i in enumerate(live):
+                memo, stream = preps[i][0], preps[i][1]
+                if bool(alives[k]):
+                    results[i] = _result_valid("reach-batch", stream, memo,
+                                               elapsed)
+                else:
+                    dead_event = _refine_dead(Ps_dev[k], xc, bm, rss[k],
+                                              int(ptrs[k]), R_blocks[k])
+                    results[i] = _result_invalid(
+                        "reach-batch", stream, memo, packed_list[i],
+                        dead_event, elapsed)
+            return results  # type: ignore[return-value]
         E_pad = max(preps[i][1].E for i in live)
-        O_pad = max(preps[i][2].shape[1] for i in live) - 1
-        Ts, kinds, slots, opids, R0s, slot0s, streams = [], [], [], [], [], [], []
+        Ts, kinds, slots, opids, R0s, slot0s, streams = \
+            [], [], [], [], [], [], []
         for i in live:
             memo, stream, _, _, _ = preps[i]
             stream = ev.pad(stream, E_pad, W)
@@ -349,14 +559,16 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
                   max_slots: int = 20, max_dense: int = 1 << 22,
                   max_matrix: int = 1 << 26,
                   devices: Optional[Sequence] = None) -> Dict[str, Any]:
-    """History-length-parallel check: split the event stream into
-    ``n_chunks`` chunks, compute each chunk's D×D boolean transfer matrix by
-    running the walk over all D basis configs (vmapped; chunks run in
-    parallel and shard across ``devices``), then fold the matrices.
+    """History-length-parallel check: split the RETURN stream into
+    ``n_chunks`` chunks, compute each chunk's D×D boolean transfer matrix
+    by running the returns walk over all D basis configs (vmapped over
+    (chunk, basis); chunks shard across ``devices``), then fold the
+    matrices on the host.
 
-    The per-chunk basis walk costs D× the sequential walk's work but has
-    1/n_chunks the sequential depth — the winning trade on a mesh when D is
-    small (register-family models). Requires ``D**2 <= max_matrix``."""
+    The basis walk costs D× the sequential walk's work but has
+    1/n_chunks the sequential depth, and the D-sized batch axis is what
+    fills the device — the winning trade when D = S·2**W is small
+    (register-family models). Requires ``D**2 <= max_matrix``."""
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
@@ -372,41 +584,31 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
     if D * D > max_matrix:
         raise DenseOverflow(
             f"chunk transfer matrix {D}x{D} exceeds budget {max_matrix}")
-    E = stream.E
-    n_chunks = max(1, min(n_chunks, E))
-    # chunk boundaries on the padded stream; padding events are no-ops so
-    # uneven trailing chunks are harmless.
-    per = -(-E // n_chunks)
-    E_chunk = per
-    bounds = np.arange(n_chunks) * per
-    slot_maps = ev.chunk_slot_maps(stream, memo.n_ops, bounds)
-
-    def _chunk(a: np.ndarray) -> np.ndarray:
-        out = np.full((n_chunks, E_chunk), ev.KIND_PAD
-                      if a is stream.kind else 0, a.dtype)
-        for c in range(n_chunks):
-            seg = a[bounds[c]:min(bounds[c] + per, E)]
-            out[c, :len(seg)] = seg
-        return out
-
-    kinds = _chunk(stream.kind)
-    slots = _chunk(stream.slot)
-    opids = _chunk(stream.opid)
-    opids[kinds == ev.KIND_PAD] = -1
-    # basis: R0[b] = one-hot config b = (state, mask)
+    W = max(stream.W, 1)
+    if not _fast_ok(S_pad, W, M, memo.n_ops):
+        raise DenseOverflow("chunked basis walk exceeds fast-path budget")
+    rs = ev.returns_view(stream)
+    Rn = rs.n_returns
+    n_chunks = max(1, min(n_chunks, max(Rn, 1)))
+    per = -(-max(Rn, 1) // n_chunks)
+    rs_p = ev.pad_returns(rs, n_chunks * per)
+    ret_slot_c = rs_p.ret_slot.reshape(n_chunks, per)
+    slot_ops_c = rs_p.slot_ops.reshape(n_chunks, per, W)
+    P = _build_P(memo, S_pad)
+    xor_cols, bitmask = _xor_bitmask(W, M)
     basis = np.zeros((D, S_pad, M), bool)
     idx = np.arange(D)
     basis[idx, idx // M, idx % M] = True
     basis_c = np.broadcast_to(basis, (n_chunks, D, S_pad, M))
 
-    args = (jnp.asarray(T), jnp.asarray(kinds), jnp.asarray(slots),
-            jnp.asarray(opids), jnp.asarray(basis_c),
-            jnp.asarray(slot_maps))
+    args = (jnp.asarray(P), jnp.asarray(xor_cols), jnp.asarray(bitmask),
+            jnp.asarray(ret_slot_c), jnp.asarray(slot_ops_c),
+            jnp.asarray(basis_c))
     if devices is not None and len(devices) > 1:
         from jepsen_tpu.parallel import chunked_transfer
         mats = chunked_transfer(args, devices)
     else:
-        _, R, _ = _jitted_basis_walk()(*args)
+        R = _jitted_basis_returns()(*args)
         mats = np.asarray(R).reshape(n_chunks, D, D)
     # fold: v0 through each chunk's transfer matrix
     v = np.zeros(D, bool)
@@ -422,18 +624,26 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
         out = _result_valid("reach-chunked", stream, memo, elapsed)
         out["chunks"] = n_chunks
         return out
-    # coarse localization: re-walk the failing prefix sequentially to find
-    # the exact event (still device work, bounded by one chunk).
-    import jax.numpy as jnp2
-    hi = min(int(bounds[dead_chunk] + per), E)
-    R0 = jnp2.zeros((S_pad, M), jnp2.bool_).at[0, 0].set(True)
-    slot_op0 = jnp2.full((max(stream.W, 1),), -1, jnp2.int32)
-    ptr, _, alive = _jitted_walk()(
-        jnp2.asarray(T), jnp2.asarray(stream.kind[:hi]),
-        jnp2.asarray(stream.slot[:hi]), jnp2.asarray(stream.opid[:hi]),
-        R0, slot_op0)
+    # exact localization: re-walk the failing prefix of returns
+    # sequentially (bounded by dead_chunk+1 chunks of work), padded to an
+    # unroll-aligned length with identity rows.
+    hi = min((dead_chunk + 1) * per, rs_p.R)
+    L = max(_UNROLL, -(-hi // _UNROLL) * _UNROLL)
+    rs_loc = ev.pad_returns(
+        ev.ReturnStream(ret_slot=rs_p.ret_slot[:hi],
+                        slot_ops=rs_p.slot_ops[:hi],
+                        ret_event=rs_p.ret_event[:hi],
+                        ret_entry=rs_p.ret_entry[:hi],
+                        W=W, n_returns=min(hi, rs.n_returns)), L)
+    P_dev, xc, bm = (jnp.asarray(P), jnp.asarray(xor_cols),
+                     jnp.asarray(bitmask))
+    R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
+    ptr, _, alive, R_block = _jitted_walk_returns()(
+        P_dev, xc, bm, jnp.asarray(rs_loc.ret_slot),
+        jnp.asarray(rs_loc.slot_ops), R0)
+    dead_event = _refine_dead(P_dev, xc, bm, rs_loc, int(ptr), R_block)
     elapsed = _time.monotonic() - t0
     out = _result_invalid("reach-chunked", stream, memo, packed,
-                          int(ptr) - 1, elapsed)
+                          dead_event, elapsed)
     out["chunks"] = n_chunks
     return out

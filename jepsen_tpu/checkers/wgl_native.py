@@ -1,0 +1,163 @@
+"""ctypes bridge to the native C++ WGL search (``native/wgl.cpp``) — the
+fast CPU engine raced against the device engine in ``competition`` and
+used for large-n cross-validation (upstream's knossos.wgl ran on the JVM;
+here the equivalent hot loop is C++, built on demand with g++).
+
+Result dicts mirror :mod:`jepsen_tpu.checkers.wgl_ref` so the facade can
+route to either interchangeably. An :class:`AbortFlag` lets a competition
+thread stop the search from Python (upstream ``knossos.search/abort!``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu import history as h
+from jepsen_tpu.models import Model
+from jepsen_tpu.models.memo import memo as build_memo
+from jepsen_tpu.op import Op
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "wgl.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_build")
+_SO = os.path.join(_BUILD_DIR, "libjepsen_wgl.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+INF = 1 << 60
+_CAUSES = {0: None, 1: "timeout", 2: "config-set-explosion", 3: "aborted"}
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if missing/stale. Returns an error
+    message, or None on success."""
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return None
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        p = subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             "-o", _SO + ".tmp", _SRC],
+            capture_output=True, text=True, timeout=120)
+        if p.returncode != 0:
+            return f"g++ failed: {p.stderr[:500]}"
+        os.replace(_SO + ".tmp", _SO)
+        return None
+    except FileNotFoundError:
+        return "g++ not found"
+    except Exception as e:                          # noqa: BLE001
+        return f"{type(e).__name__}: {e}"
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (once) and load the library; None if unavailable."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        err = _build()
+        if err is not None:
+            _build_error = err
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.wgl_check.restype = ctypes.c_int64
+        lib.wgl_check.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def build_error() -> Optional[str]:
+    load()
+    return _build_error
+
+
+class AbortFlag:
+    """Shared abort flag the search polls (upstream
+    ``knossos.search/abort!``)."""
+
+    def __init__(self) -> None:
+        self._flag = ctypes.c_int32(0)
+
+    def abort(self) -> None:
+        self._flag.value = 1
+
+    @property
+    def pointer(self):
+        return ctypes.byref(self._flag)
+
+
+def check(model: Model, history: Sequence[Op], *,
+          time_limit: Optional[float] = None,
+          max_configs: int = 50_000_000,
+          max_states: int = 1_000_000,
+          abort_flag: Optional[AbortFlag] = None) -> Dict[str, Any]:
+    return check_packed(model, h.pack(history), time_limit=time_limit,
+                        max_configs=max_configs, max_states=max_states,
+                        abort_flag=abort_flag)
+
+
+def check_packed(model: Model, packed: h.PackedHistory, *,
+                 time_limit: Optional[float] = None,
+                 max_configs: int = 50_000_000,
+                 max_states: int = 1_000_000,
+                 abort_flag: Optional[AbortFlag] = None) -> Dict[str, Any]:
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native WGL unavailable: {_build_error}")
+    n = packed.n
+    if n == 0 or packed.n_ok == 0:
+        return {"valid": True, "engine": "wgl-native",
+                "configs-explored": 0}
+    memo = build_memo(model, packed, max_states=max_states)
+
+    table = np.ascontiguousarray(memo.table, np.int32)
+    inv_ev = np.ascontiguousarray(packed.inv_ev, np.int32)
+    ret_ev = np.ascontiguousarray(packed.ret_ev, np.int64)
+    op_id = np.ascontiguousarray(packed.op_id, np.int32)
+    crashed = np.ascontiguousarray(packed.crashed, np.uint8)
+    out = np.zeros(4, np.int32)
+
+    def ptr(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    explored = lib.wgl_check(
+        ptr(table, ctypes.c_int32), memo.n_states, memo.n_ops,
+        ptr(inv_ev, ctypes.c_int32), ptr(ret_ev, ctypes.c_int64),
+        ptr(op_id, ctypes.c_int32), ptr(crashed, ctypes.c_uint8),
+        n, max_configs, -1.0 if time_limit is None else float(time_limit),
+        abort_flag.pointer if abort_flag is not None else None,
+        ptr(out, ctypes.c_int32))
+
+    verdict, stuck, cover, cause = (int(x) for x in out)
+    if verdict == 1:
+        return {"valid": True, "engine": "wgl-native",
+                "configs-explored": int(explored),
+                "states-materialized": memo.n_states}
+    if verdict == 0:
+        return {"valid": False, "engine": "wgl-native",
+                "op": packed.entries[stuck].op.to_dict(),
+                "max-linearized": cover,
+                "configs-explored": int(explored)}
+    return {"valid": "unknown", "engine": "wgl-native",
+            "cause": _CAUSES.get(cause, cause),
+            "configs-explored": int(explored)}

@@ -42,7 +42,7 @@ def _nodes_from(args) -> Optional[list]:
 
 def _cmd_run(args) -> int:
     from jepsen_tpu import core
-    from jepsen_tpu.suites import register
+    from jepsen_tpu.suites import mutex, register
 
     logging.basicConfig(
         level=logging.INFO,
@@ -57,6 +57,11 @@ def _cmd_run(args) -> int:
         "register-independent": lambda: register.independent_test(
             mode=args.mode, concurrency=args.concurrency,
             seed=args.seed, store=True),
+        "mutex": lambda: mutex.mutex_test(
+            mode=args.mode, time_limit=args.time_limit,
+            concurrency=args.concurrency, seed=args.seed,
+            with_nemesis=not args.no_nemesis, store=True,
+            algorithm=args.algorithm),
     }
     if args.suite not in builders:
         print(f"unknown suite {args.suite!r}; have {sorted(builders)}",

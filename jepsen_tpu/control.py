@@ -249,17 +249,27 @@ class Session:
 
 
 def remote_for(test: Mapping) -> Remote:
-    """The test map's remote: ``test["remote"]`` if given, else SSH
-    (upstream defaults to SSH; ``--dummy`` style local runs pass
-    ``LocalRemote``)."""
+    """The test map's remote: ``test["remote"]`` if given, else a shared
+    SSH remote cached into the test map (so ControlMaster sockets and
+    per-node credentials persist across sessions). Upstream defaults to
+    SSH; ``--dummy`` style local runs pass ``LocalRemote``."""
     r = test.get("remote")
     if r is not None:
         return r
-    return SSHRemote()
+    r = SSHRemote()
+    try:
+        test["remote"] = r                              # type: ignore[index]
+    except TypeError:
+        pass                                    # immutable test map: one-shot
+    return r
 
 
 def session(test: Mapping, node: str) -> Session:
-    return Session(remote_for(test), node, ssh=test.get("ssh", {}))
+    """A connected session for ``node`` — registers the test's ssh
+    credentials (username/port/key) with the remote. Note: password auth
+    is not supported (no sshpass in the image); use key-based auth."""
+    return Session(remote_for(test), node,
+                   ssh=test.get("ssh") or {}).connect()
 
 
 def on_nodes(test: Mapping, fn, nodes: Optional[Sequence[str]] = None

@@ -1,0 +1,74 @@
+"""A fake distributed lock service — plays the role upstream's
+``zookeeper/`` suite's real ZooKeeper ensemble plays (SURVEY.md §2.5: the
+zookeeper lock workload checked against the ``mutex`` model).
+
+Modes mirror :class:`~jepsen_tpu.fake.cluster.FakeCluster`:
+
+- ``"linearizable"`` — one global lock; try-acquire requires the contacted
+  node to reach a quorum. Histories always satisfy the mutex model.
+- ``"sloppy"`` — each side of a partition keeps granting from its own
+  local view: two holders at once — a mutex violation the checker must
+  catch.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+from jepsen_tpu.fake.cluster import FakeCluster, FakeTimeout, Unavailable
+
+
+class FakeLockService(FakeCluster):
+    """Reuses FakeCluster's node/link/fault plumbing; the datum is one
+    lock (per name) instead of a KV map."""
+
+    def __init__(self, nodes: Sequence[str] = ("n1", "n2", "n3", "n4", "n5"),
+                 mode: str = "linearizable", seed: Optional[int] = None):
+        super().__init__(nodes, mode=mode, seed=seed)
+        self._lock_holder: Dict[Any, Any] = {}          # global (linearizable)
+        self._llock = threading.Lock()
+        for n in self.nodes.values():
+            n.data = {}                                 # name -> holder
+
+    # -- lock RPC ------------------------------------------------------------
+    def acquire(self, node: str, name: Any, holder: Any) -> bool:
+        n = self._enter(node)
+        if self.mode == "linearizable":
+            if not self._has_majority(node):
+                raise Unavailable(f"{node} lost quorum")
+            with self._llock:
+                if self._lock_holder.get(name) is not None:
+                    return False
+                self._lock_holder[name] = holder
+                return True
+        with n.lock:
+            if n.data.get(name) is not None:
+                return False
+        self._replicate(n, name, holder)
+        return True
+
+    def release(self, node: str, name: Any, holder: Any) -> bool:
+        n = self._enter(node)
+        if self.mode == "linearizable":
+            if not self._has_majority(node):
+                raise Unavailable(f"{node} lost quorum")
+            with self._llock:
+                if self._lock_holder.get(name) != holder:
+                    return False
+                self._lock_holder[name] = None
+                return True
+        with n.lock:
+            if n.data.get(name) != holder:
+                return False
+        self._replicate(n, name, None)
+        return True
+
+    def _replicate(self, n, name: Any, holder: Any) -> None:
+        with n.lock:
+            n.data[name] = holder
+        for peer in self._reachable_from(n.name):
+            p = self.nodes[peer]
+            if p is n or p.pause.is_set():
+                continue
+            with p.lock:
+                p.data[name] = holder

@@ -123,25 +123,34 @@ class Fn(Generator):
 class Seq(Generator):
     """Drain an iterable of sketches/sub-generators, one element at a time;
     each element serves to exhaustion before the next (upstream
-    ``gen/seq``). Thread-safe."""
+    ``gen/seq``). Thread-safe; the current element's ``op`` runs OUTSIDE
+    the lock (it may block, e.g. a ``Synchronize`` barrier — holding the
+    lock would deadlock the other workers the barrier waits for)."""
 
     def __init__(self, xs: Iterable):
         self._it = iter(xs)
         self._cur: Optional[Generator] = None
+        self._done = False
         self._lock = threading.Lock()
 
     def op(self, test, process):
-        with self._lock:
-            while True:
-                if self._cur is not None:
-                    sketch = self._cur.op(test, process)
-                    if sketch is not None:
-                        return sketch
-                    self._cur = None
-                try:
-                    self._cur = gen(next(self._it))
-                except StopIteration:
+        while True:
+            with self._lock:
+                if self._done:
                     return None
+                if self._cur is None:
+                    try:
+                        self._cur = gen(next(self._it))
+                    except StopIteration:
+                        self._done = True
+                        return None
+                cur = self._cur
+            sketch = cur.op(test, process)
+            if sketch is not None:
+                return sketch
+            with self._lock:
+                if self._cur is cur:        # only the first observer advances
+                    self._cur = None
 
 
 def seq(*gens: GenLike) -> Seq:
@@ -415,11 +424,13 @@ def log_gen(msg: str) -> Log:
 
 
 class Synchronize(Generator):
-    """Barrier: no process proceeds into ``g`` until every active process
-    has exhausted whatever preceded this generator and arrived here
-    (upstream ``gen/synchronize``). The runner declares the worker set via
-    ``test["active-processes"]`` (a live set maintained by
-    :mod:`jepsen_tpu.core`); without it, the first arrival passes."""
+    """Barrier: no client process proceeds into ``g`` until every active
+    client process has exhausted whatever preceded this generator and
+    arrived here (upstream ``gen/synchronize``). The runner declares the
+    worker set via ``test["active-processes"]`` (a live set maintained by
+    :mod:`jepsen_tpu.core`); the nemesis is excluded — it never routes
+    through client-side barriers. Without an active set, the first
+    arrival passes."""
 
     def __init__(self, g: GenLike):
         self._gen = gen(g)
@@ -429,13 +440,16 @@ class Synchronize(Generator):
 
     def op(self, test, process):
         active = test.get("active-processes") if hasattr(test, "get") else None
-        if active:
+        if active and process != NEMESIS:
             with self._cond:
                 self._arrived.add(process)
-                while not self._open and not self._arrived >= set(active()):
-                    if not self._cond.wait(timeout=0.05):
-                        # active set may shrink as workers exit; re-check
-                        continue
+                while not self._open:
+                    want = {p for p in active() if p != NEMESIS}
+                    if self._arrived >= want:
+                        break
+                    # wait with timeout: the active set shrinks as workers
+                    # exit, so re-check periodically
+                    self._cond.wait(timeout=0.05)
                 self._open = True
                 self._cond.notify_all()
         return self._gen.op(test, process)

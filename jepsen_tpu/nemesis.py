@@ -400,8 +400,11 @@ class Compose(Nemesis):
             else:
                 self._routes.append((key, nem, None))
 
+    def _distinct(self) -> List[Nemesis]:
+        return list({id(n): n for _, n, _ in self._routes}.values())
+
     def setup(self, test):
-        for _, nem, _ in self._routes:
+        for nem in self._distinct():
             nem.setup(test)
 
     def invoke(self, test, op):
@@ -414,7 +417,7 @@ class Compose(Nemesis):
         return op.with_(type=INFO, value=f"no nemesis handles f={op.f!r}")
 
     def teardown(self, test):
-        for _, nem, _ in self._routes:
+        for nem in self._distinct():
             nem.teardown(test)
 
 

@@ -48,38 +48,40 @@ def shard_leading_axis(arrays, devs: Optional[Sequence] = None):
 
 def chunked_transfer(args, devs: Sequence):
     """Compute per-chunk transfer matrices with the chunk axis sharded over
-    ``devs`` via ``shard_map``. ``args`` = (T, kinds, slots, opids, basis_c,
-    slot_maps) as built by :func:`jepsen_tpu.checkers.reach.check_chunked`;
-    the transition table is replicated, everything else is chunk-sharded.
-    Returns a host ndarray [n_chunks, D, D]."""
+    ``devs`` via ``shard_map``. ``args`` = (P_mats, xor_cols, bitmask,
+    ret_slot_c, slot_ops_c, basis_c) as built by
+    :func:`jepsen_tpu.checkers.reach.check_chunked`; the transition
+    matrices and static index maps are replicated, the chunked return
+    streams and basis blocks are chunk-sharded. Returns a host ndarray
+    [n_chunks, D, D]."""
     import jax
     from jax.sharding import PartitionSpec as P
 
     from jepsen_tpu.checkers import reach
 
-    T, kinds, slots, opids, basis_c, slot_maps = args
-    n_chunks = kinds.shape[0]
+    P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c, basis_c = args
+    n_chunks = ret_slot_c.shape[0]
     n_dev = len(devs)
     if n_chunks % n_dev:
         raise ValueError(f"n_chunks {n_chunks} not divisible by "
                          f"{n_dev} devices")
     m = mesh("chunks", devs)
 
-    def local(T, kinds, slots, opids, basis_c, slot_maps):
-        inner = jax.vmap(reach._walk,
-                         in_axes=(None, None, None, None, 0, None))
-        outer = jax.vmap(inner, in_axes=(None, 0, 0, 0, 0, 0))
-        _, R, _ = outer(T, kinds, slots, opids, basis_c, slot_maps)
-        return R
+    def local(P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c, basis_c):
+        inner = jax.vmap(reach._walk_returns_scan,
+                         in_axes=(None, None, None, None, None, 0))
+        outer = jax.vmap(inner, in_axes=(None, None, None, 0, 0, 0))
+        return outer(P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c,
+                     basis_c)
 
     sm = jax.shard_map(
         local, mesh=m,
-        in_specs=(P(), P("chunks"), P("chunks"), P("chunks"), P("chunks"),
-                  P("chunks")),
+        in_specs=(P(), P(), P(), P("chunks"), P("chunks"), P("chunks")),
         out_specs=P("chunks"),
-        # the replicated transition table mixes invariant/variant operands
-        # inside control flow; skip the varying-axes check
+        # replicated operands mix invariant/variant axes inside control
+        # flow; skip the varying-axes check
         check_vma=False)
-    R = jax.jit(sm)(T, kinds, slots, opids, basis_c, slot_maps)
+    R = jax.jit(sm)(P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c,
+                    basis_c)
     D = R.shape[1]
     return np.asarray(R).reshape(n_chunks, D, D)

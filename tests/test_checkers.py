@@ -21,14 +21,14 @@ def hist(*ops):
 
 class TestLinearizable:
     @pytest.mark.parametrize("algorithm",
-                             ["auto", "reach", "wgl-cpu", "competition"])
+                             ["auto", "reach", "wgl-cpu", "wgl-native", "competition"])
     def test_valid_history(self, algorithm):
         h = fixtures.gen_history("cas", n_ops=40, processes=4, seed=5)
         c = linearizable(m.cas_register(), algorithm=algorithm)
         assert c.check(None, h)["valid"] is True
 
     @pytest.mark.parametrize("algorithm",
-                             ["auto", "reach", "wgl-cpu", "competition"])
+                             ["auto", "reach", "wgl-cpu", "wgl-native", "competition"])
     def test_invalid_history(self, algorithm):
         h = fixtures.corrupt(
             fixtures.gen_history("cas", n_ops=40, processes=4, seed=5),
@@ -48,7 +48,7 @@ class TestLinearizable:
         c = linearizable(m.register(), max_dense=2)
         res = c.check(None, h)
         assert res["valid"] is True
-        assert res["engine"] == "wgl-cpu-fallback"
+        assert res["engine"] in ("wgl-native-fallback", "wgl-cpu-fallback")
 
     def test_check_safe_catches(self):
         class Boom(type(noop_checker())):

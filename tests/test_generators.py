@@ -158,3 +158,31 @@ def test_synchronize_without_active_set_passes():
 def test_phases_run_in_order():
     gen = g.phases(g.seq({"f": "a"}), g.seq({"f": "b"}))
     assert [s["f"] for s in drain(gen, test={})] == ["a", "b"]
+
+
+def test_phases_barrier_with_concurrent_workers():
+    """Regression: Seq must not hold its lock through a blocking barrier,
+    and the barrier must not wait for the nemesis process."""
+    import threading
+
+    active = {0, 1, g.NEMESIS}
+    test = {"active-processes": lambda: set(active)}
+    gen = g.phases(g.each(lambda: g.seq({"f": "a"})),
+                   g.each(lambda: g.seq({"f": "b"})))
+    out = {0: [], 1: []}
+
+    def worker(p):
+        while True:
+            s = gen.op(test, p)
+            if s is None:
+                active.discard(p)
+                return
+            out[p].append(s["f"])
+
+    ts = [threading.Thread(target=worker, args=(p,)) for p in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+        assert not t.is_alive(), "phases barrier deadlocked"
+    assert out[0] == ["a", "b"] and out[1] == ["a", "b"]

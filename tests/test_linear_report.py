@@ -1,0 +1,36 @@
+"""SVG failure-report tests (upstream knossos.linear.report analogue)."""
+import os
+
+from jepsen_tpu import fixtures, models
+from jepsen_tpu.checkers import linear_report
+from jepsen_tpu.checkers.facade import linearizable
+
+
+def _bad_history():
+    return fixtures.corrupt(
+        fixtures.gen_history("cas", n_ops=60, processes=4, seed=6), seed=6)
+
+
+def test_render_analysis_produces_svg(tmp_path):
+    hist = _bad_history()
+    res = linearizable(models.cas_register()).check(None, hist)
+    assert res["valid"] is False
+    path = str(tmp_path / "linear.svg")
+    svg = linear_report.render_analysis(hist, res, path)
+    assert svg.startswith("<svg") and svg.endswith("</svg>")
+    assert "Non-linearizable" in svg
+    assert os.path.exists(path)
+
+
+def test_checker_writes_report_with_dir(tmp_path):
+    hist = _bad_history()
+    res = linearizable(models.cas_register()).check(
+        {"dir": str(tmp_path)}, hist)
+    assert res["valid"] is False
+    assert os.path.exists(res["report-file"])
+
+
+def test_render_rejects_valid_verdicts():
+    import pytest
+    with pytest.raises(ValueError):
+        linear_report.render_analysis([], {"valid": True})

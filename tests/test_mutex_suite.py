@@ -1,0 +1,43 @@
+"""ZooKeeper-style lock suite E2E (upstream zookeeper/ — SURVEY.md §2.5)."""
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.fake.lock import FakeLockService
+from jepsen_tpu.suites import mutex
+
+
+def test_lock_service_mutual_exclusion():
+    svc = FakeLockService(mode="linearizable")
+    assert svc.acquire("n1", "L", "p0") is True
+    assert svc.acquire("n2", "L", "p1") is False       # held
+    assert svc.release("n3", "L", "p1") is False       # not the holder
+    assert svc.release("n2", "L", "p0") is True
+    assert svc.acquire("n2", "L", "p1") is True
+
+
+def test_sloppy_lock_double_grants_under_partition():
+    svc = FakeLockService(mode="sloppy")
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            svc.drop_link(a, b)
+            svc.drop_link(b, a)
+    assert svc.acquire("n1", "L", "p0") is True
+    assert svc.acquire("n3", "L", "p1") is True        # the bug: two holders
+
+
+def test_mutex_run_linearizable_valid():
+    t = mutex.mutex_test(mode="linearizable", time_limit=1.0, seed=7,
+                         with_nemesis=True, nemesis_interval=0.25,
+                         store=False)
+    done = core.run(t)
+    assert done["results"]["results"]["linear"]["valid"] is True
+    fs = {op.f for op in done["history"] if op.process != "nemesis"}
+    assert fs >= {"acquire", "release"}
+
+
+def test_mutex_run_sloppy_finds_violation():
+    t = mutex.mutex_test(mode="sloppy", time_limit=1.5, seed=13,
+                         with_nemesis=True, nemesis_interval=0.2,
+                         store=False)
+    done = core.run(t)
+    assert done["results"]["results"]["linear"]["valid"] is False

@@ -1,0 +1,39 @@
+"""Search-control tests (upstream knossos.search semantics)."""
+import time
+
+from jepsen_tpu.checkers.search import SearchControl, mem_available_bytes
+
+
+def test_deadline_aborts():
+    with SearchControl(time_limit=0.01) as ctl:
+        time.sleep(0.03)
+        assert ctl.should_abort() is True
+        assert ctl.cause == "timeout"
+
+
+def test_explicit_abort_trips_native_flags():
+    class Flag:
+        tripped = False
+
+        def abort(self):
+            self.tripped = True
+
+    with SearchControl() as ctl:
+        f = ctl.bind_native(Flag())
+        assert ctl.should_abort() is False
+        ctl.abort("because")
+        assert f.tripped is True
+        assert ctl.cause == "because"
+        # late-bound flags are tripped immediately
+        assert ctl.bind_native(Flag()).tripped is True
+
+
+def test_memory_watchdog_fires_on_low_threshold():
+    free = mem_available_bytes()
+    if free is None:
+        return                         # non-Linux: watchdog is inert
+    with SearchControl(min_free_bytes=free * 4,
+                       watchdog_interval=0.01) as ctl:
+        time.sleep(0.1)
+        assert ctl.should_abort() is True
+        assert ctl.cause == "low-memory"

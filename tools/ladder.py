@@ -1,0 +1,76 @@
+"""Run the full BASELINE.md benchmark ladder and print one JSON line per
+rung (engine comparison: device reach, chunked, native C++, Python WGL).
+
+Usage: python tools/ladder.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def time_engine(fn, repeat: int = 2):
+    fn()                                    # warm-up / compile
+    best = float("inf")
+    for _ in range(repeat):
+        t0 = time.monotonic()
+        res = fn()
+        best = min(best, time.monotonic() - t0)
+    return res, best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="shrink the big rungs for CI")
+    args = ap.parse_args()
+
+    from jepsen_tpu import fixtures, independent, models
+    from jepsen_tpu.checkers import reach, wgl_native, wgl_ref
+    from jepsen_tpu.history import pack
+
+    scale = 10 if args.quick else 1
+    rungs = [
+        ("register-200", "register", 200 // scale or 20, 5),
+        ("cas-1k", "cas", 1_000 // scale, 5),
+        ("mutex-5k", "mutex", 5_000 // scale, 5),
+        ("multi-10k", "multi", 10_000 // scale, 5),
+        ("cas-100k", "cas", 100_000 // scale, 5),
+    ]
+    for name, kind, n_ops, procs in rungs:
+        hist = fixtures.gen_history(kind, n_ops=n_ops, processes=procs,
+                                    seed=42)
+        packed = pack(hist)
+        model = fixtures.model_for(kind)
+        row = {"rung": name, "ops": n_ops}
+        res, dt = time_engine(lambda: reach.check_packed(model, packed))
+        assert res["valid"] is True, (name, res)
+        row["reach_s"] = round(dt, 4)
+        try:
+            res, dt = time_engine(
+                lambda: reach.check_chunked(model, packed=packed,
+                                            n_chunks=64,
+                                            max_matrix=1 << 28))
+            assert res["valid"] is True, (name, res)
+            row["chunked_s"] = round(dt, 4)
+        except Exception as e:                          # noqa: BLE001
+            row["chunked_s"] = f"n/a ({type(e).__name__})"
+        if wgl_native.available():
+            res, dt = time_engine(
+                lambda: wgl_native.check_packed(model, packed))
+            assert res["valid"] is True, (name, res)
+            row["native_s"] = round(dt, 4)
+        if n_ops <= 10_000:
+            res, dt = time_engine(
+                lambda: wgl_ref.check_packed(model, packed,
+                                             time_limit=120),
+                repeat=1)
+            row["wgl_py_s"] = (round(dt, 4) if res["valid"] is True
+                               else f"{res['valid']}")
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
