@@ -67,6 +67,12 @@ def debian() -> DebianOS:
     return DebianOS()
 
 
+def ubuntu() -> DebianOS:
+    """Ubuntu uses the Debian toolchain (upstream ``jepsen.os.ubuntu`` is
+    a thin wrapper over the debian ns)."""
+    return DebianOS()
+
+
 def centos() -> CentosOS:
     return CentosOS()
 

@@ -58,3 +58,40 @@ def meh(fn: Callable[[], T]) -> Optional[T]:
         return fn()
     except Exception:
         return None
+
+
+def map_vals(f: Callable[[Any], Any], d: dict) -> dict:
+    """Map ``f`` over a dict's values (upstream ``jepsen.util/map-vals``)."""
+    return {k: f(v) for k, v in d.items()}
+
+
+def pprint_str(x: Any) -> str:
+    """Pretty-print to a string (upstream ``jepsen.util/pprint-str``)."""
+    import pprint
+    return pprint.pformat(x, width=78)
+
+
+def log_op(op: Any) -> None:
+    """Log one operation in the jepsen console style (upstream
+    ``jepsen.util/log-op``)."""
+    import logging
+    logging.getLogger("jepsen.ops").info(
+        "%s\t%s\t%s\t%r", op.process, op.type, op.f, op.value)
+
+
+class with_thread_name:
+    """Context manager renaming the current thread (upstream
+    ``jepsen.util/with-thread-name``) — thread names show in log lines."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        import threading
+        self._old = threading.current_thread().name
+        threading.current_thread().name = self.name
+        return self
+
+    def __exit__(self, *exc):
+        import threading
+        threading.current_thread().name = self._old

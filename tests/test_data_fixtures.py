@@ -1,0 +1,56 @@
+"""Cross-engine agreement on the recorded EDN fixtures in ``data/`` —
+the rebuild of knossos' recorded-history test tier (SURVEY.md §4): every
+engine must return the known verdict on every fixture."""
+import os
+
+import pytest
+
+from jepsen_tpu import history as h
+from jepsen_tpu import models
+from jepsen_tpu.checkers import reach, wgl_native, wgl_ref
+
+DATA = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "data")
+
+FIXTURES = [
+    ("cas-register-ok-small.edn", models.cas_register, True),
+    ("cas-register-ok-large.edn", models.cas_register, True),
+    ("cas-register-bad.edn", models.cas_register, False),
+    ("cas-register-recorded-bad.edn", models.cas_register, False),
+    ("register-ok.edn", models.register, True),
+    ("register-bad.edn", models.register, False),
+    ("mutex-ok.edn", models.mutex, True),
+    ("multi-register-ok.edn", models.multi_register, True),
+    ("multi-register-bad.edn", models.multi_register, False),
+]
+
+
+@pytest.mark.parametrize("fname,model_fn,want",
+                         FIXTURES, ids=[f[0] for f in FIXTURES])
+def test_all_engines_agree(fname, model_fn, want):
+    hist = h.load_edn(os.path.join(DATA, fname))
+    packed = h.pack(hist)
+    model = model_fn()
+    assert reach.check_packed(model, packed)["valid"] is want
+    assert wgl_ref.check_packed(model, packed)["valid"] is want
+    if wgl_native.available():
+        assert wgl_native.check_packed(model, packed)["valid"] is want
+
+
+def test_keyword_edn_syntax():
+    """Upstream keyword-style EDN loads identically."""
+    import tempfile
+
+    text = """[{:process 0, :type :invoke, :f :write, :value 1}
+ {:process 0, :type :ok, :f :write, :value 1}
+ {:process 1, :type :invoke, :f :read, :value nil}
+ {:process 1, :type :ok, :f :read, :value 1}]"""
+    with tempfile.NamedTemporaryFile("w", suffix=".edn",
+                                     delete=False) as f:
+        f.write(text)
+        path = f.name
+    hist = h.load_edn(path)
+    os.unlink(path)
+    assert len(hist) == 4
+    assert hist[0].process == 0 and hist[0].f == "write"
+    assert wgl_ref.check(models.register(), hist)["valid"] is True
