@@ -36,8 +36,15 @@ def test_mutex_run_linearizable_valid():
 
 
 def test_mutex_run_sloppy_finds_violation():
+    # Install a permanent full partition {n1,n2} | {n3,n4,n5} up front so
+    # both sides are guaranteed to grant the lock during the run — the
+    # random nemesis version of this test was timing-flaky.
     t = mutex.mutex_test(mode="sloppy", time_limit=1.5, seed=13,
-                         with_nemesis=True, nemesis_interval=0.2,
-                         store=False)
+                         with_nemesis=False, store=False)
+    svc = t["cluster"]
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            svc.drop_link(a, b)
+            svc.drop_link(b, a)
     done = core.run(t)
     assert done["results"]["results"]["linear"]["valid"] is False
