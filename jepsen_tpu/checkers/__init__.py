@@ -8,6 +8,9 @@ the linearizability engines live in:
   recast as a device-resident tensor program).
 - :mod:`jepsen_tpu.checkers.wgl_ref` — CPU reference Wing-Gong-Lowe search
   (upstream ``knossos.wgl``), the correctness oracle and CPU baseline.
+- :mod:`jepsen_tpu.checkers.linear` — sparse just-in-time linearization
+  (upstream ``knossos.linear`` with ``knossos.linear.config``'s
+  array/set config-set representations).
 - :mod:`jepsen_tpu.checkers.brute` — exhaustive permutation checker for
   differential testing of tiny histories (no upstream analogue; replaces
   knossos's recorded-fixture cross-checks at the smallest scale).

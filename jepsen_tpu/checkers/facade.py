@@ -70,9 +70,12 @@ class Linearizable(Checker):
     - ``"wgl-native"`` — the C++ WGL search
       (:mod:`jepsen_tpu.checkers.wgl_native`).
     - ``"wgl-cpu"`` — the Python oracle (:mod:`jepsen_tpu.checkers.wgl_ref`).
-    - ``"competition"`` — device engine raced against the native (or
-      Python) CPU search on a thread, first definitive verdict wins and
-      the loser is aborted (upstream ``knossos.competition``).
+    - ``"linear"`` — sparse just-in-time linearization, upstream
+      ``knossos.linear`` (:mod:`jepsen_tpu.checkers.linear`).
+    - ``"competition"`` — device engine raced against the CPU searches
+      (WGL native/Python plus JIT-linearization) on threads, first
+      definitive verdict wins and the losers are aborted (upstream
+      ``knossos.competition`` racing wgl against linear).
     """
     model: Optional[Model] = None
     algorithm: str = "auto"
@@ -116,6 +119,10 @@ class Linearizable(Checker):
                                     **_engine_kw(kw, _NATIVE_KW))
         if algorithm == "wgl-cpu":
             return wgl_ref.check(model, history, **_engine_kw(kw, _WGL_KW))
+        if algorithm == "linear":
+            from jepsen_tpu.checkers import linear
+            return linear.check(model, history,
+                                **_engine_kw(kw, _LINEAR_KW))
         if algorithm == "auto":
             try:
                 return reach.check(model, history,
@@ -145,6 +152,7 @@ _REACH_KW = ("max_states", "max_slots", "max_dense")
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
 _WGL_KW = ("time_limit", "max_configs", "strategy", "should_abort")
 _NATIVE_KW = ("time_limit", "max_configs", "max_states", "abort_flag")
+_LINEAR_KW = ("time_limit", "max_configs", "rep", "should_abort")
 
 
 def _engine_kw(kw: Mapping, allowed: Sequence[str]) -> Dict[str, Any]:
@@ -153,14 +161,15 @@ def _engine_kw(kw: Mapping, allowed: Sequence[str]) -> Dict[str, Any]:
 
 def _competition(model: Model, history: Sequence[Op],
                  kw: Dict[str, Any]) -> Dict[str, Any]:
-    """Race the device engine against the CPU search (native C++ when
-    built, else the Python oracle) on threads; the first definitive
-    verdict wins and the CPU search is aborted (upstream
-    ``knossos.competition/analysis``). If one engine errors, the other's
-    verdict is used."""
+    """Race the device engine against the CPU searches (WGL — native C++
+    when built, else the Python oracle — and JIT-linearization) on
+    threads; the first definitive verdict wins and the losers are aborted
+    (upstream ``knossos.competition/analysis``, which races wgl against
+    linear). If an engine errors or returns unknown, another's verdict is
+    used."""
     import queue
 
-    from jepsen_tpu.checkers import reach, wgl_native, wgl_ref
+    from jepsen_tpu.checkers import linear, reach, wgl_native, wgl_ref
     from jepsen_tpu.checkers.search import SearchControl
 
     ctl = SearchControl(time_limit=kw.get("time_limit")).start()
@@ -192,8 +201,18 @@ def _competition(model: Model, history: Sequence[Op],
         except Exception as e:                          # noqa: BLE001
             verdicts.put(("reach", {"valid": "unknown", "error": str(e)}))
 
+    def run_linear():
+        try:
+            r = linear.check(model, history,
+                             should_abort=ctl.should_abort,
+                             **_engine_kw(kw, ("max_configs", "rep")))
+            verdicts.put(("linear", r))
+        except Exception as e:                          # noqa: BLE001
+            verdicts.put(("linear", {"valid": "unknown", "error": str(e)}))
+
     threads = [threading.Thread(target=run_cpu, daemon=True),
-               threading.Thread(target=run_tpu, daemon=True)]
+               threading.Thread(target=run_tpu, daemon=True),
+               threading.Thread(target=run_linear, daemon=True)]
     for t in threads:
         t.start()
     winner: Optional[Dict[str, Any]] = None

@@ -1,0 +1,164 @@
+"""JIT-linearization engine tests: hand-written verdicts, agreement with
+the WGL oracle and the brute-force checker on randomized histories (both
+config-set representations), EDN fixture verdicts, and budget/abort
+behaviour — mirroring the upstream knossos linear_test tier (SURVEY.md §4)."""
+import glob
+import os
+
+import pytest
+
+from jepsen_tpu import fixtures
+from jepsen_tpu import models as m
+from jepsen_tpu.checkers import brute, linear, wgl_ref
+from jepsen_tpu.history import index, load_edn
+from jepsen_tpu.op import info, invoke, ok
+
+DATA = os.path.join(os.path.dirname(__file__), os.pardir, "data")
+
+
+def hist(*ops):
+    return index(list(ops))
+
+
+class TestHandWritten:
+    def test_empty_valid(self):
+        assert linear.check(m.register(), [])["valid"] is True
+
+    def test_stale_read_invalid(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(0, "write", 2), ok(0, "write", 2),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        res = linear.check(m.register(), h)
+        assert res["valid"] is False
+        assert res["op"]["f"] == "read"
+        assert res["op"]["value"] == 1
+
+    def test_concurrent_reads_may_split(self):
+        h = hist(
+            invoke(0, "write", 0), ok(0, "write", 0),
+            invoke(0, "write", 1),
+            invoke(1, "read"), ok(1, "read", 0),
+            invoke(2, "read"), ok(2, "read", 1),
+            ok(0, "write", 1),
+        )
+        assert linear.check(m.register(), h)["valid"] is True
+
+    def test_crashed_write_both_branches(self):
+        base = [
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "write", 2), info(1, "write", 2),
+            invoke(0, "read"),
+        ]
+        for seen in (1, 2):
+            h = hist(*base, ok(0, "read", seen))
+            assert linear.check(m.register(), h)["valid"] is True, seen
+
+    def test_crashed_op_cannot_fire_before_invocation(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(2, "read"), ok(2, "read", 2),
+            invoke(1, "write", 2), info(1, "write", 2),
+        )
+        assert linear.check(m.register(), h)["valid"] is False
+
+    def test_mutex_double_acquire_invalid(self):
+        h = hist(
+            invoke(0, "acquire"), ok(0, "acquire"),
+            invoke(1, "acquire"), ok(1, "acquire"),
+        )
+        assert linear.check(m.mutex(), h)["valid"] is False
+
+    def test_config_set_explosion_unknown(self):
+        h = fixtures.gen_history("cas", n_ops=60, processes=8, seed=0)
+        res = linear.check(m.cas_register(), h, max_configs=2)
+        assert res["valid"] == "unknown"
+        assert res["cause"] == "config-set-explosion"
+
+    def test_should_abort_unknown(self):
+        h = fixtures.gen_history("cas", n_ops=60, processes=8, seed=0)
+        res = linear.check(m.cas_register(), h, should_abort=lambda: True)
+        assert res["valid"] == "unknown"
+        assert res["cause"] == "aborted"
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("rep", ["array", "set"])
+    @pytest.mark.parametrize("kind", ["register", "cas", "mutex"])
+    def test_vs_oracle(self, kind, rep):
+        model = fixtures.model_for(kind)
+        for seed in range(40):
+            h = fixtures.gen_history(kind, n_ops=30, processes=4, seed=seed,
+                                     crash_p=0.1)
+            if kind != "mutex" and seed % 2 == 0:
+                try:
+                    h = fixtures.corrupt(h, seed=seed)
+                except ValueError:
+                    pass
+            want = wgl_ref.check(model, h)["valid"]
+            got = linear.check(model, h, rep=rep)["valid"]
+            assert got == want, (kind, seed, rep, got, want)
+
+    @pytest.mark.parametrize("rep", ["array", "set"])
+    def test_long_history_slot_reuse(self, rep):
+        # >32 completed ops forces slot reuse; the array rep must still fit
+        # (peak concurrency, not total ops, bounds the slot count)
+        model = fixtures.model_for("cas")
+        for seed in range(6):
+            h = fixtures.gen_history("cas", n_ops=120, processes=4,
+                                     seed=seed, crash_p=0.05)
+            if seed % 2 == 0:
+                h = fixtures.corrupt(h, seed=seed)
+            want = wgl_ref.check(model, h)["valid"]
+            res = linear.check(model, h, rep=rep)
+            assert res["valid"] == want, (seed, rep)
+            if rep == "array":
+                assert res["rep"] == "array"
+
+    @pytest.mark.parametrize("kind", ["register", "cas", "mutex"])
+    def test_vs_brute_tiny(self, kind):
+        model = fixtures.model_for(kind)
+        for seed in range(60):
+            h = fixtures.gen_history(kind, n_ops=7, processes=3, seed=seed,
+                                     crash_p=0.15)
+            if kind != "mutex" and seed % 2 == 0:
+                try:
+                    h = fixtures.corrupt(h, seed=seed)
+                except ValueError:
+                    pass
+            want = brute.check(model, h)["valid"]
+            got = linear.check(model, h)["valid"]
+            assert got == want, (kind, seed, got, want)
+
+
+class TestFixtures:
+    @pytest.mark.parametrize("path", sorted(glob.glob(
+        os.path.join(DATA, "*.edn"))))
+    def test_edn_fixture_verdicts(self, path):
+        h = load_edn(path)
+        name = os.path.basename(path)
+        model = (m.mutex() if name.startswith("mutex")
+                 else m.multi_register() if name.startswith("multi")
+                 else m.cas_register() if name.startswith("cas")
+                 else m.register())
+        want = "bad" not in name
+        assert linear.check(model, h)["valid"] is want, name
+
+
+class TestFacade:
+    def test_algorithm_linear(self):
+        from jepsen_tpu.checkers import facade
+        h = fixtures.gen_history("cas", n_ops=30, processes=3, seed=3)
+        c = facade.linearizable(m.cas_register(), algorithm="linear")
+        res = c.check({}, h)
+        assert res["valid"] is True
+        assert res["engine"] == "linear"
+
+    def test_competition_includes_linear(self):
+        from jepsen_tpu.checkers import facade
+        h = fixtures.gen_history("cas", n_ops=40, processes=3, seed=5)
+        c = facade.linearizable(m.cas_register(), algorithm="competition")
+        res = c.check({}, h)
+        assert res["valid"] is True
+        assert res["winner"] in ("reach", "wgl-native", "wgl-cpu", "linear")
