@@ -40,9 +40,20 @@ def _nodes_from(args) -> Optional[list]:
     return None
 
 
+def _suite_mode(mode: str, cluster_cls) -> str:
+    """Translate the CLI's linearizable/sloppy vocabulary positionally
+    through a fake-system class's ``MODES`` (first = safe, second =
+    deliberately buggy) — e.g. sloppy → FakeBroker's "lossy"."""
+    from jepsen_tpu.fake import FakeCluster
+    base = FakeCluster.MODES
+    return cluster_cls.MODES[base.index(mode)] if mode in base else mode
+
+
 def _cmd_run(args) -> int:
     from jepsen_tpu import core
-    from jepsen_tpu.suites import mutex, register
+    from jepsen_tpu.fake import FakeBroker
+    from jepsen_tpu.suites import (counter as counter_suite, mutex, queue,
+                                   register, set_suite)
 
     logging.basicConfig(
         level=logging.INFO,
@@ -61,7 +72,20 @@ def _cmd_run(args) -> int:
             mode=args.mode, time_limit=args.time_limit,
             concurrency=args.concurrency, seed=args.seed,
             with_nemesis=not args.no_nemesis, store=True,
-            algorithm=args.algorithm),
+            algorithm=args.algorithm, nodes=nodes or 5),
+        "queue": lambda: queue.queue_test(
+            mode=_suite_mode(args.mode, FakeBroker),
+            time_limit=args.time_limit, concurrency=args.concurrency,
+            seed=args.seed, with_nemesis=not args.no_nemesis, store=True,
+            nodes=nodes or 5),
+        "set": lambda: set_suite.set_test(
+            mode=args.mode, time_limit=args.time_limit,
+            concurrency=args.concurrency, seed=args.seed,
+            with_nemesis=not args.no_nemesis, store=True, nodes=nodes or 5),
+        "counter": lambda: counter_suite.counter_test(
+            mode=args.mode, time_limit=args.time_limit,
+            concurrency=args.concurrency, seed=args.seed,
+            with_nemesis=not args.no_nemesis, store=True, nodes=nodes or 5),
     }
     if args.suite not in builders:
         print(f"unknown suite {args.suite!r}; have {sorted(builders)}",

@@ -3,6 +3,7 @@ docker (SURVEY.md §4 "implication for the rebuild" #4): a deliberately
 configurable replicated KV store with injectable partitions, pauses,
 kills, latency, loss, and clock skew.
 """
+from jepsen_tpu.fake.broker import FakeBroker
 from jepsen_tpu.fake.cluster import FakeCluster, Unavailable
 
-__all__ = ["FakeCluster", "Unavailable"]
+__all__ = ["FakeBroker", "FakeCluster", "Unavailable"]

@@ -49,11 +49,17 @@ class _Node:
 
 
 class FakeCluster:
+    #: subclasses with different consistency vocabularies override this
+    #: (first entry = the safe mode, second = the deliberately buggy one)
+    MODES = ("linearizable", "sloppy")
+
     def __init__(self, nodes: Sequence[str] = ("n1", "n2", "n3", "n4", "n5"),
                  mode: str = "linearizable", seed: Optional[int] = None,
                  base_latency: float = 0.0):
-        assert mode in ("linearizable", "sloppy")
+        assert mode in self.MODES
         self.mode = mode
+        #: subclass-proof branch selector: MODES[0] is always the safe mode
+        self.safe = mode == self.MODES[0]
         self.node_names: List[str] = list(nodes)
         self.nodes: Dict[str, _Node] = {n: _Node(n) for n in nodes}
         self.dropped: Set[Tuple[str, str]] = set()     # (src, dst)
@@ -82,7 +88,7 @@ class FakeCluster:
     def start_node(self, node: str) -> None:
         n = self.nodes[node]
         n.alive = True
-        if self.mode == "sloppy":
+        if not self.safe:
             # a restarted node rejoins empty and catches up from whoever it
             # can reach (deliberately naive — data loss is a feature here)
             for peer in self._reachable_from(node):
@@ -137,7 +143,7 @@ class FakeCluster:
 
     def read(self, node: str, key: Any) -> Any:
         n = self._enter(node)
-        if self.mode == "linearizable":
+        if self.safe:
             if not self._has_majority(node):
                 raise Unavailable(f"{node} lost quorum")
             with self._glock:
@@ -147,7 +153,7 @@ class FakeCluster:
 
     def write(self, node: str, key: Any, value: Any) -> None:
         n = self._enter(node)
-        if self.mode == "linearizable":
+        if self.safe:
             if not self._has_majority(node):
                 raise Unavailable(f"{node} lost quorum")
             with self._glock:
@@ -159,7 +165,7 @@ class FakeCluster:
 
     def cas(self, node: str, key: Any, old: Any, new: Any) -> bool:
         n = self._enter(node)
-        if self.mode == "linearizable":
+        if self.safe:
             if not self._has_majority(node):
                 raise Unavailable(f"{node} lost quorum")
             with self._glock:
@@ -172,6 +178,44 @@ class FakeCluster:
                 return False
         self._sloppy_apply(n, key, lambda _: new)
         return True
+
+    def sadd(self, node: str, key: Any, value: Any) -> None:
+        """Add ``value`` to the set at ``key`` (grow-only-set workload)."""
+        n = self._enter(node)
+        if self.safe:
+            if not self._has_majority(node):
+                raise Unavailable(f"{node} lost quorum")
+            with self._glock:
+                self._global.setdefault(key, set()).add(value)
+            return
+        # the sloppy bug: the add replicates only to currently-reachable
+        # peers, and replicas never merge — partitioned adds are lost to
+        # any single node's final read
+        self._sloppy_apply(n, key, lambda cur: (set(cur or ()) | {value}))
+
+    def sread(self, node: str, key: Any) -> list:
+        n = self._enter(node)
+        if self.safe:
+            if not self._has_majority(node):
+                raise Unavailable(f"{node} lost quorum")
+            with self._glock:
+                return sorted(self._global.get(key) or (), key=repr)
+        with n.lock:
+            return sorted(n.data.get(key) or (), key=repr)
+
+    def incr(self, node: str, key: Any, delta: Any) -> None:
+        """Increment the counter at ``key`` by ``delta``."""
+        n = self._enter(node)
+        if self.safe:
+            if not self._has_majority(node):
+                raise Unavailable(f"{node} lost quorum")
+            with self._glock:
+                self._global[key] = (self._global.get(key) or 0) + delta
+            return
+        # the sloppy bug: the post-increment VALUE is replicated (last
+        # writer wins), so concurrent/partitioned increments clobber each
+        # other — reads drift below the definite sum
+        self._sloppy_apply(n, key, lambda cur: (cur or 0) + delta)
 
     def _sloppy_apply(self, n: _Node, key: Any, f) -> None:
         """Apply locally, then best-effort replicate to reachable peers —

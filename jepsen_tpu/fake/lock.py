@@ -33,7 +33,7 @@ class FakeLockService(FakeCluster):
     # -- lock RPC ------------------------------------------------------------
     def acquire(self, node: str, name: Any, holder: Any) -> bool:
         n = self._enter(node)
-        if self.mode == "linearizable":
+        if self.safe:
             if not self._has_majority(node):
                 raise Unavailable(f"{node} lost quorum")
             with self._llock:
@@ -49,7 +49,7 @@ class FakeLockService(FakeCluster):
 
     def release(self, node: str, name: Any, holder: Any) -> bool:
         n = self._enter(node)
-        if self.mode == "linearizable":
+        if self.safe:
             if not self._has_majority(node):
                 raise Unavailable(f"{node} lost quorum")
             with self._llock:

@@ -493,6 +493,25 @@ def register_workload(hi: int = 5, seed: Optional[int] = None) -> Mix:
                 Fn(lambda: cas(rng, hi))], seed=seed)
 
 
+class UniqueValues(Generator):
+    """Emit ``{"f": f, "value": n}`` with ``n`` unique and increasing —
+    the stock source for set-add / enqueue workloads whose checkers
+    account for each attempted value individually."""
+
+    def __init__(self, f: str):
+        self._f = f
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+
+    def op(self, test, process):
+        with self._lock:
+            return {"f": self._f, "value": next(self._counter)}
+
+
+def unique_values(f: str) -> UniqueValues:
+    return UniqueValues(f)
+
+
 # -- independent-keys generators (upstream jepsen.independent) ---------------
 
 class SequentialKeys(Generator):

@@ -13,7 +13,7 @@ from typing import Any, Dict, Optional
 
 from jepsen_tpu import client as cl
 from jepsen_tpu import generators as g
-from jepsen_tpu import models, nemesis
+from jepsen_tpu import models, nemesis, util
 from jepsen_tpu.checkers import facade, timeline
 from jepsen_tpu.fake.cluster import FakeTimeout, Unavailable
 from jepsen_tpu.fake.lock import FakeLockService
@@ -87,8 +87,8 @@ def mutex_test(mode: str = "linearizable", *, time_limit: float = 5.0,
                concurrency: int = 5, seed: Optional[int] = None,
                with_nemesis: bool = True, store: bool = False,
                nemesis_interval: float = 0.5,
-               algorithm: str = "auto") -> Dict[str, Any]:
-    node_names = [f"n{i + 1}" for i in range(5)]
+               algorithm: str = "auto", nodes: Any = 5) -> Dict[str, Any]:
+    node_names = util.node_names(nodes)
     svc = FakeLockService(node_names, mode=mode, seed=seed)
     client_gen = g.TimeLimit(time_limit, g.Stagger(0.001, LockWorkload(),
                                                    seed=seed))

@@ -15,7 +15,7 @@ from typing import Any, Dict, Mapping, Optional
 
 from jepsen_tpu import client as cl
 from jepsen_tpu import generators as g
-from jepsen_tpu import independent, models, nemesis
+from jepsen_tpu import independent, models, nemesis, util
 from jepsen_tpu.checkers import facade, perf, timeline
 from jepsen_tpu.fake import FakeCluster, Unavailable
 from jepsen_tpu.fake.cluster import FakeTimeout
@@ -81,8 +81,7 @@ def register_test(mode: str = "linearizable", *,
                   nemesis_interval: float = 1.0) -> Dict[str, Any]:
     """Build the test map (upstream ``etcd/src/.../runner.clj``'s
     ``tests`` fn). ``nodes``: a count or explicit node names."""
-    node_names = (list(nodes) if not isinstance(nodes, int)
-                  else [f"n{i + 1}" for i in range(nodes)])
+    node_names = util.node_names(nodes)
     cluster = FakeCluster(node_names, mode=mode, seed=seed)
     client_gen: g.GenLike = g.Stagger(0.001, workload(seed=seed), seed=seed)
     if n_ops is not None:

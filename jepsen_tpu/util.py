@@ -30,6 +30,17 @@ def majority(n: int) -> int:
     return n // 2 + 1
 
 
+def node_names(nodes) -> list:
+    """Normalize a suite's ``nodes`` argument: a count becomes
+    ``["n1", ..., "nN"]``, a bare string is ONE node name (not a char
+    sequence), anything else is taken as a list of names."""
+    if isinstance(nodes, int):
+        return [f"n{i + 1}" for i in range(nodes)]
+    if isinstance(nodes, str):
+        return [nodes]
+    return list(nodes)
+
+
 def relative_time_nanos(start: float) -> int:
     """Nanoseconds since ``start`` (a ``time.monotonic()`` instant) —
     upstream ``jepsen.util/relative-time-nanos``."""

@@ -242,7 +242,11 @@ def test_worker_crash_bumps_process():
                                with_nemesis=False, store=False,
                                concurrency=2)
     t["client"] = Flaky("r")
-    t["generator"] = g.limit(6, g.Fn(lambda: {"f": "read", "value": None}))
+    # per-process limits: the successor process gets its own fresh ops, so
+    # the crash→successor assertion can't be starved by the other worker
+    # draining a shared limit first (that version was timing-flaky)
+    t["generator"] = g.each(
+        lambda: g.limit(3, g.Fn(lambda: {"f": "read", "value": None})))
     done = core.run(t)
     infos = [op for op in done["history"] if op.type == INFO]
     assert len(infos) == 1

@@ -1,0 +1,125 @@
+"""RabbitMQ-style queue suite E2E (upstream rabbitmq/ — SURVEY.md §2.5)."""
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.checkers import facade
+from jepsen_tpu.fake.broker import Empty, FakeBroker
+from jepsen_tpu.suites import queue
+
+
+def test_broker_safe_fifo():
+    b = FakeBroker(mode="safe")
+    b.enqueue("n1", 1)
+    b.enqueue("n2", 2)
+    assert b.dequeue("n3") == 1
+    assert b.dequeue("n4") == 2
+    with pytest.raises(Empty):
+        b.dequeue("n5")
+    assert b.empty()
+
+
+def test_broker_safe_requires_quorum():
+    from jepsen_tpu.fake.broker import Unavailable
+    b = FakeBroker(mode="safe")
+    for peer in ("n2", "n3", "n4", "n5"):
+        b.drop_link("n1", peer)
+        b.drop_link(peer, "n1")
+    with pytest.raises(Unavailable):
+        b.enqueue("n1", 9)
+    b.enqueue("n2", 9)                      # majority side still works
+    assert b.dequeue("n3") == 9
+
+
+def test_broker_lossy_autoheal_discards_minority_side():
+    b = FakeBroker(mode="lossy")
+    for a in ("n1", "n2"):
+        for x in ("n3", "n4", "n5"):
+            b.drop_link(a, x)
+            b.drop_link(x, a)
+    b.enqueue("n1", "minority-msg")         # acked on the losing side
+    b.enqueue("n3", "majority-msg")
+    b.heal()                                # n1's replica wins autoheal here
+    # winner is the first alive node (n1): the majority side's message is
+    # discarded — an acknowledged enqueue that will never be dequeued
+    seen = []
+    while not b.empty():
+        try:
+            seen.append(b.dequeue("n2"))
+        except Empty:
+            break
+    assert "majority-msg" not in seen
+    assert "minority-msg" in seen
+
+
+def test_broker_lossy_duplicate_delivery():
+    b = FakeBroker(mode="lossy")
+    b.enqueue("n1", "m")                    # replicated everywhere
+    for a in ("n1", "n2"):
+        for x in ("n3", "n4", "n5"):
+            b.drop_link(a, x)
+            b.drop_link(x, a)
+    assert b.dequeue("n1") == "m"           # consumed on one side…
+    assert b.dequeue("n3") == "m"           # …and again on the other
+
+
+def test_queue_run_safe_valid():
+    t = queue.queue_test(mode="safe", time_limit=1.0, seed=11,
+                         with_nemesis=True, nemesis_interval=0.25,
+                         store=False)
+    done = core.run(t)
+    res = done["results"]["results"]
+    assert res["queue"]["valid"] is True
+    assert res["total-queue"]["valid"] is True
+    assert res["total-queue"]["acknowledged-count"] > 0
+    # the drain consumed every acknowledged message
+    assert res["total-queue"]["lost-count"] == 0
+
+
+def test_queue_run_lossy_finds_loss():
+    # Deterministic violation (like the sloppy-mutex test): pre-install a
+    # permanent full partition so both sides accept enqueues (the
+    # enqueue-heavy mix guarantees a backlog on each side), then heal —
+    # autoheal discards one side's backlog — exactly once, when the drain
+    # phase first polls empty().
+    t = queue.queue_test(mode="lossy", time_limit=1.5, seed=23,
+                         with_nemesis=False, store=False, enqueue_weight=3)
+    b = t["cluster"]
+    for a in ("n1", "n2"):
+        for x in ("n3", "n4", "n5"):
+            b.drop_link(a, x)
+            b.drop_link(x, a)
+    orig_empty = b.empty
+
+    def empty_healing_first():
+        if b.dropped:
+            b.heal()                        # idempotent if raced
+        return orig_empty()
+
+    b.empty = empty_healing_first
+    done = core.run(t)
+    res = done["results"]["results"]
+    # enqueues were acked on both sides; autoheal kept only n1's replica,
+    # so the majority side's backlog is acked-but-never-dequeued
+    assert res["total-queue"]["valid"] is False
+    assert res["total-queue"]["lost-count"] > 0
+
+
+def test_checkers_on_handmade_lossy_history():
+    """The queue/total-queue checkers on a hand-written loss+dup history."""
+    from jepsen_tpu.op import Op
+    hist = [
+        Op(process=0, type="invoke", f="enqueue", value="a"),
+        Op(process=0, type="ok", f="enqueue", value="a"),
+        Op(process=1, type="invoke", f="enqueue", value="b"),
+        Op(process=1, type="ok", f="enqueue", value="b"),
+        Op(process=2, type="invoke", f="dequeue", value=None),
+        Op(process=2, type="ok", f="dequeue", value="a"),
+        Op(process=3, type="invoke", f="dequeue", value=None),
+        Op(process=3, type="ok", f="dequeue", value="a"),   # duplicate
+    ]
+    q = facade.queue().check(None, hist)
+    assert q["valid"] is False                  # 'a' overdrawn
+    tq = facade.total_queue().check(None, hist)
+    assert tq["valid"] is False                 # 'b' lost
+    assert tq["lost-count"] == 1
+    assert tq["duplicated-count"] == 1

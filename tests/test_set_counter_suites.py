@@ -1,0 +1,119 @@
+"""Set and counter suite E2E (upstream set/counter workloads — SURVEY.md
+§2.5) against the fake cluster's sadd/sread/incr RPCs."""
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.checkers import facade
+from jepsen_tpu.fake import FakeCluster
+from jepsen_tpu.op import Op
+from jepsen_tpu.suites import counter as counter_suite
+from jepsen_tpu.suites import set_suite
+
+
+# -- fake-cluster RPCs -------------------------------------------------------
+
+def test_cluster_sadd_sread_linearizable():
+    c = FakeCluster(mode="linearizable")
+    c.sadd("n1", "s", 1)
+    c.sadd("n2", "s", 2)
+    assert c.sread("n3", "s") == [1, 2]
+
+
+def test_cluster_sloppy_set_loses_partitioned_adds():
+    c = FakeCluster(mode="sloppy")
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            c.drop_link(a, b)
+            c.drop_link(b, a)
+    c.sadd("n1", "s", "left")
+    c.sadd("n3", "s", "right")
+    c.heal()                                # replicas never merge
+    assert "right" not in c.sread("n1", "s")
+    assert "left" not in c.sread("n3", "s")
+
+
+def test_cluster_incr_linearizable():
+    c = FakeCluster(mode="linearizable")
+    c.incr("n1", "c", 2)
+    c.incr("n2", "c", 3)
+    assert c.read("n3", "c") == 5
+
+
+def test_cluster_sloppy_incr_clobbers_under_partition():
+    c = FakeCluster(mode="sloppy")
+    c.incr("n1", "c", 1)                    # value 1 everywhere
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            c.drop_link(a, b)
+            c.drop_link(b, a)
+    c.incr("n1", "c", 5)                    # left side: 6
+    c.incr("n3", "c", 7)                    # right side: 8
+    c.heal()
+    # neither side ever sees 13 = 1+5+7: increments were clobbered
+    assert c.read("n1", "c") == 6
+    assert c.read("n3", "c") == 8
+
+
+# -- E2E runs ----------------------------------------------------------------
+
+def test_set_run_linearizable_valid():
+    t = set_suite.set_test(mode="linearizable", time_limit=1.0, seed=5,
+                           with_nemesis=True, nemesis_interval=0.25,
+                           store=False)
+    done = core.run(t)
+    res = done["results"]["results"]["set"]
+    assert res["valid"] is True
+    assert res["acknowledged-count"] > 0
+    assert res["lost-count"] == 0
+
+
+def test_set_run_sloppy_finds_lost_adds():
+    t = set_suite.set_test(mode="sloppy", time_limit=1.5, seed=17,
+                           with_nemesis=False, store=False)
+    c = t["cluster"]
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            c.drop_link(a, b)
+            c.drop_link(b, a)
+    done = core.run(t)
+    res = done["results"]["results"]["set"]
+    # adds acked on the side the final read did NOT land on are lost
+    assert res["valid"] is False
+    assert res["lost-count"] > 0
+
+
+def test_counter_run_linearizable_valid():
+    t = counter_suite.counter_test(mode="linearizable", time_limit=1.0,
+                                   seed=29, with_nemesis=True,
+                                   nemesis_interval=0.25, store=False)
+    done = core.run(t)
+    res = done["results"]["results"]["counter"]
+    assert res["valid"] is True
+    assert res["reads-checked"] > 0
+
+
+def test_counter_run_sloppy_finds_lost_increments():
+    t = counter_suite.counter_test(mode="sloppy", time_limit=1.5, seed=31,
+                                   with_nemesis=False, store=False)
+    c = t["cluster"]
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            c.drop_link(a, b)
+            c.drop_link(b, a)
+    done = core.run(t)
+    res = done["results"]["results"]["counter"]
+    assert res["valid"] is False
+
+
+def test_counter_checker_handmade_interval():
+    hist = [
+        Op(process=0, type="invoke", f="add", value=2),
+        Op(process=0, type="ok", f="add", value=2),
+        Op(process=1, type="invoke", f="read", value=None),
+        Op(process=1, type="ok", f="read", value=2),     # fine
+        Op(process=0, type="invoke", f="read", value=None),
+        Op(process=0, type="ok", f="read", value=7),     # impossible
+    ]
+    res = facade.counter().check(None, hist)
+    assert res["valid"] is False
+    assert res["error-count"] == 1
