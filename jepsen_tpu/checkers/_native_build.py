@@ -1,0 +1,75 @@
+"""Shared build/load scaffolding for the on-demand C++ helpers under
+``native/`` (used by :mod:`.wgl_native` and :mod:`.preproc_native`).
+
+Each helper is one translation unit compiled with g++ into
+``jepsen_tpu/_build/lib*.so`` the first time it is needed; callers fall
+back to their pure-Python paths when the toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_build")
+
+
+class NativeLib:
+    """One lazily-built shared library.
+
+    ``declare(lib)`` runs once after loading to set ctypes
+    restype/argtypes. Build failures are cached; :meth:`load` then
+    returns None forever (callers keep their Python fallback).
+    """
+
+    def __init__(self, src_name: str, so_name: str,
+                 declare: Callable[[ctypes.CDLL], None]) -> None:
+        self._src = os.path.join(_NATIVE_DIR, src_name)
+        self._so = os.path.join(_BUILD_DIR, so_name)
+        self._declare = declare
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self.error: Optional[str] = None
+
+    def _build(self) -> Optional[str]:
+        try:
+            if (os.path.exists(self._so) and
+                    os.path.getmtime(self._so) >= os.path.getmtime(self._src)):
+                return None
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            # per-process tmp name: concurrent builders each write their
+            # own file and the os.replace install stays atomic
+            tmp = f"{self._so}.{os.getpid()}.tmp"
+            p = subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 "-o", tmp, self._src],
+                capture_output=True, text=True, timeout=120)
+            if p.returncode != 0:
+                return f"g++ failed: {p.stderr[:500]}"
+            os.replace(tmp, self._so)
+            return None
+        except FileNotFoundError:
+            return "g++ not found"
+        except Exception as e:                          # noqa: BLE001
+            return f"{type(e).__name__}: {e}"
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        with self._lock:
+            if self._lib is not None or self.error is not None:
+                return self._lib
+            err = self._build()
+            if err is not None:
+                self.error = err
+                return None
+            lib = ctypes.CDLL(self._so)
+            self._declare(lib)
+            self._lib = lib
+            return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None

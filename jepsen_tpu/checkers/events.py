@@ -65,63 +65,71 @@ class EventStream:
         return len(self.kind)
 
 
-def _noop_column(table: np.ndarray, oid: int) -> bool:
-    """True if op ``oid`` never changes any state: every transition is either
-    identity or inconsistent. Firing such an op is unobservable, so a crashed
-    instance of it (never constrained by a return) is irrelevant."""
-    col = table[:, oid]
-    states = np.arange(table.shape[0], dtype=col.dtype)
-    return bool(np.all((col == states) | (col == -1)))
-
-
 def build(packed: PackedHistory, memo: Memo, *,
           max_slots: int = 20,
           drop_noop_crashed: bool = True) -> EventStream:
     """Assign slots and linearize the (invoke, return) events of ``packed``
     into a flat stream. Raises :class:`ConcurrencyOverflow` if more than
-    ``max_slots`` ops are ever pending at once."""
+    ``max_slots`` ops are ever pending at once.
+
+    Event-array construction is vectorized NumPy; the inherently
+    sequential lowest-free-slot assignment runs in C++
+    (``native/preproc.cpp``) with a Python fallback."""
+    from jepsen_tpu.checkers import preproc_native
+
     n = packed.n
-    dropped = 0
-    # (rank, is_return, entry) triples; returns sort after invokes via rank
-    # (ranks are distinct history indices, so no ties are possible).
-    evs = []
-    for i in range(n):
-        crashed = bool(packed.crashed[i])
-        if crashed and drop_noop_crashed and \
-                _noop_column(memo.table, int(packed.op_id[i])):
-            dropped += 1
-            continue
-        evs.append((int(packed.inv_ev[i]), KIND_INVOKE, i))
-        if not crashed:
-            evs.append((int(packed.ret_ev[i]), KIND_RETURN, i))
-    evs.sort()
-    E = len(evs)
-    kind = np.full(E, KIND_PAD, np.int32)
-    slot = np.zeros(E, np.int32)
-    opid = np.full(E, -1, np.int32)
-    entry = np.zeros(E, np.int32)
-    free: list = []             # min-heap: reuse lowest slots first
-    hi = 0                      # next never-used slot
-    slot_of = {}
-    for e, (_, k, i) in enumerate(evs):
-        kind[e] = k
-        entry[e] = i
-        if k == KIND_INVOKE:
-            s = heapq.heappop(free) if free else hi
-            if s == hi:
-                hi += 1
-                if hi > max_slots:
-                    raise ConcurrencyOverflow(
-                        f"history needs >{max_slots} pending-op slots")
-            slot_of[i] = s
-            slot[e] = s
-            opid[e] = int(packed.op_id[i])
-        else:
-            s = slot_of.pop(i)
-            slot[e] = s
-            heapq.heappush(free, s)
+    crashed = np.asarray(packed.crashed, bool)
+    if drop_noop_crashed and n:
+        tbl = memo.table
+        states = np.arange(tbl.shape[0], dtype=tbl.dtype)[:, None]
+        noop_op = np.all((tbl == states) | (tbl == -1), axis=0)
+        drop = crashed & noop_op[packed.op_id]
+    else:
+        drop = np.zeros(n, bool)
+    dropped = int(drop.sum())
+    idx = np.nonzero(~drop)[0].astype(np.int32)
+    ridx = idx[~crashed[idx]]
+    # ranks are distinct history indices, so returns order unambiguously
+    ranks = np.concatenate([packed.inv_ev[idx], packed.ret_ev[ridx]])
+    kinds = np.concatenate([
+        np.full(len(idx), KIND_INVOKE, np.int32),
+        np.full(len(ridx), KIND_RETURN, np.int32)])
+    entries = np.concatenate([idx, ridx]).astype(np.int32)
+    order = np.argsort(ranks, kind="stable")
+    kind = kinds[order]
+    entry = entries[order]
+    E = len(kind)
+    opid = np.where(kind == KIND_INVOKE,
+                    packed.op_id[entry].astype(np.int32),
+                    np.int32(-1)).astype(np.int32)
+    native = preproc_native.assign_slots(kind, entry, n, max_slots)
+    if native is not None:
+        slot, hi = native
+        if hi < 0:
+            raise ConcurrencyOverflow(
+                f"history needs >{max_slots} pending-op slots")
+    else:
+        slot = np.zeros(E, np.int32)
+        free: list = []         # min-heap: reuse lowest slots first
+        hi = 0                  # next never-used slot
+        slot_of = {}
+        for e in range(E):
+            i = int(entry[e])
+            if kind[e] == KIND_INVOKE:
+                s = heapq.heappop(free) if free else hi
+                if s == hi:
+                    hi += 1
+                    if hi > max_slots:
+                        raise ConcurrencyOverflow(
+                            f"history needs >{max_slots} pending-op slots")
+                slot_of[i] = s
+                slot[e] = s
+            else:
+                s = slot_of.pop(i)
+                slot[e] = s
+                heapq.heappush(free, s)
     return EventStream(kind=kind, slot=slot, opid=opid, entry=entry,
-                       W=hi, n_events=E, n_entries=n - dropped,
+                       W=int(hi), n_events=E, n_entries=n - dropped,
                        n_dropped_crashed=dropped)
 
 
@@ -170,8 +178,18 @@ class ReturnStream:
 
 def returns_view(stream: EventStream) -> ReturnStream:
     """Project an event stream to its return events with per-return
-    pending-op snapshots."""
+    pending-op snapshots (C++ scan when available, Python fallback)."""
+    from jepsen_tpu.checkers import preproc_native
+
     W = max(stream.W, 1)
+    native = preproc_native.returns_view(
+        stream.kind, stream.slot, stream.opid, stream.entry, W,
+        stream.n_events)
+    if native is not None:
+        ret_slot, slot_ops, ret_event, ret_entry, R = native
+        return ReturnStream(ret_slot=ret_slot, slot_ops=slot_ops,
+                            ret_event=ret_event, ret_entry=ret_entry,
+                            W=W, n_returns=R)
     n_ret = int(np.sum(stream.kind[:stream.n_events] == KIND_RETURN))
     ret_slot = np.full(n_ret, -1, np.int32)
     slot_ops = np.full((n_ret, W), -1, np.int32)

@@ -1,0 +1,83 @@
+"""ctypes bridge to ``native/preproc.cpp`` — the C++ fast path for
+event-stream preprocessing (slot assignment + returns projection).
+
+:mod:`jepsen_tpu.checkers.events` calls :func:`assign_slots` /
+:func:`returns_view` when the library builds, and falls back to its
+pure-Python scans otherwise (same contract as
+:mod:`jepsen_tpu.checkers.wgl_native` for the search itself).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu.checkers._native_build import NativeLib
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.jt_assign_slots.restype = ctypes.c_int64
+    lib.jt_assign_slots.argtypes = [
+        ctypes.c_int64, _I32P, _I32P, ctypes.c_int64,
+        ctypes.c_int32, _I32P]
+    lib.jt_returns_view.restype = ctypes.c_int64
+    lib.jt_returns_view.argtypes = [
+        ctypes.c_int64, _I32P, _I32P, _I32P, _I32P,
+        ctypes.c_int32, _I32P, _I32P, _I32P, _I32P]
+
+
+_NATIVE = NativeLib("preproc.cpp", "libjepsen_preproc.so", _declare)
+_load = _NATIVE.load
+
+
+def available() -> bool:
+    return _NATIVE.available()
+
+
+def _p(a: np.ndarray) -> "ctypes.pointer":
+    return a.ctypes.data_as(_I32P)
+
+
+def assign_slots(kind: np.ndarray, entry: np.ndarray, n_entries: int,
+                 max_slots: int) -> Optional[Tuple[np.ndarray, int]]:
+    """Returns ``(slot[E], W)``; None if the native lib is unavailable.
+    Raises the same overflow condition as the Python path by returning
+    ``W = -1`` sentinel (callers translate to ConcurrencyOverflow)."""
+    lib = _load()
+    if lib is None:
+        return None
+    E = len(kind)
+    kind = np.ascontiguousarray(kind, np.int32)
+    entry = np.ascontiguousarray(entry, np.int32)
+    out = np.empty(E, np.int32)
+    W = int(lib.jt_assign_slots(E, _p(kind), _p(entry),
+                                int(n_entries), int(max_slots), _p(out)))
+    return out, W
+
+
+def returns_view(kind: np.ndarray, slot: np.ndarray, opid: np.ndarray,
+                 entry: np.ndarray, W: int, n_events: int
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                     np.ndarray, np.ndarray, int]]:
+    """Returns ``(ret_slot, slot_ops, ret_event, ret_entry, R)``; None
+    if the native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    kind = np.ascontiguousarray(kind[:n_events], np.int32)
+    slot = np.ascontiguousarray(slot[:n_events], np.int32)
+    opid = np.ascontiguousarray(opid[:n_events], np.int32)
+    entry = np.ascontiguousarray(entry[:n_events], np.int32)
+    n_ret_max = int(np.sum(kind == 1))
+    ret_slot = np.empty(n_ret_max, np.int32)
+    slot_ops = np.empty((n_ret_max, max(W, 1)), np.int32)
+    ret_event = np.empty(n_ret_max, np.int32)
+    ret_entry = np.empty(n_ret_max, np.int32)
+    R = int(lib.jt_returns_view(
+        n_events, _p(kind), _p(slot), _p(opid), _p(entry),
+        max(W, 1), _p(ret_slot), _p(slot_ops), _p(ret_event),
+        _p(ret_entry)))
+    return ret_slot[:R], slot_ops[:R], ret_event[:R], ret_entry[:R], R

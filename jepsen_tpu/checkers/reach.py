@@ -50,6 +50,7 @@ dense set cannot produce false verdicts.
 from __future__ import annotations
 
 import functools
+import logging
 import time as _time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -334,9 +335,44 @@ _FAST_MAX_ELEMS = 1 << 22
 _FAST_MAX_P = 1 << 24
 
 
+def _use_pallas() -> bool:
+    """Single-history returns walks run as one fused Pallas kernel on TPU
+    (:mod:`.reach_pallas`) — the XLA while-loop version dispatches ~25
+    tiny ops per return and is ~2.4x slower at the headline config. Set
+    ``JEPSEN_TPU_NO_PALLAS=1`` to force the XLA path."""
+    import os
+    if os.environ.get("JEPSEN_TPU_NO_PALLAS"):
+        return False
+    try:
+        import jax
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:                                   # noqa: BLE001
+        return False
+
+
 def _fast_ok(S_pad: int, W: int, M: int, n_ops: int) -> bool:
     return (S_pad * max(W, 1) * M <= _FAST_MAX_ELEMS
             and (n_ops + 1) * S_pad * S_pad <= _FAST_MAX_P)
+
+
+# the pallas kernel keeps P plus three [M, S] f32 buffers wholly in VMEM
+# (~16 MiB/core); beyond this budget the XLA walk (P in HBM) takes over
+_PALLAS_MAX_VMEM_BYTES = 8 << 20
+
+
+def _pallas_fits(S_pad: int, M: int, n_ops: int) -> bool:
+    vmem = 4 * ((n_ops + 1) * S_pad * S_pad + 3 * M * S_pad)
+    return vmem <= _PALLAS_MAX_VMEM_BYTES
+
+
+@functools.cache
+def _warn_pallas_failed(err: str) -> None:
+    """Surface each distinct Pallas failure once — a permanent kernel
+    breakage silently degrading every check to the slower XLA walk should
+    not be invisible."""
+    logging.getLogger("jepsen.reach").warning(
+        "pallas returns-walk failed (%s); falling back to the XLA walk",
+        err)
 
 
 @functools.cache
@@ -359,6 +395,21 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1)).bit_length()
 
 
+def _bucket(x: int, grain: int = 8) -> int:
+    """Round up to ``m·2^e`` with 8 mantissa steps per octave (≤12.5%
+    padding), then to a multiple of ``grain``. Compared to next-pow-2
+    (worst case +100% padded work) this keeps the jit shape-cache small
+    (≤8 shapes per octave) while nearly eliminating padding overhead —
+    on a 100k-op history the returns walk is the whole check, so pow-2
+    padding alone cost ~40% of wall-clock."""
+    x = max(int(x), 1)
+    if x <= 8 * grain:
+        return -(-x // grain) * grain
+    e = x.bit_length() - 4              # mantissa in [8, 16]
+    m = -(-x >> e)
+    return -(-(m << e) // grain) * grain
+
+
 def _pad_table(memo: Memo, S_pad: int, O_pad: int) -> np.ndarray:
     """Transition table padded to [S_pad, O_pad+1]; everything outside the
     real region (including the sentinel last column for opid=-1) is -1."""
@@ -371,9 +422,9 @@ def _pad_table(memo: Memo, S_pad: int, O_pad: int) -> np.ndarray:
 def _prep(model: Model, packed: h.PackedHistory, *,
           max_states: int, max_slots: int, max_dense: int,
           e_bucket: int = 64):
-    """Shared host-side pipeline: memo table + slotted event stream, padded
-    to power-of-two buckets so jit compilations are reused across histories
-    of similar size."""
+    """Shared host-side pipeline: memo table + slotted event stream, with
+    the event axis padded to :func:`_bucket` sizes (8 per octave) so jit
+    compilations are reused across histories of similar size."""
     memo = build_memo(model, packed, max_states=max_states)
     stream = ev.build(packed, memo, max_slots=max_slots)
     S = memo.n_states
@@ -383,7 +434,7 @@ def _prep(model: Model, packed: h.PackedHistory, *,
         raise DenseOverflow(
             f"dense config space {S_pad}x{M} exceeds budget {max_dense}")
     O_pad = max(2, _next_pow2(memo.n_ops))
-    E_pad = max(e_bucket, _next_pow2(stream.E))
+    E_pad = max(e_bucket, _bucket(stream.E, e_bucket))
     stream = ev.pad(stream, E_pad)
     T = _pad_table(memo, S_pad, O_pad)
     return memo, stream, T, S_pad, M
@@ -437,8 +488,28 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     W = max(stream.W, 1)
     if _fast_ok(S_pad, W, M, memo.n_ops):
         rs = ev.returns_view(stream)
-        rs = ev.pad_returns(rs, max(64, _next_pow2(rs.n_returns)))
-        P = jnp.asarray(_build_P(memo, S_pad))
+        P_np = _build_P(memo, S_pad)
+        if _use_pallas() and _pallas_fits(S_pad, M, memo.n_ops):
+            from jepsen_tpu.checkers import reach_pallas
+            R0_np = np.zeros((S_pad, M), bool)
+            R0_np[0, 0] = True
+            try:
+                dead, _ = reach_pallas.walk_returns(
+                    P_np, rs.ret_slot, rs.slot_ops, R0_np)
+            except Exception as e:                      # noqa: BLE001
+                # Mosaic lowering / VMEM allocation failure — the XLA
+                # walk below handles every history the fast path admits
+                _warn_pallas_failed(repr(e))
+                dead = None
+            if dead is not None:
+                elapsed = _time.monotonic() - t0
+                if dead < 0:
+                    return _result_valid("reach-pallas", stream, memo,
+                                         elapsed)
+                return _result_invalid("reach-pallas", stream, memo, packed,
+                                       int(rs.ret_event[dead]), elapsed)
+        rs = ev.pad_returns(rs, max(64, _bucket(rs.n_returns, _UNROLL)))
+        P = jnp.asarray(P_np)
         xc, bm = _xor_bitmask(W, M)
         xc, bm = jnp.asarray(xc), jnp.asarray(bm)
         R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
@@ -499,7 +570,7 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
         fast = _fast_ok(S_pad, W, M, O_pad)
         if fast:
             rss = [ev.returns_view(preps[i][1]) for i in live]
-            R_pad = max(64, _next_pow2(max(r.n_returns for r in rss)))
+            R_pad = max(64, _bucket(max(r.n_returns for r in rss), _UNROLL))
             rss = [ev.pad_returns(r, R_pad, W) for r in rss]
             xor_cols, bitmask = _xor_bitmask(W, M)
             Ps, R0s = [], []

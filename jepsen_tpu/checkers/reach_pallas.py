@@ -1,0 +1,224 @@
+"""Pallas TPU kernel for the dense-reachability returns walk.
+
+The XLA fast path (:func:`jepsen_tpu.checkers.reach._walk_returns`)
+executes each return event as ~25 separate tiny fused HLO ops inside a
+``lax.while_loop`` — at the headline config (S=8 states, W=5 slots,
+M=32 masks) the walk is pure dispatch overhead: every op touches ≤1 KB.
+This kernel runs the ENTIRE walk as one ``pallas_call``: the config set
+``R`` (laid out ``[M, S]`` f32 0/1) lives in a VMEM scratch register
+across a sequential grid; return-slot / pending-op metadata streams in
+as SMEM blocks; each fire pass is ONE fused MXU matmul
+``R[M, S] @ G_all[S, W·S]`` applying every pending op at once.
+
+Semantics are identical to ``_walk_returns`` (upstream analogue:
+``knossos/src/knossos/linear.clj``'s per-event config-set advance):
+
+- per return, monotone Jacobi fire passes run to the between-returns
+  fixpoint, detected by popcount stability and capped at W;
+- firing slot ``j`` maps configs with bit j clear into their bit-set
+  images through ``G = P[slot_ops[r, j]]`` — expressed as static
+  half-splits (no scatters/gathers on the mask axis);
+- the return projection keeps configs that fired the returning slot and
+  clears its bit — a blend of the W static projections by scalar 0/1
+  indicator multiplies (Mosaic cannot legalize scalar-predicate vector
+  selects);
+- an emptied config set at return ``r`` is a linearizability violation;
+  the kernel records the first such ``r`` in an SMEM cell (the set
+  stays empty from then on — firing and projection preserve emptiness —
+  so no early exit is needed and the answer is exact).
+
+The kernel is exact (no fingerprint hashing) like the rest of the
+engine. ``interpret=True`` runs it on CPU for differential tests.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+
+def _make_kernel(B: int, W: int, M: int, S: int, O1: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(rlim_ref, ret_slot_ref, slot_ops_ref, R0_ref, P_ref,
+               Rout_ref, dead_ref, R_scr, dead_scr):
+        step = pl.program_id(0)
+        nsteps = pl.num_programs(0)
+
+        @pl.when(step == 0)
+        def _init():
+            R_scr[:] = R0_ref[:]
+            dead_scr[0] = jnp.int32(-1)
+
+        def do_return(k, _):
+            r = step * B + k
+            j = ret_slot_ref[k]
+            R = R_scr[:]
+            # -- W fire passes (static unroll) --------------------------
+            # One gather of each pending op's transition matrix per
+            # return, and ONE fused [M,S]@[S,W·S] matmul per pass that
+            # computes every config's image under every slot's op — the
+            # per-slot loop then only reshuffles halves (VPU). Each pass
+            # ORs all slot contributions computed from the pass-start R
+            # (Jacobi), exactly `reach._ret_step`'s einsum semantics.
+            Gs = []
+            for jj in range(W):
+                o = slot_ops_ref[k * W + jj]
+                o = jnp.where(o < 0, O1 - 1, o)
+                Gs.append(P_ref[o])                   # [S, S] f32
+            G_all = jnp.concatenate(Gs, axis=1)       # [S, W*S]
+
+            # Passes run until the config count stops growing (fire is
+            # monotone, so popcount stability == fixpoint), capped at W
+            # (a fire chain sets ≥1 new bit per pass). The projected set
+            # from the previous return is already closed under its
+            # still-pending ops, so typically only the 1-2 ops invoked
+            # since then fire and this exits after ~2 passes instead of
+            # the static worst case W.
+            def fire_cond(c):
+                Rv, prev, it = c
+                return jnp.logical_and(it < W, jnp.sum(Rv) > prev)
+
+            def fire_body(c):
+                Rv, prev, it = c
+                s = jnp.sum(Rv)
+                F = jnp.dot(Rv, G_all,
+                            preferred_element_type=jnp.float32)
+                for jj in range(W):
+                    Fj = F[:, jj * S:(jj + 1) * S]
+                    half, blk = M >> (jj + 1), 1 << jj
+                    Rr = Rv.reshape(half, 2, blk, S)
+                    Fr = Fj.reshape(half, 2, blk, S)
+                    hi = jnp.maximum(
+                        Rr[:, 1], (Fr[:, 0] > 0.5).astype(jnp.float32))
+                    # no scatter in Mosaic: rebuild via stacked halves
+                    Rv = jnp.stack([Rr[:, 0], hi],
+                                   axis=1).reshape(M, S)
+                return Rv, s, it + 1
+
+            R, _, _ = jax.lax.while_loop(
+                fire_cond, fire_body, (R, jnp.float32(-1.0), 0))
+
+            # -- projection on the (dynamic) returning slot -------------
+            # Scalar-predicate vector selects (jnp.where / lax.switch
+            # residues) don't legalize in Mosaic, so blend all W static
+            # projections with scalar 0/1 multiplies instead: exactly one
+            # indicator is hot (or none for j = -1 padding → identity).
+            acc = R * (j < 0).astype(jnp.float32)
+            for jj in range(W):
+                half, blk = M >> (jj + 1), 1 << jj
+                Rr = R.reshape(half, 2, blk, S)
+                taken = Rr[:, 1]
+                proj = jnp.stack([taken, jnp.zeros_like(taken)],
+                                 axis=1).reshape(M, S)
+                acc = acc + proj * (j == jj).astype(jnp.float32)
+            R = acc
+
+            @pl.when(jnp.logical_and(dead_scr[0] < 0,
+                                     jnp.logical_and(jnp.sum(R) < 0.5,
+                                                     r < rlim_ref[0])))
+            def _mark_dead():
+                dead_scr[0] = r
+
+            R_scr[:] = R
+            return 0
+
+        jax.lax.fori_loop(0, B, do_return, 0)
+
+        @pl.when(step == nsteps - 1)
+        def _finish():
+            Rout_ref[:] = R_scr[:]
+            dead_ref[0] = dead_scr[0]
+
+    return kernel
+
+
+@functools.cache
+def _walk_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
+               interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = _make_kernel(B, W, M, S, O1)
+    call = pl.pallas_call(
+        kernel,
+        grid=(R_pad // B,),
+        in_specs=[
+            # the real (unpadded) return count, as a runtime scalar so
+            # histories of different length share one compiled kernel
+            pl.BlockSpec((1,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((B,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            # flat [B*W] — a 2-D SMEM window pads each row to the 1 KB
+            # tile and blows the 1 MB SMEM budget
+            pl.BlockSpec((B * W,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((M, S), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((O1, S, S), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((M, S), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, S), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((M, S), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+_BLOCK = 1024     # XLA tiles 1-D s32 SMEM operands at T(1024); the block
+                  # shape must match or Mosaic rejects the layout
+
+
+def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
+                 slot_ops: np.ndarray, R0_sm: np.ndarray, *,
+                 interpret: bool = False) -> Tuple[int, np.ndarray]:
+    """Run the full returns walk in one kernel.
+
+    ``P`` f32[O1, S, S] (last row all-zero sentinel); ``ret_slot``
+    i32[R]; ``slot_ops`` i32[R, W]; ``R0_sm`` bool[S, M] (the engine's
+    native layout). Returns ``(dead, R_final[S, M] bool)`` where
+    ``dead`` is the first return index at which the config set emptied,
+    or -1 if the history prefix is linearizable.
+    """
+    import jax.numpy as jnp
+
+    O1, S, _ = P.shape
+    R_real = int(ret_slot.shape[0])
+    W = int(slot_ops.shape[1])
+    M = R0_sm.shape[1]
+    from jepsen_tpu.checkers.reach import _bucket
+
+    B = _BLOCK
+    # bucket the padded length (8 shapes per octave) so same-sized
+    # histories share a compiled kernel; pad rows are cheap identities
+    R_pad = max(B, _bucket(-(-R_real // B) * B, B))
+    if R_pad != R_real:
+        ret_slot = np.pad(ret_slot, (0, R_pad - R_real),
+                          constant_values=-1)
+        slot_ops = np.pad(slot_ops, ((0, R_pad - R_real), (0, 0)),
+                          constant_values=-1)
+    call = _walk_call(B, W, M, S, O1, R_pad, interpret)
+    R_out, dead = call(jnp.asarray(np.array([R_real], np.int32)),
+                       jnp.asarray(ret_slot),
+                       jnp.asarray(slot_ops.reshape(-1)),
+                       jnp.asarray(R0_sm.T, jnp.float32),
+                       jnp.asarray(P, jnp.float32))
+    return int(dead[0]), np.asarray(R_out, bool).T

@@ -10,85 +10,41 @@ thread stop the search from Python (upstream ``knossos.search/abort!``).
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
 from jepsen_tpu import history as h
+from jepsen_tpu.checkers._native_build import NativeLib
 from jepsen_tpu.models import Model
 from jepsen_tpu.models.memo import memo as build_memo
 from jepsen_tpu.op import Op
-
-_SRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native", "wgl.cpp")
-_BUILD_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "_build")
-_SO = os.path.join(_BUILD_DIR, "libjepsen_wgl.so")
-
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_error: Optional[str] = None
 
 INF = 1 << 60
 _CAUSES = {0: None, 1: "timeout", 2: "config-set-explosion", 3: "aborted"}
 
 
-def _build() -> Optional[str]:
-    """Compile the shared library if missing/stale. Returns an error
-    message, or None on success."""
-    try:
-        if (os.path.exists(_SO)
-                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-            return None
-        os.makedirs(_BUILD_DIR, exist_ok=True)
-        p = subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-             "-o", _SO + ".tmp", _SRC],
-            capture_output=True, text=True, timeout=120)
-        if p.returncode != 0:
-            return f"g++ failed: {p.stderr[:500]}"
-        os.replace(_SO + ".tmp", _SO)
-        return None
-    except FileNotFoundError:
-        return "g++ not found"
-    except Exception as e:                          # noqa: BLE001
-        return f"{type(e).__name__}: {e}"
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.wgl_check.restype = ctypes.c_int64
+    lib.wgl_check.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
 
 
-def load() -> Optional[ctypes.CDLL]:
-    """Build (once) and load the library; None if unavailable."""
-    global _lib, _build_error
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if _build_error is not None:
-            return None
-        err = _build()
-        if err is not None:
-            _build_error = err
-            return None
-        lib = ctypes.CDLL(_SO)
-        lib.wgl_check.restype = ctypes.c_int64
-        lib.wgl_check.argtypes = [
-            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
-            ctypes.c_int32, ctypes.c_int64, ctypes.c_double,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
-        _lib = lib
-        return _lib
+_NATIVE = NativeLib("wgl.cpp", "libjepsen_wgl.so", _declare)
+load = _NATIVE.load
 
 
 def available() -> bool:
-    return load() is not None
+    return _NATIVE.available()
 
 
 def build_error() -> Optional[str]:
     load()
-    return _build_error
+    return _NATIVE.error
 
 
 class AbortFlag:
@@ -123,7 +79,7 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                  abort_flag: Optional[AbortFlag] = None) -> Dict[str, Any]:
     lib = load()
     if lib is None:
-        raise RuntimeError(f"native WGL unavailable: {_build_error}")
+        raise RuntimeError(f"native WGL unavailable: {_NATIVE.error}")
     n = packed.n
     if n == 0 or packed.n_ok == 0:
         return {"valid": True, "engine": "wgl-native",

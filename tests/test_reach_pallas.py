@@ -1,0 +1,127 @@
+"""Differential tests for the Pallas returns-walk kernel (interpret mode
+on CPU; on TPU the same kernel is the default single-history fast path).
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from jepsen_tpu import fixtures, models
+from jepsen_tpu.checkers import events as ev
+from jepsen_tpu.checkers import reach, reach_pallas
+from jepsen_tpu.history import pack
+
+
+def _operands(model, history):
+    packed = pack(history)
+    memo, stream, T, S_pad, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20, max_dense=1 << 22)
+    W = max(stream.W, 1)
+    rs = ev.returns_view(stream)
+    P = reach._build_P(memo, S_pad)
+    R0 = np.zeros((S_pad, M), bool)
+    R0[0, 0] = True
+    return memo, stream, rs, P, R0, W, M, S_pad
+
+
+def _xla_walk(P, rs, R0, W, M):
+    rs_p = ev.pad_returns(rs, max(reach._UNROLL,
+                                  reach._bucket(rs.n_returns,
+                                                reach._UNROLL)))
+    xc, bm = reach._xor_bitmask(W, M)
+    ptr, Rf, alive, Rb = reach._jitted_walk_returns()(
+        jnp.asarray(P), jnp.asarray(xc), jnp.asarray(bm),
+        jnp.asarray(rs_p.ret_slot), jnp.asarray(rs_p.slot_ops),
+        jnp.asarray(R0))
+    return rs_p, int(ptr), np.asarray(Rf, bool), bool(alive), Rb
+
+
+@pytest.mark.parametrize("kind,model_fn", [
+    ("cas", models.cas_register),
+    ("register", models.register),
+    ("mutex", models.mutex),
+])
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_pallas_matches_xla_walk(kind, model_fn, corrupt):
+    mismatches = 0
+    corrupted_any = False
+    for seed in range(4):
+        h = fixtures.gen_history(kind, n_ops=40, processes=3, seed=seed)
+        if corrupt:
+            try:
+                h = fixtures.corrupt(h, seed=seed)
+                corrupted_any = True
+            except ValueError:      # e.g. mutex histories have no reads
+                continue
+        memo, stream, rs, P, R0, W, M, S_pad = _operands(model_fn(), h)
+        rs_p, ptr, Rf, alive, Rb = _xla_walk(P, rs, R0, W, M)
+        dead, R_out = reach_pallas.walk_returns(
+            P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+        assert (dead < 0) == alive
+        if alive:
+            assert np.array_equal(R_out, Rf)
+        else:
+            # dead-event agreement with the XLA walk's refine step
+            xc, bm = reach._xor_bitmask(W, M)
+            de_xla = reach._refine_dead(jnp.asarray(P), jnp.asarray(xc),
+                                        jnp.asarray(bm), rs_p, ptr, Rb)
+            assert int(rs.ret_event[dead]) == de_xla
+            mismatches += 1
+    if corrupt and corrupted_any:
+        assert mismatches > 0      # corruption produced real violations
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_pallas_multiblock_grid(monkeypatch, corrupt):
+    """Shrink _BLOCK so the grid has many sequential steps, covering the
+    R_scr/dead_scr carry across steps and the r = step*B + k indexing that
+    single-block histories never reach."""
+    monkeypatch.setattr(reach_pallas, "_BLOCK", 8)
+    h = fixtures.gen_history("cas", n_ops=120, processes=4, seed=9)
+    if corrupt:
+        h = fixtures.corrupt(h, seed=2)
+    memo, stream, rs, P, R0, W, M, S_pad = _operands(
+        models.cas_register(), h)
+    assert rs.n_returns > 3 * 8          # genuinely multi-block
+    rs_p, ptr, Rf, alive, Rb = _xla_walk(P, rs, R0, W, M)
+    dead, R_out = reach_pallas.walk_returns(
+        P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+    assert (dead < 0) == alive
+    if alive:
+        assert np.array_equal(R_out, Rf)
+    else:
+        xc, bm = reach._xor_bitmask(W, M)
+        de_xla = reach._refine_dead(jnp.asarray(P), jnp.asarray(xc),
+                                    jnp.asarray(bm), rs_p, ptr, Rb)
+        assert int(rs.ret_event[dead]) == de_xla
+
+
+def test_pallas_end_to_end_via_check_packed(monkeypatch):
+    """Force the pallas path through check_packed (interpret on CPU) and
+    compare verdicts against the default engine."""
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(
+        reach_pallas, "_walk_call",
+        reach_pallas._walk_call.__wrapped__
+        if hasattr(reach_pallas._walk_call, "__wrapped__")
+        else reach_pallas._walk_call)
+
+    import functools
+    orig = reach_pallas.walk_returns
+    monkeypatch.setattr(reach_pallas, "walk_returns",
+                        functools.partial(orig, interpret=True))
+
+    model = models.cas_register()
+    good = fixtures.gen_history("cas", n_ops=60, processes=4, seed=3)
+    res = reach.check_packed(model, pack(good))
+    assert res["valid"] is True
+    assert res["engine"] == "reach-pallas"
+
+    bad = fixtures.corrupt(good, seed=3)
+    res_bad = reach.check_packed(model, pack(bad))
+    monkeypatch.setattr(reach, "_use_pallas", lambda: False)
+    ref = reach.check_packed(model, pack(bad))
+    assert res_bad["valid"] is False
+    assert res_bad["op"] == ref["op"]
+    assert res_bad["dead-event"] == ref["dead-event"]
