@@ -59,8 +59,10 @@ import numpy as np
 from jepsen_tpu import history as h
 from jepsen_tpu.checkers import events as ev
 from jepsen_tpu.models import Model
-from jepsen_tpu.models.memo import Memo, StateExplosion, memo as build_memo
+from jepsen_tpu.models.memo import (
+    Memo, StateExplosion, memo as build_memo, memo_ops)
 from jepsen_tpu.op import Op
+from jepsen_tpu.util import hashable
 
 
 class DenseOverflow(RuntimeError):
@@ -359,10 +361,26 @@ def _fast_ok(S_pad: int, W: int, M: int, n_ops: int) -> bool:
 # (~16 MiB/core); beyond this budget the XLA walk (P in HBM) takes over
 _PALLAS_MAX_VMEM_BYTES = 8 << 20
 
+# below this many returns the XLA walk wins: the pallas call's fixed cost
+# (kernel dispatch + SMEM-result round-trips over the device tunnel,
+# ~0.15s measured) exceeds the XLA walk's ~4.5us/return advantage
+_PALLAS_MIN_RETURNS = 8192
+
 
 def _pallas_fits(S_pad: int, M: int, n_ops: int) -> bool:
     vmem = 4 * ((n_ops + 1) * S_pad * S_pad + 3 * M * S_pad)
     return vmem <= _PALLAS_MAX_VMEM_BYTES
+
+
+def _fetch(x) -> np.ndarray:
+    """Host copy of a device array that may be sharded across processes
+    in a multi-host run (a plain ``np.asarray`` raises on non-addressable
+    shards); every process receives the full array."""
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 @functools.cache
@@ -489,13 +507,14 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     if _fast_ok(S_pad, W, M, memo.n_ops):
         rs = ev.returns_view(stream)
         P_np = _build_P(memo, S_pad)
-        if _use_pallas() and _pallas_fits(S_pad, M, memo.n_ops):
+        if (_use_pallas() and _pallas_fits(S_pad, M, memo.n_ops)
+                and rs.n_returns >= _PALLAS_MIN_RETURNS):
             from jepsen_tpu.checkers import reach_pallas
             R0_np = np.zeros((S_pad, M), bool)
             R0_np[0, 0] = True
             try:
                 dead, _ = reach_pallas.walk_returns(
-                    P_np, rs.ret_slot, rs.slot_ops, R0_np)
+                    P_np, rs.ret_slot, rs.slot_ops, R0_np, fetch_R=False)
             except Exception as e:                      # noqa: BLE001
                 # Mosaic lowering / VMEM allocation failure — the XLA
                 # walk below handles every history the fast path admits
@@ -534,13 +553,111 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                            int(ptr) - 1, elapsed)
 
 
+def _union_alphabet(model: Model, packed_list, live, max_states: int):
+    """One memo over the UNION of the keys' op alphabets, plus a per-key
+    LUT from local op ids to union ids (last entry maps -1 → -1, so free
+    slots survive fancy-indexing). Per-key tables are history-dependent
+    (ids assigned by occurrence order), so even identical workloads get
+    different tables; the union table is what lets every key share one
+    device-resident P."""
+    union: Dict[Any, int] = {}          # (f, hashable(value)) -> union id
+    union_ops: List[Op] = []
+    for i in live:
+        for op in packed_list[i].distinct_ops:
+            key = (op.f, hashable(op.value))
+            if key not in union:
+                union[key] = len(union_ops)
+                union_ops.append(op)
+    memo_u = memo_ops(model, tuple(union_ops), max_states=max_states)
+    luts = {}
+    for i in live:
+        ops_i = packed_list[i].distinct_ops
+        lut = np.fromiter(
+            (union[(op.f, hashable(op.value))] for op in ops_i),
+            np.int32, count=len(ops_i))
+        luts[i] = np.append(lut, np.int32(-1))
+    return memo_u, luts
+
+
+def _keyed_operands(model, packed_list, rss, live, W: int,
+                    max_states: int):
+    """Build the keyed kernel's flat operands: union transition tensor P
+    plus all keys' REAL returns concatenated into one stream tagged with
+    key ids. Returns ``(P, ret_flat, ops_flat, key_flat, offsets, wide)``;
+    raises :class:`StateExplosion`/:class:`DenseOverflow` when the union
+    alphabet does not fit the kernel's budgets. Shared between
+    :func:`_check_many_keyed` and its differential tests so both exercise
+    the same flattening."""
+    memo_u, luts = _union_alphabet(model, packed_list, live, max_states)
+    S_pad = max(2, _next_pow2(memo_u.n_states))
+    M = 1 << W
+    if not (_fast_ok(S_pad, W, M, memo_u.n_ops)
+            and _pallas_fits(S_pad, M, memo_u.n_ops)):
+        raise DenseOverflow("union alphabet exceeds keyed-kernel budgets")
+    P = _build_P(memo_u, S_pad)
+    wide = [ev.pad_returns(r, r.n_returns, W) for r in rss]
+    counts = [r.n_returns for r in wide]
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    ret_flat = np.concatenate(
+        [r.ret_slot[:n] for r, n in zip(wide, counts)] or
+        [np.zeros(0, np.int32)])
+    ops_flat = np.concatenate(
+        [luts[i][r.slot_ops[:n]] for i, r, n in
+         zip(live, wide, counts)] or
+        [np.zeros((0, W), np.int32)])
+    key_flat = np.repeat(np.arange(len(wide), dtype=np.int32), counts)
+    return P, ret_flat, ops_flat, key_flat, offsets, wide
+
+
+def _check_many_keyed(model, rss, preps, live, results, packed_list,
+                      M: int, W: int, max_states: int, t0: float
+                      ) -> Optional[List[Dict[str, Any]]]:
+    """Per-key batch on the keyed pallas kernel: all keys' REAL returns
+    concatenated into one flat stream (zero padding waste), one kernel
+    launch, exact per-key death indices. Ops are remapped into the union
+    alphabet so every key shares one transition tensor. Returns the
+    filled result list, or None to fall through to the vmapped XLA path
+    (union too large, or kernel failure)."""
+    from jepsen_tpu.checkers import reach_pallas
+
+    try:
+        P, ret_flat, ops_flat, key_flat, offsets, wide = _keyed_operands(
+            model, packed_list, rss, live, W, max_states)
+    except (StateExplosion, DenseOverflow):
+        return None
+    try:
+        dead = reach_pallas.walk_returns_keyed(
+            P, ret_flat, ops_flat, key_flat, len(wide), M)
+    except Exception as e:                              # noqa: BLE001
+        _warn_pallas_failed(repr(e))
+        return None
+    elapsed = _time.monotonic() - t0
+    for k, i in enumerate(live):
+        memo, stream = preps[i][0], preps[i][1]
+        if int(dead[k]) < 0:
+            results[i] = _result_valid("reach-keyed", stream, memo,
+                                       elapsed)
+        else:
+            local = int(dead[k]) - int(offsets[k])
+            results[i] = _result_invalid(
+                "reach-keyed", stream, memo, packed_list[i],
+                int(wide[k].ret_event[local]), elapsed)
+    return results
+
+
 def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                max_states: int = 100_000, max_slots: int = 20,
-               max_dense: int = 1 << 22) -> List[Dict[str, Any]]:
+               max_dense: int = 1 << 22,
+               devices: Optional[Sequence] = None) -> List[Dict[str, Any]]:
     """Batched per-key checking (the ``independent`` checker's hot path):
     one vmapped device call over all keys, padded to common shapes. Keys
     whose history does not fit the dense engine raise; callers split those
-    out first via :func:`fits`."""
+    out first via :func:`fits`.
+
+    With ``devices`` (>1), the key axis is sharded over a
+    ``jax.sharding.Mesh`` — the data-parallel axis of SURVEY.md §2.4:
+    per-key searches are independent, so the only cross-device traffic is
+    the while-loop's all-reduced liveness test."""
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
@@ -570,44 +687,87 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
         fast = _fast_ok(S_pad, W, M, O_pad)
         if fast:
             rss = [ev.returns_view(preps[i][1]) for i in live]
-            R_pad = max(64, _bucket(max(r.n_returns for r in rss), _UNROLL))
-            rss = [ev.pad_returns(r, R_pad, W) for r in rss]
-            xor_cols, bitmask = _xor_bitmask(W, M)
+            total_returns = sum(r.n_returns for r in rss)
+            n_dev = len(devices) if devices is not None else 1
+            if (n_dev <= 1 and _use_pallas()
+                    and total_returns >= _PALLAS_MIN_RETURNS):
+                out = _check_many_keyed(model, rss, preps, live, results,
+                                        packed_list, M, W, max_states, t0)
+                if out is not None:
+                    return out
             Ps, R0s = [], []
             for i in live:
                 Ps.append(_build_P(preps[i][0], S_pad, O_pad))
                 R0 = np.zeros((S_pad, M), bool)
                 R0[0, 0] = True
                 R0s.append(R0)
-            xc, bm = jnp.asarray(xor_cols), jnp.asarray(bitmask)
             # shared-alphabet fast path: uniform workloads produce the
             # same P for every key — skip the per-key matrix batch
             shared = all((Ps[k] == Ps[0]).all() for k in range(1, len(Ps)))
-            slot_b = jnp.asarray(np.stack([r.ret_slot for r in rss]))
-            ops_b = jnp.asarray(np.stack([r.slot_ops for r in rss]))
+            R_pad = max(64, _bucket(max(r.n_returns for r in rss), _UNROLL))
+            rss = [ev.pad_returns(r, R_pad, W) for r in rss]
+            xor_cols, bitmask = _xor_bitmask(W, M)
+            xc, bm = jnp.asarray(xor_cols), jnp.asarray(bitmask)
+            slot_np = np.stack([r.ret_slot for r in rss])
+            ops_np = np.stack([r.slot_ops for r in rss])
+            Ps_np = None if shared else np.stack(Ps)
+            R0s_np = np.stack(R0s)
+            K_live = len(rss)
+            if n_dev > 1:
+                # key-axis DP over the mesh: pad the key count to a
+                # multiple of the device count (pad keys replay key 0,
+                # whose verdict is discarded), shard the leading axis,
+                # replicate the shared operands
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+                from jepsen_tpu import parallel as par
+                K_pad = -(-K_live // n_dev) * n_dev
+                pad = K_pad - K_live
+
+                def padk(a):
+                    return np.concatenate(
+                        [a, np.repeat(a[:1], pad, axis=0)]) if pad else a
+
+                m = par.mesh("keys", devices)
+                skey = NamedSharding(m, PartitionSpec("keys"))
+                srep = NamedSharding(m, PartitionSpec())
+                slot_b = jax.device_put(padk(slot_np), skey)
+                ops_b = jax.device_put(padk(ops_np), skey)
+                if shared:
+                    Ps_dev = jax.device_put(Ps[0], srep)
+                    R0_b = jax.device_put(R0s[0], srep)
+                else:
+                    Ps_dev = jax.device_put(padk(Ps_np), skey)
+                    R0_b = jax.device_put(padk(R0s_np), skey)
+            else:
+                slot_b = jnp.asarray(slot_np)
+                ops_b = jnp.asarray(ops_np)
+                Ps_dev = jnp.asarray(Ps[0] if shared else Ps_np)
+                R0_b = jnp.asarray(R0s[0] if shared else R0s_np)
             if shared:
-                Ps_dev = jnp.asarray(Ps[0])
-                R0_1 = jnp.asarray(R0s[0])
                 ptrs, _, alives, R_blocks = \
                     _jitted_walk_returns_batch_shared()(
-                        Ps_dev, xc, bm, slot_b, ops_b, R0_1)
+                        Ps_dev, xc, bm, slot_b, ops_b, R0_b)
             else:
-                Ps_dev = jnp.asarray(np.stack(Ps))
                 ptrs, _, alives, R_blocks = _jitted_walk_returns_batch()(
-                    Ps_dev, xc, bm, slot_b, ops_b,
-                    jnp.asarray(np.stack(R0s)))
+                    Ps_dev, xc, bm, slot_b, ops_b, R0_b)
             elapsed = _time.monotonic() - t0
-            ptrs = np.asarray(ptrs)
-            alives = np.asarray(alives)
+            ptrs = _fetch(ptrs)[:K_live]
+            alives = _fetch(alives)[:K_live]
+            R_blocks_np = None          # fetched lazily, only on failures
             for k, i in enumerate(live):
                 memo, stream = preps[i][0], preps[i][1]
                 if bool(alives[k]):
                     results[i] = _result_valid("reach-batch", stream, memo,
                                                elapsed)
                 else:
-                    Pk = Ps_dev if shared else Ps_dev[k]
+                    if R_blocks_np is None:
+                        R_blocks_np = _fetch(R_blocks)
+                    Pk = (jnp.asarray(Ps[0]) if shared
+                          else jnp.asarray(Ps_np[k]))
                     dead_event = _refine_dead(Pk, xc, bm, rss[k],
-                                              int(ptrs[k]), R_blocks[k])
+                                              int(ptrs[k]),
+                                              jnp.asarray(R_blocks_np[k]))
                     results[i] = _result_invalid(
                         "reach-batch", stream, memo, packed_list[i],
                         dead_event, elapsed)

@@ -33,9 +33,75 @@ engine. ``interpret=True`` runs it on CPU for differential tests.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
+
+
+def _gather_G(slot_ops_ref, P_ref, k: int, W: int, O1: int):
+    """Concatenate the W pending ops' transition matrices for return ``k``
+    into one [S, W·S] operand (slot -1 → the all-zero sentinel row)."""
+    import jax.numpy as jnp
+
+    Gs = []
+    for jj in range(W):
+        o = slot_ops_ref[k * W + jj]
+        o = jnp.where(o < 0, O1 - 1, o)
+        Gs.append(P_ref[o])                       # [S, S] f32
+    return jnp.concatenate(Gs, axis=1)            # [S, W*S]
+
+
+def _fire_and_project(R, G_all, j, W: int, M: int, S: int):
+    """One return event on the dense config set ``R`` [M, S] f32:
+
+    - W fire passes (Jacobi): ONE fused [M,S]@[S,W·S] matmul per pass
+      computes every config's image under every slot's op; the per-slot
+      loop then only reshuffles halves (VPU). Passes run until the config
+      count stops growing (fire is monotone, so popcount stability ==
+      fixpoint), capped at W (a fire chain sets ≥1 new bit per pass). The
+      projected set from the previous return is already closed under its
+      still-pending ops, so typically only the 1-2 ops invoked since then
+      fire and this exits after ~2 passes instead of the static worst
+      case W. Semantics match ``reach._ret_step``'s einsum.
+    - projection on the (dynamic) returning slot ``j``: scalar-predicate
+      vector selects don't legalize in Mosaic, so blend all W static
+      projections with scalar 0/1 indicator multiplies — exactly one is
+      hot (or none for j = -1 padding → identity).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def fire_cond(c):
+        Rv, prev, it = c
+        return jnp.logical_and(it < W, jnp.sum(Rv) > prev)
+
+    def fire_body(c):
+        Rv, prev, it = c
+        s = jnp.sum(Rv)
+        F = jnp.dot(Rv, G_all, preferred_element_type=jnp.float32)
+        for jj in range(W):
+            Fj = F[:, jj * S:(jj + 1) * S]
+            half, blk = M >> (jj + 1), 1 << jj
+            Rr = Rv.reshape(half, 2, blk, S)
+            Fr = Fj.reshape(half, 2, blk, S)
+            hi = jnp.maximum(
+                Rr[:, 1], (Fr[:, 0] > 0.5).astype(jnp.float32))
+            # no scatter in Mosaic: rebuild via stacked halves
+            Rv = jnp.stack([Rr[:, 0], hi], axis=1).reshape(M, S)
+        return Rv, s, it + 1
+
+    R, _, _ = jax.lax.while_loop(
+        fire_cond, fire_body, (R, jnp.float32(-1.0), 0))
+
+    acc = R * (j < 0).astype(jnp.float32)
+    for jj in range(W):
+        half, blk = M >> (jj + 1), 1 << jj
+        Rr = R.reshape(half, 2, blk, S)
+        taken = Rr[:, 1]
+        proj = jnp.stack([taken, jnp.zeros_like(taken)],
+                         axis=1).reshape(M, S)
+        acc = acc + proj * (j == jj).astype(jnp.float32)
+    return acc
 
 
 def _make_kernel(B: int, W: int, M: int, S: int, O1: int):
@@ -56,66 +122,8 @@ def _make_kernel(B: int, W: int, M: int, S: int, O1: int):
         def do_return(k, _):
             r = step * B + k
             j = ret_slot_ref[k]
-            R = R_scr[:]
-            # -- W fire passes (static unroll) --------------------------
-            # One gather of each pending op's transition matrix per
-            # return, and ONE fused [M,S]@[S,W·S] matmul per pass that
-            # computes every config's image under every slot's op — the
-            # per-slot loop then only reshuffles halves (VPU). Each pass
-            # ORs all slot contributions computed from the pass-start R
-            # (Jacobi), exactly `reach._ret_step`'s einsum semantics.
-            Gs = []
-            for jj in range(W):
-                o = slot_ops_ref[k * W + jj]
-                o = jnp.where(o < 0, O1 - 1, o)
-                Gs.append(P_ref[o])                   # [S, S] f32
-            G_all = jnp.concatenate(Gs, axis=1)       # [S, W*S]
-
-            # Passes run until the config count stops growing (fire is
-            # monotone, so popcount stability == fixpoint), capped at W
-            # (a fire chain sets ≥1 new bit per pass). The projected set
-            # from the previous return is already closed under its
-            # still-pending ops, so typically only the 1-2 ops invoked
-            # since then fire and this exits after ~2 passes instead of
-            # the static worst case W.
-            def fire_cond(c):
-                Rv, prev, it = c
-                return jnp.logical_and(it < W, jnp.sum(Rv) > prev)
-
-            def fire_body(c):
-                Rv, prev, it = c
-                s = jnp.sum(Rv)
-                F = jnp.dot(Rv, G_all,
-                            preferred_element_type=jnp.float32)
-                for jj in range(W):
-                    Fj = F[:, jj * S:(jj + 1) * S]
-                    half, blk = M >> (jj + 1), 1 << jj
-                    Rr = Rv.reshape(half, 2, blk, S)
-                    Fr = Fj.reshape(half, 2, blk, S)
-                    hi = jnp.maximum(
-                        Rr[:, 1], (Fr[:, 0] > 0.5).astype(jnp.float32))
-                    # no scatter in Mosaic: rebuild via stacked halves
-                    Rv = jnp.stack([Rr[:, 0], hi],
-                                   axis=1).reshape(M, S)
-                return Rv, s, it + 1
-
-            R, _, _ = jax.lax.while_loop(
-                fire_cond, fire_body, (R, jnp.float32(-1.0), 0))
-
-            # -- projection on the (dynamic) returning slot -------------
-            # Scalar-predicate vector selects (jnp.where / lax.switch
-            # residues) don't legalize in Mosaic, so blend all W static
-            # projections with scalar 0/1 multiplies instead: exactly one
-            # indicator is hot (or none for j = -1 padding → identity).
-            acc = R * (j < 0).astype(jnp.float32)
-            for jj in range(W):
-                half, blk = M >> (jj + 1), 1 << jj
-                Rr = R.reshape(half, 2, blk, S)
-                taken = Rr[:, 1]
-                proj = jnp.stack([taken, jnp.zeros_like(taken)],
-                                 axis=1).reshape(M, S)
-                acc = acc + proj * (j == jj).astype(jnp.float32)
-            R = acc
+            G_all = _gather_G(slot_ops_ref, P_ref, k, W, O1)
+            R = _fire_and_project(R_scr[:], G_all, j, W, M, S)
 
             @pl.when(jnp.logical_and(dead_scr[0] < 0,
                                      jnp.logical_and(jnp.sum(R) < 0.5,
@@ -189,16 +197,20 @@ _BLOCK = 1024     # XLA tiles 1-D s32 SMEM operands at T(1024); the block
 
 def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
                  slot_ops: np.ndarray, R0_sm: np.ndarray, *,
-                 interpret: bool = False) -> Tuple[int, np.ndarray]:
+                 interpret: bool = False,
+                 fetch_R: bool = True) -> Tuple[int, Optional[np.ndarray]]:
     """Run the full returns walk in one kernel.
 
     ``P`` f32[O1, S, S] (last row all-zero sentinel); ``ret_slot``
     i32[R]; ``slot_ops`` i32[R, W]; ``R0_sm`` bool[S, M] (the engine's
     native layout). Returns ``(dead, R_final[S, M] bool)`` where
     ``dead`` is the first return index at which the config set emptied,
-    or -1 if the history prefix is linearizable.
+    or -1 if the history prefix is linearizable. With ``fetch_R=False``
+    the final config set is not copied back (``None``) — the verdict
+    needs only ``dead``, and on a tunneled device each host fetch is a
+    blocking round-trip.
     """
-    import jax.numpy as jnp
+    import jax
 
     O1, S, _ = P.shape
     R_real = int(ret_slot.shape[0])
@@ -216,9 +228,155 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
         slot_ops = np.pad(slot_ops, ((0, R_pad - R_real), (0, 0)),
                           constant_values=-1)
     call = _walk_call(B, W, M, S, O1, R_pad, interpret)
-    R_out, dead = call(jnp.asarray(np.array([R_real], np.int32)),
-                       jnp.asarray(ret_slot),
-                       jnp.asarray(slot_ops.reshape(-1)),
-                       jnp.asarray(R0_sm.T, jnp.float32),
-                       jnp.asarray(P, jnp.float32))
-    return int(dead[0]), np.asarray(R_out, bool).T
+    # one batched host->device transfer, not five round-trips
+    args = jax.device_put((
+        np.array([R_real], np.int32),
+        np.ascontiguousarray(ret_slot, np.int32),
+        np.ascontiguousarray(slot_ops.reshape(-1), np.int32),
+        np.ascontiguousarray(R0_sm.T, np.float32),
+        np.ascontiguousarray(P, np.float32)))
+    R_out, dead = call(*args)
+    return int(dead[0]), (np.asarray(R_out, bool).T if fetch_R else None)
+
+
+# -- keyed batch: many independent keys in one kernel ------------------------
+#
+# The per-key (`jepsen.independent`) hot path. Instead of vmapping the
+# walk with every key padded to the longest return stream (the XLA batch
+# path), all keys' REAL returns are concatenated into one flat stream
+# tagged with key ids; the kernel walks it sequentially, resetting the
+# VMEM config set at each key boundary and recording each key's first
+# death index into a K-sized SMEM output. Zero padding waste for skewed
+# key sizes, one kernel launch total, and exact per-key dead indices
+# (the vmapped XLA walk only brackets death within an unroll block).
+# All keys share one transition tensor P: history-dependent per-key op
+# alphabets are remapped into a union alphabet by the caller
+# (``reach._union_alphabet``); only a union too large for the budgets
+# falls back to the XLA path.
+
+def _make_keyed_kernel(B: int, W: int, M: int, S: int, O1: int, K: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(ret_slot_ref, slot_ops_ref, key_ref, P_ref,
+               dead_ref, R_scr, prev_scr):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            prev_scr[0] = jnp.int32(-1)
+
+            def ini(k, _):
+                dead_ref[k] = jnp.int32(-1)
+                return 0
+
+            jax.lax.fori_loop(0, K, ini, 0)
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, (M, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (M, S), 1)
+        R0 = jnp.logical_and(rows == 0, cols == 0).astype(jnp.float32)
+
+        def do_return(b, _):
+            r = step * B + b
+            j = ret_slot_ref[b]
+            key = key_ref[b]
+            is_real = key >= 0
+
+            @pl.when(jnp.logical_and(is_real, key != prev_scr[0]))
+            def _new_key():
+                R_scr[:] = R0
+                prev_scr[0] = key
+
+            G_all = _gather_G(slot_ops_ref, P_ref, b, W, O1)
+            R = _fire_and_project(R_scr[:], G_all, j, W, M, S)
+
+            kk = jnp.maximum(key, 0)
+
+            @pl.when(jnp.logical_and(
+                    is_real,
+                    jnp.logical_and(jnp.sum(R) < 0.5, dead_ref[kk] < 0)))
+            def _mark_dead():
+                dead_ref[kk] = r
+
+            R_scr[:] = R
+            return 0
+
+        jax.lax.fori_loop(0, B, do_return, 0)
+
+    return kernel
+
+
+@functools.cache
+def _keyed_call(B: int, W: int, M: int, S: int, O1: int, N_pad: int,
+                K_pad: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = _make_keyed_kernel(B, W, M, S, O1, K_pad)
+    call = pl.pallas_call(
+        kernel,
+        grid=(N_pad // B,),
+        in_specs=[
+            pl.BlockSpec((B,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((B * W,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((B,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((O1, S, S), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            # constant index map: the block stays resident across the
+            # sequential grid, accumulating per-key verdicts
+            pl.BlockSpec((K_pad,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((K_pad,), jnp.int32)],
+        scratch_shapes=[
+            pltpu.VMEM((M, S), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(call)
+
+
+def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
+                       slot_ops: np.ndarray, key_id: np.ndarray,
+                       n_keys: int, M: int, *,
+                       interpret: bool = False) -> np.ndarray:
+    """Walk the concatenation of ``n_keys`` return streams in one kernel.
+
+    ``ret_slot`` i32[N] / ``slot_ops`` i32[N, W] / ``key_id`` i32[N]
+    (non-decreasing, the key owning each return) are the flat
+    concatenation of all keys' real returns. Returns ``dead[n_keys]``:
+    for each key the FLAT index of the first return at which its config
+    set emptied, or -1 if that key's history is linearizable.
+    """
+    import jax
+
+    from jepsen_tpu.checkers.reach import _bucket
+
+    O1, S, _ = P.shape
+    N = int(ret_slot.shape[0])
+    W = int(slot_ops.shape[1])
+    B = _BLOCK
+    N_pad = max(B, _bucket(-(-max(N, 1) // B) * B, B))
+    K_pad = max(8, _bucket(n_keys, 8))
+    if N_pad != N:
+        ret_slot = np.pad(ret_slot, (0, N_pad - N), constant_values=-1)
+        slot_ops = np.pad(slot_ops, ((0, N_pad - N), (0, 0)),
+                          constant_values=-1)
+        key_id = np.pad(key_id, (0, N_pad - N), constant_values=-1)
+    call = _keyed_call(B, W, M, S, O1, N_pad, K_pad, interpret)
+    args = jax.device_put((
+        np.ascontiguousarray(ret_slot, np.int32),
+        np.ascontiguousarray(slot_ops.reshape(-1), np.int32),
+        np.ascontiguousarray(key_id, np.int32),
+        np.ascontiguousarray(P, np.float32)))
+    (dead,) = call(*args)
+    return np.asarray(dead)[:n_keys]

@@ -1,0 +1,106 @@
+"""Multi-host distribution — the scale-out story (SURVEY.md §2.4, §5).
+
+The reference has no data-plane communication backend at all: its
+analysis is single-JVM, and its only cross-machine traffic is the SSH
+control plane (``jepsen.control``). The TPU-native equivalents:
+
+- **control plane** — unchanged in spirit: :mod:`jepsen_tpu.control`
+  drives DB nodes over SSH.
+- **data plane** — single-controller JAX inside one host;
+  ``jax.distributed`` + a hybrid ICI×DCN mesh across hosts. Collectives
+  are XLA's (``psum`` liveness reductions, ``all_gather`` of transfer
+  matrices); shardings are laid out so the hot axes (keys, chunks) ride
+  ICI within a slice and only the final scalar reductions cross DCN.
+
+Usage on each host of a multi-host TPU slice::
+
+    from jepsen_tpu.parallel import distributed
+    distributed.initialize()            # env-driven on TPU pods
+    mesh = distributed.hybrid_mesh(("dcn", "keys"))
+    results = reach.check_many(model, packs, devices=mesh.devices.ravel())
+
+Everything here degrades gracefully to single-process: ``initialize``
+is a no-op when no coordinator is configured, and ``hybrid_mesh`` of a
+single host is an ordinary 1-slice mesh.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Bring up ``jax.distributed`` for multi-host runs.
+
+    On TPU pods all three arguments are discovered from the environment
+    (the standard JAX bootstrap); pass them explicitly for CPU/GPU
+    fleets. Returns True if a distributed runtime is (now) active,
+    False when running single-process (no coordinator configured) —
+    callers need no branching, every mesh helper below works either
+    way."""
+    global _initialized
+    if _initialized:
+        return True
+    workers = [w for w in
+               os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if w]
+    if (coordinator_address is None and num_processes is None
+            and "JAX_COORDINATOR_ADDRESS" not in os.environ
+            and "MEGASCALE_COORDINATOR_ADDRESS" not in os.environ
+            and len(workers) < 2):      # one hostname = single host
+        return False                    # single-process: nothing to do
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except (ValueError, RuntimeError):
+        # auto-detection came up empty (or already initialized by the
+        # launcher) — stay single-process rather than crash the check
+        return False
+    _initialized = True
+    return True
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_index, process_count) — (0, 1) when single-process."""
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def hybrid_mesh(axis_names: Tuple[str, str] = ("dcn", "ici"),
+                devices: Optional[Sequence] = None):
+    """A 2-D mesh [hosts(DCN) × per-host devices(ICI)].
+
+    The outer axis crosses host boundaries (DCN-speed collectives —
+    keep it for scalar reductions and rare rebalances); the inner axis
+    stays within a slice (ICI-speed — shard the hot batch axes here).
+    Falls back to a 1×N mesh in single-host runs, so shardings written
+    against these axis names work unchanged everywhere."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n_proc = max(jax.process_count(), 1)
+    per_host = len(devs) // n_proc
+    if n_proc > 1 and per_host * n_proc == len(devs):
+        try:
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_hybrid_device_mesh(
+                (per_host,), (n_proc,), devices=devs)
+            return Mesh(arr.reshape(n_proc, per_host), axis_names)
+        except Exception:                               # noqa: BLE001
+            pass                        # topology discovery unavailable
+    return Mesh(np.array(devs).reshape(1, len(devs)), axis_names)
+
+
+def keys_sharding(mesh, batch_axis: str = "ici"):
+    """NamedSharding placing a leading key/chunk axis on the ICI axis of
+    a :func:`hybrid_mesh` (replicated across DCN)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(batch_axis))

@@ -97,10 +97,98 @@ def test_pallas_multiblock_grid(monkeypatch, corrupt):
         assert int(rs.ret_event[dead]) == de_xla
 
 
+def test_keyed_kernel_matches_per_key_checks():
+    """Concatenated multi-key walk vs independent single-key verdicts:
+    mixed valid/corrupt keys, shared alphabet, exact dead mapping."""
+    model = models.cas_register()
+    histories, expect = [], []
+    for seed in range(6):
+        h = fixtures.gen_history("cas", n_ops=30, processes=3, seed=seed)
+        if seed % 2:
+            h = fixtures.corrupt(h, seed=seed)
+        histories.append(h)
+    packed = [pack(h) for h in histories]
+    preps = [reach._prep(model, p, max_states=100_000, max_slots=20,
+                         max_dense=1 << 22) for p in packed]
+    live = list(range(len(packed)))
+    W = max(max(p[1].W, 1) for p in preps)
+    M = 1 << W
+    rss = [ev.returns_view(p[1]) for p in preps]
+    P, ret_flat, ops_flat, key_flat, offsets, wide = \
+        reach._keyed_operands(model, packed, rss, live, W, 100_000)
+    dead = reach_pallas.walk_returns_keyed(
+        P, ret_flat, ops_flat, key_flat, len(wide), M, interpret=True)
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        if ref["valid"]:
+            assert dead[k] < 0, f"key {k}"
+        else:
+            local = int(dead[k]) - int(offsets[k])
+            assert 0 <= local < wide[k].n_returns
+            assert int(wide[k].ret_event[local]) == ref["dead-event"], \
+                f"key {k}"
+
+
+def test_keyed_kernel_multiblock(monkeypatch):
+    """Key boundaries crossing pallas grid-step boundaries: shrink _BLOCK
+    so the flat stream spans many sequential steps."""
+    monkeypatch.setattr(reach_pallas, "_BLOCK", 16)
+    model = models.register()
+    histories = []
+    for seed in range(8):
+        h = fixtures.gen_history("register", n_ops=25, processes=3,
+                                 seed=seed)
+        if seed in (2, 5):
+            h = fixtures.corrupt(h, seed=seed)
+        histories.append(h)
+    packed = [pack(h) for h in histories]
+    preps = [reach._prep(model, p, max_states=100_000, max_slots=20,
+                         max_dense=1 << 22) for p in packed]
+    live = list(range(len(packed)))
+    W = max(max(p[1].W, 1) for p in preps)
+    M = 1 << W
+    rss = [ev.returns_view(p[1]) for p in preps]
+    P, ret_flat, ops_flat, key_flat, offsets, wide = \
+        reach._keyed_operands(model, packed, rss, live, W, 100_000)
+    assert len(ret_flat) > 3 * 16        # genuinely multi-block
+    dead = reach_pallas.walk_returns_keyed(
+        P, ret_flat, ops_flat, key_flat, len(wide), M, interpret=True)
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        assert (dead[k] < 0) == bool(ref["valid"]), f"key {k}"
+
+
+def test_keyed_end_to_end_via_check_many(monkeypatch):
+    """Force the keyed path through check_many and compare against the
+    XLA batch path on the same keys."""
+    import functools
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    orig = reach_pallas.walk_returns_keyed
+    monkeypatch.setattr(reach_pallas, "walk_returns_keyed",
+                        functools.partial(orig, interpret=True))
+    model = models.cas_register()
+    packed = []
+    for seed in range(5):
+        h = fixtures.gen_history("cas", n_ops=40, processes=3, seed=seed)
+        if seed == 3:
+            h = fixtures.corrupt(h, seed=seed)
+        packed.append(pack(h))
+    res = reach.check_many(model, packed)
+    assert all(r["engine"] == "reach-keyed" for r in res)
+    monkeypatch.setattr(reach, "_use_pallas", lambda: False)
+    ref = reach.check_many(model, packed)
+    for r, f in zip(res, ref):
+        assert r["valid"] == f["valid"]
+        if not r["valid"]:
+            assert r["op"] == f["op"]
+
+
 def test_pallas_end_to_end_via_check_packed(monkeypatch):
     """Force the pallas path through check_packed (interpret on CPU) and
     compare verdicts against the default engine."""
     monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
     monkeypatch.setattr(
         reach_pallas, "_walk_call",
         reach_pallas._walk_call.__wrapped__
