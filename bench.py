@@ -26,6 +26,9 @@ def main() -> int:
     ap.add_argument("--engine", default="reach",
                     choices=["reach", "chunked", "wgl-cpu", "wgl-native"])
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="write a jax.profiler trace of one steady-state "
+                         "check to DIR")
     args = ap.parse_args()
 
     from jepsen_tpu import fixtures, models
@@ -59,6 +62,14 @@ def main() -> int:
                           "error": f"bad verdict {res.get('valid')}"}))
         return 1
     times = []
+    if args.profile:
+        # SURVEY.md §5 tracing: a jax.profiler trace of the steady-state
+        # solver, viewable in TensorBoard / Perfetto
+        import jax
+        with jax.profiler.trace(args.profile):
+            t1 = time.monotonic()
+            res = run()
+            times.append(time.monotonic() - t1)
     for _ in range(max(1, args.repeat)):
         t1 = time.monotonic()
         res = run()

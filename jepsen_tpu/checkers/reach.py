@@ -478,6 +478,55 @@ def _result_invalid(engine: str, stream: ev.EventStream, memo: Memo,
             "dead-event": int(dead_event), "time-s": elapsed}
 
 
+def _final_configs(memo: Memo, rs: "ev.ReturnStream", P_np: np.ndarray,
+                   S_pad: int, M: int, W: int, dead_ret: int,
+                   limit: int = 16) -> List[Dict[str, Any]]:
+    """Decode the configurations that survived up to (but not through)
+    the dead return — the analogue of knossos's ``:final-paths``: each
+    entry is a reachable model state plus the pending ops it has already
+    linearized. Together they show every way the search tried to order
+    the window, and that none admits the failing return."""
+    import jax.numpy as jnp
+
+    xor_cols, bitmask = _xor_bitmask(W, M)
+    L = max(_UNROLL, -(-max(dead_ret, 1) // _UNROLL) * _UNROLL)
+    prefix = ev.pad_returns(
+        ev.ReturnStream(ret_slot=rs.ret_slot[:dead_ret],
+                        slot_ops=rs.slot_ops[:dead_ret],
+                        ret_event=rs.ret_event[:dead_ret],
+                        ret_entry=rs.ret_entry[:dead_ret],
+                        W=W, n_returns=dead_ret), L)
+    R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
+    _, R, _, _ = _jitted_walk_returns()(
+        jnp.asarray(P_np), jnp.asarray(xor_cols), jnp.asarray(bitmask),
+        jnp.asarray(prefix.ret_slot), jnp.asarray(prefix.slot_ops), R0)
+    alive = np.argwhere(np.asarray(R))
+    pending = rs.slot_ops[dead_ret]
+    out = []
+    for s, mask in alive[:limit]:
+        lin = [str(memo.distinct_ops[pending[j]])
+               for j in range(W)
+               if (mask >> j) & 1 and pending[j] >= 0]
+        out.append({"model": str(memo.states[s]),
+                    "linearized-pending": lin})
+    return out
+
+
+def _attach_witness(out: Dict[str, Any], memo: Memo, rs, P_np, S_pad, M,
+                    W, dead_ret: int, packed: h.PackedHistory) -> None:
+    """Enrich an invalid verdict with knossos-style failure evidence:
+    ``final-configs`` (:func:`_final_configs`) and ``previous-ok`` (the
+    last successfully linearized return before the failing one)."""
+    try:
+        out["final-configs"] = _final_configs(
+            memo, rs, P_np, S_pad, M, W, dead_ret)
+        if dead_ret > 0:
+            prev = packed.entries[int(rs.ret_entry[dead_ret - 1])]
+            out["previous-ok"] = prev.op.to_dict()
+    except Exception:                                   # noqa: BLE001
+        pass                            # evidence is best-effort garnish
+
+
 def check(model: Model, history: Sequence[Op], *,
           max_states: int = 100_000, max_slots: int = 20,
           max_dense: int = 1 << 22) -> Dict[str, Any]:
@@ -525,8 +574,11 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                 if dead < 0:
                     return _result_valid("reach-pallas", stream, memo,
                                          elapsed)
-                return _result_invalid("reach-pallas", stream, memo, packed,
-                                       int(rs.ret_event[dead]), elapsed)
+                out = _result_invalid("reach-pallas", stream, memo, packed,
+                                      int(rs.ret_event[dead]), elapsed)
+                _attach_witness(out, memo, rs, P_np, S_pad, M, W,
+                                int(dead), packed)
+                return out
         rs = ev.pad_returns(rs, max(64, _bucket(rs.n_returns, _UNROLL)))
         P = jnp.asarray(P_np)
         xc, bm = _xor_bitmask(W, M)
@@ -539,8 +591,13 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         if bool(alive):
             return _result_valid("reach", stream, memo, elapsed)
         dead_event = _refine_dead(P, xc, bm, rs, int(ptr), R_block)
-        return _result_invalid("reach", stream, memo, packed, dead_event,
-                               elapsed)
+        out = _result_invalid("reach", stream, memo, packed, dead_event,
+                              elapsed)
+        dead_ret = int(np.searchsorted(rs.ret_event[:rs.n_returns],
+                                       dead_event))
+        _attach_witness(out, memo, rs, P_np, S_pad, M, W, dead_ret,
+                        packed)
+        return out
     R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
     slot_op0 = jnp.full((W,), -1, jnp.int32)
     ptr, _, alive = _jitted_walk()(

@@ -37,6 +37,12 @@ class TestHandWritten:
         assert res["valid"] is False
         assert res["op"]["f"] == "read"
         assert res["op"]["value"] == 1
+        # knossos-style evidence: the configs alive just before death and
+        # the last successful linearization
+        assert res["previous-ok"]["f"] == "write"
+        assert res["previous-ok"]["value"] == 2
+        assert len(res["final-configs"]) >= 1
+        assert any("2" in c["model"] for c in res["final-configs"])
 
     def test_concurrent_reads_may_split(self):
         h = hist(

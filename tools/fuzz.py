@@ -1,0 +1,167 @@
+"""Cross-engine differential fuzzer (SURVEY.md §4: the rebuild's answer
+to knossos's recorded-fixture cross-checks — thousands of randomized
+small histories, every engine must agree).
+
+Each trial draws a random workload kind, concurrency, crash rate, and
+possibly an injected violation, then runs every applicable engine:
+
+- ``wgl_ref``   — readable Python WGL (the oracle)
+- ``linear``    — sparse JIT-linearization (array/set config sets)
+- ``wgl-native``— C++ memoized DFS
+- ``reach``     — the device engine (XLA walk; pass ``--pallas`` to also
+  run the fused kernel in interpret mode — slow but exact)
+- ``brute``     — exhaustive permutation check on tiny histories
+
+Disagreement on a verdict (True/False; ``"unknown"`` is inconclusive and
+excluded) is a bug in one of them. Exit code 1 on any mismatch.
+
+Usage: python tools/fuzz.py [--n 1000] [--seed 0] [--pallas] [-v]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KINDS = ("register", "cas", "mutex", "multi")
+
+
+def trial_params(rng: random.Random):
+    kind = rng.choice(KINDS)
+    return {
+        "kind": kind,
+        "n_ops": rng.randrange(4, 60),
+        "processes": rng.randrange(2, 6),
+        "values": rng.choice((2, 3, 5)),
+        "crash_p": rng.choice((0.0, 0.0, 0.05, 0.2)),
+        "keys": rng.randrange(2, 4) if kind == "multi" else 1,
+        "corrupt": rng.random() < 0.5,
+    }
+
+
+def run_trial(params, seed: int, *, pallas: bool = False):
+    """Returns (verdicts dict, mismatch bool)."""
+    from jepsen_tpu import fixtures
+    from jepsen_tpu.checkers import brute, linear, reach, wgl_native, wgl_ref
+    from jepsen_tpu.history import pack
+
+    h = fixtures.gen_history(
+        params["kind"], n_ops=params["n_ops"],
+        processes=params["processes"], values=params["values"],
+        crash_p=params["crash_p"], keys=params["keys"], seed=seed)
+    if params["corrupt"]:
+        try:
+            h = fixtures.corrupt(h, seed=seed)
+        except ValueError:          # no reads (e.g. mutex): leave valid
+            pass
+    model = fixtures.model_for(params["kind"])
+    packed = pack(h)
+
+    from jepsen_tpu.checkers.events import ConcurrencyOverflow
+    from jepsen_tpu.models.memo import StateExplosion
+
+    verdicts = {}
+    verdicts["wgl_ref"] = wgl_ref.check_packed(
+        model, packed, time_limit=60)["valid"]
+    verdicts["linear"] = linear.check_packed(
+        model, packed, max_configs=2_000_000)["valid"]
+    if wgl_native.available():
+        verdicts["wgl-native"] = wgl_native.check_packed(
+            model, packed)["valid"]
+    try:
+        # capacity overflows are legitimate skips; anything else (an
+        # engine CRASH) must propagate — hiding it would defeat the fuzz
+        verdicts["reach"] = reach.check_packed(model, packed)["valid"]
+    except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion) as e:
+        verdicts["reach"] = f"skipped: {type(e).__name__}"
+    if pallas:
+        try:
+            from jepsen_tpu.checkers import events as ev
+            from jepsen_tpu.checkers import reach_pallas
+            memo, stream, T, S_pad, M = reach._prep(
+                model, packed, max_states=100_000, max_slots=20,
+                max_dense=1 << 22)
+            rs = ev.returns_view(stream)
+            import numpy as np
+            P = reach._build_P(memo, S_pad)
+            R0 = np.zeros((S_pad, M), bool)
+            R0[0, 0] = True
+            dead, _ = reach_pallas.walk_returns(
+                P, rs.ret_slot, rs.slot_ops, R0, interpret=True,
+                fetch_R=False)
+            verdicts["reach-pallas"] = dead < 0
+        except Exception as e:                          # noqa: BLE001
+            verdicts["reach-pallas"] = f"skipped: {type(e).__name__}"
+    if packed.n <= 7:
+        verdicts["brute"] = brute.check(model, h)["valid"]
+
+    conclusive = {k: v for k, v in verdicts.items()
+                  if isinstance(v, bool)}
+    mismatch = len({bool(v) for v in conclusive.values()}) > 1
+    return verdicts, mismatch
+
+
+def run_many(n: int, seed: int, *, pallas: bool = False,
+             verbose: bool = False):
+    """Run ``n`` trials; returns ``(mismatches, invalid_seen)`` where
+    ``mismatches`` is a list of {trial, seed, params, verdicts} dicts.
+    Shared by the CLI below and the CI slice in tests/test_fuzz.py."""
+    rng = random.Random(seed)
+    t0 = time.monotonic()
+    mismatches = []
+    invalid_seen = 0
+    for t in range(n):
+        params = trial_params(rng)
+        trial_seed = rng.randrange(1 << 30)
+        verdicts, bad = run_trial(params, trial_seed, pallas=pallas)
+        if any(v is False for v in verdicts.values()):
+            invalid_seen += 1
+        if bad:
+            mismatches.append({"trial": t, "seed": trial_seed,
+                               "params": params, "verdicts": verdicts})
+            print(f"MISMATCH trial {t}: {params} seed={trial_seed} "
+                  f"-> {verdicts}", file=sys.stderr)
+        elif verbose and t % 50 == 0:
+            print(f"{t}/{n} ok ({time.monotonic() - t0:.0f}s)")
+    return mismatches, invalid_seen
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--pallas", action="store_true",
+                    help="also run the pallas kernel (interpret mode)")
+    ap.add_argument("--tpu", action="store_true",
+                    help="run the device engine on the real accelerator "
+                         "(default: CPU — per-trial dispatch round-trips "
+                         "over a tunneled device dominate otherwise)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+
+    if not args.tpu:
+        import jax
+        try:
+            # a sitecustomize may pin another platform; env alone is not
+            # enough (same dance as tests/conftest.py)
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:                               # noqa: BLE001
+            pass
+
+    t0 = time.monotonic()
+    mismatches, invalid_seen = run_many(
+        args.n, args.seed, pallas=args.pallas, verbose=args.verbose)
+    print(json.dumps({
+        "trials": args.n, "mismatches": len(mismatches),
+        "invalid_histories": invalid_seen,
+        "elapsed_s": round(time.monotonic() - t0, 1)}))
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
