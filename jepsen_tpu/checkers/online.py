@@ -1,0 +1,182 @@
+"""Online (live) linearizability monitoring — no upstream analogue.
+
+Upstream Jepsen is strictly post-hoc: the history is analyzed after the
+run ends (``jepsen.core/run!`` → ``checker/check-safe``, SURVEY.md §3.1),
+so a test that violated linearizability in its first second still runs to
+completion before anyone finds out. The TPU engine is fast enough
+(~400k ops verified/s — BASELINE.md) to simply re-check the ENTIRE
+recorded prefix on a cadence while the test is still running, failing
+fast the moment a violation appears.
+
+Soundness:
+
+- *No false alarms.* A flush checks the prefix of ops recorded so far;
+  still-running invocations enter the analysis as crashed ops (they may
+  linearize at any point or never — both explored), and unresolved read
+  values are ``None`` wildcards. Both are over-approximations of the
+  constraints the finished history will impose, so the linearizations
+  considered form a superset of the true ones: a prefix reported invalid
+  is genuinely invalid.
+- *Fail-fast is permanent.* Linearizability is prefix-closed: any
+  linearization of the full history restricted to a prefix linearizes
+  that prefix (later-invoked ops cannot fire before earlier returns). An
+  invalid prefix can never be repaired by more ops, so the monitor stops
+  looking after the first violation and the runner may abort the test.
+- *Eventually exact.* Constraints a flush under-applied (pending values)
+  are applied by later flushes and by the final post-hoc check, which
+  remains the source of truth.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from typing import Any, Callable, Dict, List, Optional
+
+from jepsen_tpu.models import Model
+from jepsen_tpu.op import Op
+
+log = logging.getLogger("jepsen.online")
+
+
+class OnlineLinearizable:
+    """Background prefix re-checker. Wire :meth:`observe` as the history
+    observer (``core.History(observer=...)``), :meth:`start` /
+    :meth:`stop` around the run, and pass ``on_violation`` to abort the
+    test early (the runner sets its stop flag there)."""
+
+    def __init__(self, model: Model, *,
+                 interval_s: float = 1.0,
+                 min_new_ops: int = 128,
+                 on_violation: Optional[Callable[[Dict[str, Any]], None]]
+                 = None,
+                 **checker_kw: Any):
+        self.model = model
+        self.interval_s = interval_s
+        self.min_new_ops = min_new_ops
+        self.on_violation = on_violation
+        self.checker_kw = checker_kw
+        self._ops: List[Op] = []
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._checked_upto = 0          # longest CONCLUSIVELY checked prefix
+        self._inconclusive_tail = 0
+        self._flushes = 0
+        self.violation: Optional[Dict[str, Any]] = None
+
+    # -- producer side (worker threads, via History observer) ---------------
+
+    def observe(self, op: Op) -> None:
+        with self._lock:
+            self._ops.append(op)
+        if len(self._ops) - self._checked_upto >= self.min_new_ops:
+            self._wake.set()
+
+    # -- checking ------------------------------------------------------------
+
+    def flush(self) -> Optional[Dict[str, Any]]:
+        """Check the current prefix; returns the violation dict once one
+        is found (then sticky — no further work happens). Serialized: the
+        monitor thread and a caller's stop() may both land here."""
+        with self._flush_lock:
+            return self._flush_locked()
+
+    def _flush_locked(self) -> Optional[Dict[str, Any]]:
+        if self.violation is not None:
+            return self.violation
+        with self._lock:
+            prefix = list(self._ops)
+        if (len(prefix) <= self._checked_upto
+                and not self._inconclusive_tail):
+            return None
+        from jepsen_tpu.checkers.facade import check_safe, linearizable
+
+        kw = dict(self.checker_kw)
+        if "algorithm" not in kw:
+            # low-latency default: the C++ WGL engine has no per-shape
+            # compile cost, so flushes keep up with fast op streams; a
+            # time limit bounds its exponential worst case ("unknown"
+            # flushes are retried at the next cadence tick). The device
+            # engine remains the post-hoc source of truth.
+            from jepsen_tpu.checkers import wgl_native
+            if wgl_native.available():
+                kw["algorithm"] = "wgl-native"
+                kw.setdefault("time_limit", max(5.0, 5 * self.interval_s))
+            else:
+                kw["algorithm"] = "auto"
+        checker = linearizable(self.model, **kw)
+        res = check_safe(checker, None, prefix)
+        self._flushes += 1
+        if res.get("valid") is True:
+            self._checked_upto = len(prefix)
+            self._inconclusive_tail = 0
+        elif res.get("valid") is False:
+            self._checked_upto = len(prefix)
+            self._inconclusive_tail = 0
+            res["prefix-ops"] = len(prefix)
+            res["detected-at-flush"] = self._flushes
+            self.violation = res
+            log.warning("online check: violation after %d ops (%s)",
+                        len(prefix), res.get("op"))
+            if self.on_violation is not None:
+                try:
+                    self.on_violation(res)
+                except Exception:                       # noqa: BLE001
+                    pass
+        else:
+            # inconclusive (engine timeout / overflow): do NOT advance —
+            # these ops are re-checked next flush, and result() must not
+            # claim them verified
+            self._inconclusive_tail = len(prefix) - self._checked_upto
+        return self.violation
+
+    # -- thread lifecycle ----------------------------------------------------
+
+    def start(self) -> "OnlineLinearizable":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="jepsen-online-check")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set() and self.violation is None:
+            self._wake.wait(self.interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                break
+            try:
+                self.flush()
+            except Exception as e:                      # noqa: BLE001
+                log.warning("online check flush failed: %s", e)
+
+    def stop(self) -> Dict[str, Any]:
+        """Stop the thread, run one final flush, and return
+        :meth:`result`."""
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(30)
+        try:
+            self.flush()
+        except Exception as e:                          # noqa: BLE001
+            log.warning("online check final flush failed: %s", e)
+        return self.result()
+
+    def result(self) -> Dict[str, Any]:
+        if self.violation is not None:
+            out = dict(self.violation)
+            out["valid"] = False
+            return out
+        out: Dict[str, Any] = {"valid": True,
+                               "ops-checked": self._checked_upto,
+                               "flushes": self._flushes}
+        if self._inconclusive_tail:
+            # the last flush(es) were inconclusive: the tail was never
+            # verified, so the monitor's verdict is only "no violation
+            # SEEN", not a clean bill
+            out["valid"] = "unknown"
+            out["unchecked-tail-ops"] = self._inconclusive_tail
+        return out

@@ -98,6 +98,8 @@ def _cmd_run(args) -> int:
         test["ssh"] = {"username": args.username,
                        "password": args.password,
                        "private-key-path": args.ssh_private_key}
+        if getattr(args, "online", False):
+            test["online-check"] = True
         done = core.run(test)
         valid = done["results"].get("valid")
         print(json.dumps({"run": i, "name": done["name"], "valid": valid,
@@ -150,6 +152,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       choices=["linearizable", "sloppy"])
     runp.add_argument("--algorithm", default="auto")
     runp.add_argument("--no-nemesis", action="store_true")
+    runp.add_argument("--online", action="store_true",
+                      help="live linearizability monitoring: re-check the "
+                           "history during the run and abort on the first "
+                           "violation")
     runp.set_defaults(fn=_cmd_run)
 
     servep = sub.add_parser("serve", help="browse results over HTTP")

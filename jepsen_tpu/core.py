@@ -40,12 +40,16 @@ log = logging.getLogger("jepsen.core")
 
 
 class History:
-    """Thread-safe append-only history with optional JSONL streaming."""
+    """Thread-safe append-only history with optional JSONL streaming and
+    an optional observer (e.g. the online checker) notified of every op
+    in append order."""
 
-    def __init__(self, stream_path: Optional[str] = None):
+    def __init__(self, stream_path: Optional[str] = None,
+                 observer: Optional[Any] = None):
         self._ops: List[Op] = []
         self._lock = threading.Lock()
         self._file = open(stream_path, "w") if stream_path else None
+        self._observer = observer
 
     def append(self, op: Op) -> Op:
         import json
@@ -57,6 +61,11 @@ class History:
             if self._file:
                 self._file.write(json.dumps(op.to_dict(), default=str) + "\n")
                 self._file.flush()
+            if self._observer is not None:
+                try:
+                    self._observer(op)
+                except Exception:                       # noqa: BLE001
+                    pass                # observers must not break the run
         return op
 
     def snapshot(self) -> List[Op]:
@@ -239,10 +248,37 @@ def run(test: Mapping) -> Dict[str, Any]:
         log_handler = store_mod.attach_log(store_dir)
     log.info("Running test %s", test.get("name"))
 
+    online = None
+    if test.get("online-check"):
+        from jepsen_tpu.checkers.facade import _model_from
+        from jepsen_tpu.checkers.online import OnlineLinearizable
+        try:
+            online_model = _model_from(None, test)
+        except ValueError:
+            log.warning("online-check requested but the test map has no "
+                        "model (suite %s); monitoring disabled",
+                        test.get("name"))
+            online_model = None
+        if online_model is not None:
+            online = OnlineLinearizable(
+                online_model, **(test.get("online-opts") or {}))
     history = History(
-        stream_path=f"{store_dir}/history.jsonl" if store_dir else None)
+        stream_path=f"{store_dir}/history.jsonl" if store_dir else None,
+        observer=online.observe if online else None)
     run_state = _Run(history, _time.monotonic())
     test["active-processes"] = lambda: set(run_state.active)
+    if online is not None:
+        # fail fast: a violated prefix can never become valid again.
+        # Chain rather than replace any caller-supplied callback.
+        user_cb = online.on_violation
+
+        def _abort(v, _cb=user_cb):
+            if _cb is not None:
+                _cb(v)
+            run_state.stop.set()
+
+        online.on_violation = _abort
+        online.start()
 
     try:
         os_setup.setup_all(test)
@@ -302,6 +338,13 @@ def run(test: Mapping) -> Dict[str, Any]:
     checker = test.get("checker")
     results = (check_safe(checker, test, test["history"])
                if checker is not None else {"valid": True})
+    if online is not None:
+        results["online-check"] = online.stop()
+        if results["online-check"].get("valid") is False:
+            # the online verdict is sound (no false alarms — see
+            # checkers/online.py); it must not be masked by a post-hoc
+            # "unknown" (state explosion / timeout) or a missing checker
+            results["valid"] = False
     test["results"] = results
     if store_dir:
         store_mod.save(test, store_dir)

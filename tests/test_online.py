@@ -1,0 +1,96 @@
+"""Online (live) linearizability monitoring — checkers/online.py."""
+import pytest
+
+from jepsen_tpu import core, fixtures
+from jepsen_tpu.checkers.online import OnlineLinearizable
+from jepsen_tpu.suites import register
+
+
+def test_valid_history_no_violation():
+    h = fixtures.gen_history("cas", n_ops=60, processes=4, seed=2)
+    mon = OnlineLinearizable(fixtures.model_for("cas"))
+    for op in h:
+        mon.observe(op)
+    mon.flush()
+    res = mon.result()
+    assert res["valid"] is True
+    assert res["ops-checked"] == len(h)
+
+
+def test_violation_detected_mid_stream_and_sticky():
+    h = fixtures.corrupt(
+        fixtures.gen_history("cas", n_ops=80, processes=4, seed=3), seed=3)
+    mon = OnlineLinearizable(fixtures.model_for("cas"))
+    first_bad_prefix = None
+    for i, op in enumerate(h):
+        mon.observe(op)
+        if i % 20 == 19:
+            v = mon.flush()
+            if v is not None and first_bad_prefix is None:
+                first_bad_prefix = v["prefix-ops"]
+    mon.flush()
+    res = mon.result()
+    assert res["valid"] is False
+    assert res["op"]
+    if first_bad_prefix is not None:
+        # sticky: the final result still reports the first detection
+        assert res["prefix-ops"] == first_bad_prefix
+    assert res["prefix-ops"] <= len(h)
+
+
+def test_pending_invokes_are_not_false_alarms():
+    """A prefix cut mid-operation (dangling invokes) must stay valid —
+    pending ops enter the analysis as optional crashed ops."""
+    h = fixtures.gen_history("cas", n_ops=50, processes=5, seed=4)
+    mon = OnlineLinearizable(fixtures.model_for("cas"))
+    for i, op in enumerate(h):
+        mon.observe(op)
+        if i % 7 == 6:                  # flush at arbitrary cut points
+            assert mon.flush() is None, f"false alarm at op {i}"
+    mon.flush()
+    assert mon.result()["valid"] is True
+
+
+def test_run_with_online_check_fails_fast():
+    t = register.register_test(mode="sloppy", time_limit=8.0, seed=11,
+                               with_nemesis=True, nemesis_interval=0.25,
+                               store=False, concurrency=5)
+    t["online-check"] = True
+    t["online-opts"] = {"interval_s": 0.3, "min_new_ops": 64}
+    done = core.run(t)
+    online = done["results"]["online-check"]
+    assert online["valid"] is False
+    assert online["prefix-ops"] <= len(done["history"])
+    # fail-fast: after detection only in-flight ops land, so the history
+    # stops shortly past the violating prefix (timing-independent signal
+    # that the abort fired, unlike a wall-clock bound)
+    assert len(done["history"]) <= online["prefix-ops"] + 2000
+    # the sound online verdict forces the top-level verdict
+    assert done["results"]["valid"] is False
+    # post-hoc remains the source of truth and agrees
+    assert done["results"]["results"]["linear"]["valid"] is False
+
+
+def test_online_check_without_model_is_disabled_not_fatal():
+    """Suites with no test["model"] (queue/set/counter) must run normally
+    with online-check requested — monitoring is skipped, not a crash."""
+    from jepsen_tpu.suites import queue as queue_suite
+    t = queue_suite.queue_test(mode="safe", time_limit=0.8, seed=3,
+                               with_nemesis=False, store=False,
+                               concurrency=3)
+    t["online-check"] = True
+    done = core.run(t)
+    assert done["results"]["valid"] is True
+    assert "online-check" not in done["results"]
+
+
+def test_valid_run_with_online_check():
+    t = register.register_test(mode="linearizable", time_limit=1.2,
+                               seed=7, with_nemesis=False, store=False,
+                               concurrency=4)
+    t["online-check"] = True
+    t["online-opts"] = {"interval_s": 0.2, "min_new_ops": 64}
+    done = core.run(t)
+    online = done["results"]["online-check"]
+    assert online["valid"] is True
+    assert online["flushes"] >= 1

@@ -196,17 +196,23 @@ class TestBatched:
 
 
 class TestChunked:
-    @pytest.mark.parametrize("n_chunks", [1, 3, 8])
-    def test_matches_sequential(self, n_chunks):
+    def test_matches_sequential(self):
+        # all chunk counts compared against ONE sequential verdict per
+        # seed — the sequential check is as costly as the chunked one
         model = fixtures.model_for("cas")
-        for seed in range(4):
-            h = fixtures.gen_history("cas", n_ops=40, processes=4, seed=seed,
+        for seed in range(2):           # seed 0 corrupt, seed 1 valid
+            # 3 processes keeps the basis config space D = S·2^W small —
+            # the basis walk costs D× the sequential walk and this test
+            # only asserts fold/localization correctness, not capacity
+            h = fixtures.gen_history("cas", n_ops=40, processes=3, seed=seed,
                                      crash_p=0.05)
             if seed % 2 == 0:
                 h = fixtures.corrupt(h, seed=seed)
             want = reach.check(model, h)["valid"]
-            got = reach.check_chunked(model, h, n_chunks=n_chunks)["valid"]
-            assert got == want, (seed, n_chunks)
+            for n_chunks in (1, 3, 8):
+                got = reach.check_chunked(model, h,
+                                          n_chunks=n_chunks)["valid"]
+                assert got == want, (seed, n_chunks)
 
     def test_sharded_over_mesh(self):
         import jax
