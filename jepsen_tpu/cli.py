@@ -52,8 +52,8 @@ def _suite_mode(mode: str, cluster_cls) -> str:
 def _cmd_run(args) -> int:
     from jepsen_tpu import core
     from jepsen_tpu.fake import FakeBroker
-    from jepsen_tpu.suites import (counter as counter_suite, mutex, queue,
-                                   register, set_suite)
+    from jepsen_tpu.suites import (counter as counter_suite, etcd, mutex,
+                                   queue, register, set_suite)
 
     logging.basicConfig(
         level=logging.INFO,
@@ -86,6 +86,11 @@ def _cmd_run(args) -> int:
             mode=args.mode, time_limit=args.time_limit,
             concurrency=args.concurrency, seed=args.seed,
             with_nemesis=not args.no_nemesis, store=True, nodes=nodes or 5),
+        "etcd": lambda: etcd.etcd_test(
+            mode=args.mode, time_limit=args.time_limit,
+            concurrency=args.concurrency, seed=args.seed,
+            with_nemesis=not args.no_nemesis, store=True,
+            algorithm=args.algorithm, nodes=nodes or 5),
     }
     if args.suite not in builders:
         print(f"unknown suite {args.suite!r}; have {sorted(builders)}",

@@ -16,6 +16,7 @@ from typing import Any, Dict, Mapping, Optional
 from jepsen_tpu import client as cl
 from jepsen_tpu import generators as g
 from jepsen_tpu import independent, models, nemesis, util
+from jepsen_tpu.suites import _common
 from jepsen_tpu.checkers import facade, perf, timeline
 from jepsen_tpu.fake import FakeCluster, Unavailable
 from jepsen_tpu.fake.cluster import FakeTimeout
@@ -92,13 +93,7 @@ def register_test(mode: str = "linearizable", *,
     generator: g.GenLike = client_gen
     if with_nemesis:
         nem = nemesis.partition_random_halves(seed=seed)
-        nem_gen = g.Seq([{"sleep": nemesis_interval / 2},
-                         g.cycle(lambda: g.Seq([
-                             {"f": "start"},
-                             {"sleep": nemesis_interval},
-                             {"f": "stop"},
-                             {"sleep": nemesis_interval}]))])
-        generator = g.clients_gen(client_gen, nem_gen)
+        generator = _common.nemesis_schedule(client_gen, nemesis_interval)
     return {
         "name": f"register-{mode}",
         "nodes": node_names,
@@ -107,14 +102,8 @@ def register_test(mode: str = "linearizable", *,
         "nemesis": nem,
         "generator": generator,
         "model": models.cas_register(),
-        "checker": facade.compose({
-            "linear": facade.linearizable(models.cas_register(),
-                                          algorithm=algorithm),
-            "timeline": timeline.html(),
-            "latency": perf.latency_graph(),
-            "rate": perf.rate_graph(),
-            "stats": facade.stats(),
-        }),
+        "checker": _common.standard_checker(models.cas_register(),
+                                            algorithm=algorithm),
         "concurrency": concurrency,
         "store": store,
         "run-time-limit": max(60.0, time_limit * 6),
