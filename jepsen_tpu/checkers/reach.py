@@ -428,6 +428,58 @@ def _bucket(x: int, grain: int = 8) -> int:
     return -(-(m << e) // grain) * grain
 
 
+# memo tables depend only on (model, alphabet-as-a-SET, cap) — identical
+# across the keys of a uniform `independent` workload, where rebuilding
+# the BFS per key dominated host time (~40% of a 1024-key warm check).
+# Alphabets are canonicalized by sorting (per-key id assignment is
+# occurrence-ordered, so two keys running the same workload usually
+# disagree on order); on every hit the cached table's columns are
+# permuted back to the history's local op-id order (state ids are
+# arbitrary labels, so no other remap is needed). Bounded by entry
+# count AND per-entry bytes —
+# big memos (state-rich models) are not worth pinning for the process
+# lifetime.
+_MEMO_CACHE: "Dict[Any, Memo]" = {}
+_MEMO_CACHE_MAX = 512
+_MEMO_CACHE_MAX_ENTRY_BYTES = 1 << 20
+
+
+def _op_sort_key(t):
+    return (repr(t[0]), repr(t[1]))
+
+
+def _cached_memo(model: Model, packed: h.PackedHistory,
+                 max_states: int) -> Memo:
+    """Memo for ``packed``'s alphabet, cached across histories. The
+    cache entry is built on the SORTED alphabet (hit regardless of
+    per-history occurrence order); on return its table columns are
+    permuted back to this history's local op-id order and its
+    ``distinct_ops`` are THIS history's ops — callers and failure
+    witnesses never see another history's op objects."""
+    keys = [(op.f, hashable(op.value)) for op in packed.distinct_ops]
+    try:
+        order = sorted(range(len(keys)), key=lambda i: _op_sort_key(keys[i]))
+        sig = (model, max_states, tuple(keys[i] for i in order))
+        hash(sig)
+    except TypeError:                   # unhashable model/values: no cache
+        return build_memo(model, packed, max_states=max_states)
+    m = _MEMO_CACHE.get(sig)
+    if m is None:
+        canonical_ops = tuple(packed.distinct_ops[i] for i in order)
+        m = memo_ops(model, canonical_ops, max_states=max_states)
+        if m.table.nbytes <= _MEMO_CACHE_MAX_ENTRY_BYTES:
+            if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
+                _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)))
+            _MEMO_CACHE[sig] = m
+    # local op id i lives in canonical column lut[i]
+    lut = np.empty(len(keys), np.int32)
+    for col, i in enumerate(order):
+        lut[i] = col
+    return Memo(table=np.ascontiguousarray(m.table[:, lut]),
+                states=m.states, distinct_ops=packed.distinct_ops,
+                initial=m.initial)
+
+
 def _pad_table(memo: Memo, S_pad: int, O_pad: int) -> np.ndarray:
     """Transition table padded to [S_pad, O_pad+1]; everything outside the
     real region (including the sentinel last column for opid=-1) is -1."""
@@ -443,7 +495,7 @@ def _prep(model: Model, packed: h.PackedHistory, *,
     """Shared host-side pipeline: memo table + slotted event stream, with
     the event axis padded to :func:`_bucket` sizes (8 per octave) so jit
     compilations are reused across histories of similar size."""
-    memo = build_memo(model, packed, max_states=max_states)
+    memo = _cached_memo(model, packed, max_states)
     stream = ev.build(packed, memo, max_slots=max_slots)
     S = memo.n_states
     S_pad = max(2, _next_pow2(S))

@@ -180,6 +180,39 @@ class TestBatched:
             if not r["valid"]:
                 assert s["op"] == r["op"]
 
+    def test_memo_cache_order_independent(self):
+        """Histories with the same op alphabet in different occurrence
+        orders share one cache entry, and the permuted-back table equals
+        a fresh build exactly."""
+        model = fixtures.model_for("cas")
+        h1 = fixtures.gen_history("cas", n_ops=40, processes=3, seed=0)
+        h2 = fixtures.gen_history("cas", n_ops=40, processes=3, seed=5)
+        p1, p2 = pack(h1), pack(h2)
+        reach._MEMO_CACHE.clear()
+        m1 = reach._cached_memo(model, p1, 100_000)
+        size_after_first = len(reach._MEMO_CACHE)
+        m2 = reach._cached_memo(model, p2, 100_000)
+        # same (f, value) alphabet -> no second BFS entry
+        k1 = sorted((op.f, repr(op.value)) for op in p1.distinct_ops)
+        k2 = sorted((op.f, repr(op.value)) for op in p2.distinct_ops)
+        if k1 == k2:
+            assert len(reach._MEMO_CACHE) == size_after_first
+        # state ids are arbitrary labels (BFS order over the canonical
+        # alphabet differs from a local build); what must hold is the
+        # semantic invariant: table[s, i] names exactly step(states[s],
+        # distinct_ops[i]), with this history's own ops in local order
+        from jepsen_tpu.models import is_inconsistent
+        for m, p in ((m1, p1), (m2, p2)):
+            assert m.distinct_ops == p.distinct_ops
+            assert m.states[m.initial] == model
+            for s, st in enumerate(m.states):
+                for i, op in enumerate(m.distinct_ops):
+                    nxt = st.step(op)
+                    if is_inconsistent(nxt):
+                        assert m.table[s, i] == -1
+                    else:
+                        assert m.states[m.table[s, i]] == nxt
+
     def test_hybrid_mesh_single_host(self):
         """hybrid_mesh degrades to 1xN single-host; keys_sharding places
         the batch axis on the inner (ICI) axis."""
