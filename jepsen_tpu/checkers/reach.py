@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -440,8 +441,12 @@ def _bucket(x: int, grain: int = 8) -> int:
 # big memos (state-rich models) are not worth pinning for the process
 # lifetime.
 _MEMO_CACHE: "Dict[Any, Memo]" = {}
+_MEMO_CACHE_LOCK = threading.Lock()
 _MEMO_CACHE_MAX = 512
 _MEMO_CACHE_MAX_ENTRY_BYTES = 1 << 20
+# `states` pins one Model object per reachable state — for state-rich
+# models that dwarfs the table, so cap the state count too
+_MEMO_CACHE_MAX_ENTRY_STATES = 4096
 
 
 def _op_sort_key(t):
@@ -463,14 +468,19 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
         hash(sig)
     except TypeError:                   # unhashable model/values: no cache
         return build_memo(model, packed, max_states=max_states)
-    m = _MEMO_CACHE.get(sig)
+    with _MEMO_CACHE_LOCK:
+        m = _MEMO_CACHE.get(sig)
     if m is None:
         canonical_ops = tuple(packed.distinct_ops[i] for i in order)
         m = memo_ops(model, canonical_ops, max_states=max_states)
-        if m.table.nbytes <= _MEMO_CACHE_MAX_ENTRY_BYTES:
-            if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
-                _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)))
-            _MEMO_CACHE[sig] = m
+        if (m.table.nbytes <= _MEMO_CACHE_MAX_ENTRY_BYTES
+                and m.n_states <= _MEMO_CACHE_MAX_ENTRY_STATES):
+            # facade races engines on threads and the online monitor
+            # flushes from its own — guard lookup/insert/eviction
+            with _MEMO_CACHE_LOCK:
+                if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
+                    _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)), None)
+                _MEMO_CACHE[sig] = m
     # local op id i lives in canonical column lut[i]
     lut = np.empty(len(keys), np.int32)
     for col, i in enumerate(order):

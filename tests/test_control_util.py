@@ -1,0 +1,98 @@
+"""Node-automation helpers (control_util.py) against a REAL local shell
+(LocalRemote), and the OS / net / db layers against the scripted
+FakeRemote — the unit tier upstream lacks for its control stack."""
+import os
+import tarfile
+import time
+
+from jepsen_tpu import control, control_util as cu, db as db_mod
+from jepsen_tpu import net as net_mod
+from jepsen_tpu import os_setup
+
+
+def _local_session(node="n1"):
+    return control.Session(control.LocalRemote(), node)
+
+
+def test_exists_and_ls_full(tmp_path):
+    s = _local_session()
+    assert cu.exists(s, str(tmp_path))
+    assert not cu.exists(s, str(tmp_path / "nope"))
+    (tmp_path / "a").write_text("x")
+    (tmp_path / "b").write_text("y")
+    assert sorted(cu.ls_full(s, str(tmp_path))) == \
+        [str(tmp_path / "a"), str(tmp_path / "b")]
+
+
+def test_daemon_lifecycle(tmp_path):
+    s = _local_session()
+    pidfile = str(tmp_path / "sleep.pid")
+    logfile = str(tmp_path / "sleep.log")
+    cu.start_daemon(s, "sleep", "60", pidfile=pidfile, logfile=logfile)
+    time.sleep(0.2)
+    assert cu.daemon_running(s, pidfile)
+    cu.stop_daemon(s, "sleep", pidfile=pidfile)
+    time.sleep(0.2)
+    assert not cu.daemon_running(s, pidfile)
+    assert not os.path.exists(pidfile)
+
+
+def test_install_archive_from_file_url(tmp_path):
+    s = _local_session()
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "bin").mkdir()
+    (src / "bin" / "tool").write_text("#!/bin/sh\n")
+    tar = tmp_path / "pkg.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(src, arcname="pkg-1.0")
+    dest = tmp_path / "installed"
+    out = cu.install_archive(s, f"file://{tar}", str(dest))
+    assert out == str(dest)
+    # single top-level dir stripped, contents at dest root
+    assert (dest / "bin" / "tool").exists()
+    # idempotent: second call is a no-op, not a re-unpack
+    assert cu.install_archive(s, f"file://{tar}", str(dest)) == str(dest)
+
+
+def test_debian_and_centos_setup_commands():
+    for os_impl, installer in ((os_setup.debian(), "apt-get"),
+                               (os_setup.centos(), "yum")):
+        # dpkg -s probes must FAIL so the debian path reaches apt-get
+        remote = control.FakeRemote(responses={"dpkg -s": (1, "")})
+        test = {"remote": remote, "nodes": ["n1"], "ssh": {}}
+        os_impl.setup(test, "n1")
+        cmds = [c for _, c in remote.commands]
+        assert any(installer in c for c in cmds), (installer, cmds)
+        assert any("hostname" in c for c in cmds)
+
+
+def test_iptables_net_commands():
+    remote = control.FakeRemote()
+    test = {"remote": remote, "nodes": ["n1", "n2"], "ssh": {}}
+    net = net_mod.IptablesNet()
+    net.drop(test, "n1", "n2")
+    assert any("iptables" in c and "DROP" in c and node == "n2"
+               for node, c in remote.commands)
+    net.heal(test)
+    assert any("iptables" in c and ("-F" in c or "-D" in c)
+               for _, c in remote.commands)
+    net.slow(test, mean_ms=50)
+    assert any("netem" in c and "delay" in c for _, c in remote.commands)
+    net.flaky(test, prob=0.2)
+    assert any("netem" in c and "loss" in c for _, c in remote.commands)
+    net.fast(test)
+    assert any("qdisc del" in c for _, c in remote.commands)
+
+
+def test_snarf_logs_downloads_db_logfiles(tmp_path):
+    class LoggingDB(db_mod.DB):
+        def log_files(self, test, node):
+            return [f"/var/log/db-{node}.log"]
+
+    remote = control.FakeRemote()
+    test = {"remote": remote, "nodes": ["n1", "n2"], "ssh": {},
+            "db": LoggingDB()}
+    db_mod.snarf_logs(test, str(tmp_path))
+    assert sorted(d[1] for d in remote.downloads) == \
+        ["/var/log/db-n1.log", "/var/log/db-n2.log"]

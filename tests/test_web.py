@@ -1,0 +1,62 @@
+"""Results browser (web.py): index over the store, artifact serving,
+path traversal safety."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import core, web
+from jepsen_tpu.suites import register
+
+
+@pytest.fixture
+def store_with_run(tmp_path):
+    t = register.register_test(mode="linearizable", time_limit=0.6,
+                               seed=2, with_nemesis=False, store=True,
+                               concurrency=3)
+    t["store-root"] = str(tmp_path)
+    done = core.run(t)
+    return str(tmp_path), done
+
+
+def _fetch(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+def test_index_and_artifacts(store_with_run):
+    root, done = store_with_run
+    httpd = web.serve(root=root, port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        status, body = _fetch(f"http://127.0.0.1:{port}/")
+        assert status == 200
+        assert "register-linearizable" in body
+        assert "True" in body                   # the valid? column
+        rel = done["dir"].replace(root, "").lstrip("/")
+        status, res = _fetch(
+            f"http://127.0.0.1:{port}/files/{rel}/results.json")
+        assert status == 200
+        assert json.loads(res)["valid"] is True
+        status, hist = _fetch(
+            f"http://127.0.0.1:{port}/files/{rel}/history.txt")
+        assert status == 200 and "invoke" in hist
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_path_traversal_stays_inside_store(store_with_run):
+    root, _ = store_with_run
+    httpd = web.serve(root=root, port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        with pytest.raises(urllib.error.HTTPError):
+            # normpath collapses the ../.. inside translate_path; the
+            # result must not escape the store root
+            _fetch(f"http://127.0.0.1:{port}/files/..%2f..%2f..%2f"
+                   f"etc%2fpasswd")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
