@@ -25,13 +25,19 @@ def test_exists_and_ls_full(tmp_path):
 
 
 def test_daemon_lifecycle(tmp_path):
+    import shutil
     s = _local_session()
-    pidfile = str(tmp_path / "sleep.pid")
-    logfile = str(tmp_path / "sleep.log")
-    cu.start_daemon(s, "sleep", "60", pidfile=pidfile, logfile=logfile)
+    # a uniquely-named binary: stop_daemon falls through to
+    # `pkill -f <basename>`, which must not match unrelated processes
+    binary = str(tmp_path / "jt-test-daemon-xk91")
+    shutil.copy("/bin/sleep", binary)
+    os.chmod(binary, 0o755)
+    pidfile = str(tmp_path / "d.pid")
+    logfile = str(tmp_path / "d.log")
+    cu.start_daemon(s, binary, "60", pidfile=pidfile, logfile=logfile)
     time.sleep(0.2)
     assert cu.daemon_running(s, pidfile)
-    cu.stop_daemon(s, "sleep", pidfile=pidfile)
+    cu.stop_daemon(s, binary, pidfile=pidfile)
     time.sleep(0.2)
     assert not cu.daemon_running(s, pidfile)
     assert not os.path.exists(pidfile)

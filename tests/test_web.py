@@ -34,7 +34,8 @@ def test_index_and_artifacts(store_with_run):
         assert status == 200
         assert "register-linearizable" in body
         assert "True" in body                   # the valid? column
-        rel = done["dir"].replace(root, "").lstrip("/")
+        import os
+        rel = os.path.relpath(done["dir"], root)
         status, res = _fetch(
             f"http://127.0.0.1:{port}/files/{rel}/results.json")
         assert status == 200
