@@ -62,17 +62,22 @@ class Linearizable(Checker):
 
     ``algorithm``:
 
-    - ``"auto"`` (default): the TPU dense-reachability engine; falls back to
-      the CPU WGL search when the history does not fit the dense config
-      space (state explosion / too many concurrent pending ops).
-    - ``"reach"`` / ``"reach-chunked"`` — device engine, sequential or
-      history-parallel (:mod:`jepsen_tpu.checkers.reach`).
+    - ``"auto"`` (default): the TPU dense-reachability engine; when the
+      history does not fit the dense config space (state explosion / too
+      many concurrent pending ops) falls back to the C++ WGL search, then
+      to the sparse-frontier device engine (whose crashed-op quotient
+      survives crash-heavy histories that explode the exact searches),
+      then to the Python oracle.
+    - ``"reach"`` / ``"reach-chunked"`` — dense device engine, sequential
+      or history-parallel (:mod:`jepsen_tpu.checkers.reach`).
+    - ``"frontier"`` — sparse batched-frontier device engine for
+      high-concurrency histories (:mod:`jepsen_tpu.checkers.frontier`).
     - ``"wgl-native"`` — the C++ WGL search
       (:mod:`jepsen_tpu.checkers.wgl_native`).
     - ``"wgl-cpu"`` — the Python oracle (:mod:`jepsen_tpu.checkers.wgl_ref`).
     - ``"linear"`` — sparse just-in-time linearization, upstream
       ``knossos.linear`` (:mod:`jepsen_tpu.checkers.linear`).
-    - ``"competition"`` — device engine raced against the CPU searches
+    - ``"competition"`` — device engines raced against the CPU searches
       (WGL native/Python plus JIT-linearization) on threads, first
       definitive verdict wins and the losers are aborted (upstream
       ``knossos.competition`` racing wgl against linear).
@@ -100,7 +105,7 @@ class Linearizable(Checker):
         return res
 
     def _check_impl(self, test, history, opts=None):
-        from jepsen_tpu.checkers import reach, wgl_native, wgl_ref
+        from jepsen_tpu.checkers import frontier, reach, wgl_native, wgl_ref
         from jepsen_tpu.checkers.events import ConcurrencyOverflow
         from jepsen_tpu.models.memo import StateExplosion
 
@@ -114,6 +119,9 @@ class Linearizable(Checker):
         if algorithm == "reach-chunked":
             return reach.check_chunked(model, history,
                                        **_engine_kw(kw, _CHUNKED_KW))
+        if algorithm == "frontier":
+            return frontier.check(model, history,
+                                  **_engine_kw(kw, _FRONTIER_KW))
         if algorithm == "wgl-native":
             return wgl_native.check(model, history,
                                     **_engine_kw(kw, _NATIVE_KW))
@@ -134,10 +142,21 @@ class Linearizable(Checker):
                 try:
                     res = wgl_native.check(model, history,
                                            **_engine_kw(kw, _NATIVE_KW))
-                    res["engine"] = "wgl-native-fallback"
-                    return res
+                    if res.get("valid") in (True, False):
+                        res["engine"] = "wgl-native-fallback"
+                        return res
                 except StateExplosion:
                     pass            # un-memoizable model: lazy Python path
+            try:
+                # the frontier engine's crashed-op quotient can survive
+                # crash-heavy histories that explode the exact C++ search
+                res = frontier.check(model, history,
+                                     **_engine_kw(kw, _FRONTIER_KW))
+                if res.get("valid") in (True, False):
+                    res["engine"] = "frontier-fallback"
+                    return res
+            except Exception:                           # noqa: BLE001
+                pass        # overflow or device failure: Python path next
             res = wgl_ref.check(model, history, **_engine_kw(kw, _WGL_KW))
             res["engine"] = "wgl-cpu-fallback"
             return res
@@ -150,6 +169,8 @@ class Linearizable(Checker):
 # checker config can carry opts for every algorithm it may route to.
 _REACH_KW = ("max_states", "max_slots", "max_dense")
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
+_FRONTIER_KW = ("max_states", "frontier0", "max_frontier", "time_limit",
+                "should_abort")
 _WGL_KW = ("time_limit", "max_configs", "strategy", "should_abort")
 _NATIVE_KW = ("time_limit", "max_configs", "max_states", "abort_flag")
 _LINEAR_KW = ("time_limit", "max_configs", "rep", "should_abort")
@@ -169,7 +190,8 @@ def _competition(model: Model, history: Sequence[Op],
     used."""
     import queue
 
-    from jepsen_tpu.checkers import linear, reach, wgl_native, wgl_ref
+    from jepsen_tpu.checkers import (
+        frontier, linear, reach, wgl_native, wgl_ref)
     from jepsen_tpu.checkers.search import SearchControl
 
     ctl = SearchControl(time_limit=kw.get("time_limit")).start()
@@ -210,9 +232,21 @@ def _competition(model: Model, history: Sequence[Op],
         except Exception as e:                          # noqa: BLE001
             verdicts.put(("linear", {"valid": "unknown", "error": str(e)}))
 
+    def run_frontier():
+        try:
+            r = frontier.check(model, history,
+                               should_abort=ctl.should_abort,
+                               **_engine_kw(kw, ("max_states", "frontier0",
+                                                 "max_frontier")))
+            verdicts.put(("frontier", r))
+        except Exception as e:                          # noqa: BLE001
+            verdicts.put(("frontier", {"valid": "unknown",
+                                       "error": str(e)}))
+
     threads = [threading.Thread(target=run_cpu, daemon=True),
                threading.Thread(target=run_tpu, daemon=True),
-               threading.Thread(target=run_linear, daemon=True)]
+               threading.Thread(target=run_linear, daemon=True),
+               threading.Thread(target=run_frontier, daemon=True)]
     for t in threads:
         t.start()
     winner: Optional[Dict[str, Any]] = None

@@ -7,7 +7,7 @@ import pytest
 
 from jepsen_tpu import history as h
 from jepsen_tpu import models
-from jepsen_tpu.checkers import reach, wgl_native, wgl_ref
+from jepsen_tpu.checkers import frontier, reach, wgl_native, wgl_ref
 
 DATA = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "data")
@@ -32,6 +32,8 @@ def test_all_engines_agree(fname, model_fn, want):
     packed = h.pack(hist)
     model = model_fn()
     assert reach.check_packed(model, packed)["valid"] is want
+    assert frontier.check_packed(model, packed, frontier0=64)["valid"] \
+        is want
     assert wgl_ref.check_packed(model, packed)["valid"] is want
     if wgl_native.available():
         assert wgl_native.check_packed(model, packed)["valid"] is want

@@ -1,0 +1,222 @@
+"""Sparse batched-frontier engine tests: hand-written verdicts,
+differential agreement with the CPU WGL oracle (including crash-heavy
+histories), the crashed-op interchangeability quotient beating the exact
+CPU searches, capacity-overflow and abort behaviour, and the facade's
+auto-fallback routing."""
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures
+from jepsen_tpu import models as m
+from jepsen_tpu.checkers import facade, frontier, wgl_native, wgl_ref
+from jepsen_tpu.history import index
+from jepsen_tpu.op import info, invoke, ok
+
+
+def hist(*ops):
+    return index(list(ops))
+
+
+def crash_heavy(n_crashed=24, n_live=20, value=1):
+    """``n_crashed`` processes invoke write(value) and never return, with a
+    successful read(0) interleaved after each crash; a live process then
+    does read/write traffic. Valid, but the crashed writes share one op id
+    — the interleaved reads make the exact searches reach ~2**n_crashed
+    distinct linearized subsets (config-set explosion for C++ WGL), while
+    the quotient keeps ~n_crashed+1 canonical configs."""
+    h = [invoke(0, "write", 0), ok(0, "write", 0)]
+    for c in range(n_crashed):
+        h += [invoke(100 + c, "write", value), info(100 + c, "write", value),
+              invoke(0, "read"), ok(0, "read", 0)]
+    for i in range(n_live):
+        v = i % 3
+        h += [invoke(0, "write", v), ok(0, "write", v),
+              invoke(0, "read"), ok(0, "read", v)]
+    return index(h)
+
+
+class TestHandWritten:
+    def test_empty_valid(self):
+        assert frontier.check(m.register(), [])["valid"] is True
+
+    def test_sequential_rw_valid(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        res = frontier.check(m.register(), h, frontier0=64)
+        assert res["valid"] is True
+        assert res["engine"] == "frontier"
+
+    def test_stale_read_invalid_with_evidence(self):
+        h = hist(
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(0, "write", 2), ok(0, "write", 2),
+            invoke(0, "read"), ok(0, "read", 1),
+        )
+        res = frontier.check(m.register(), h, frontier0=64)
+        assert res["valid"] is False
+        assert res["op"]["f"] == "read"
+        assert res["op"]["value"] == 1
+        assert res["previous-ok"]["f"] == "write"
+        assert res["previous-ok"]["value"] == 2
+        assert len(res["final-configs"]) >= 1
+        assert any("2" in c["model"] for c in res["final-configs"])
+
+    def test_crashed_write_both_branches(self):
+        base = [
+            invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "write", 2), info(1, "write", 2),
+            invoke(0, "read"),
+        ]
+        ok_seen = frontier.check(m.register(),
+                                 hist(*base, ok(0, "read", 2)),
+                                 frontier0=64)
+        ok_unseen = frontier.check(m.register(),
+                                   hist(*base, ok(0, "read", 1)),
+                                   frontier0=64)
+        assert ok_seen["valid"] is True
+        assert ok_unseen["valid"] is True
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("kind", ["register", "cas", "mutex"])
+    def test_agrees_with_oracle_crash_heavy(self, kind):
+        for seed in range(4):
+            h = fixtures.gen_history(kind, n_ops=30, processes=3, values=3,
+                                     crash_p=0.2, seed=seed)
+            model = fixtures.model_for(kind)
+            ref = wgl_ref.check(model, h)
+            got = frontier.check(model, h, frontier0=64)
+            assert got["valid"] == ref["valid"], (kind, seed)
+
+    def test_agrees_on_corrupted(self):
+        for seed in range(3):
+            h = fixtures.gen_history("cas", n_ops=40, processes=3,
+                                     seed=seed)
+            hb = fixtures.corrupt(h, seed=seed)
+            got = frontier.check(m.cas_register(), hb, frontier0=64)
+            assert got["valid"] is False
+
+    def test_fixture_files(self):
+        import os
+
+        from jepsen_tpu import history as H
+        data = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "data")
+        for name, model, want in [
+            ("register-ok.edn", m.register(), True),
+            ("register-bad.edn", m.register(), False),
+            ("cas-register-ok-small.edn", m.cas_register(), True),
+            ("cas-register-bad.edn", m.cas_register(), False),
+        ]:
+            h = H.load_edn(os.path.join(data, name))
+            res = frontier.check(model, h, frontier0=64)
+            assert res["valid"] is want, name
+
+
+class TestCrashedOpQuotient:
+    def test_beats_exact_searches(self):
+        """24 same-id crashed writes: 25 canonical configs for the
+        quotient, config-set explosion for the exact C++ WGL search."""
+        h = crash_heavy()
+        res = frontier.check(m.register(), h, frontier0=64)
+        assert res["valid"] is True
+        assert res["slots"] >= 24
+        assert res["frontier-cap"] <= 256
+        if wgl_native.available():
+            rn = wgl_native.check(m.register(), h, max_configs=100_000)
+            assert rn["valid"] == "unknown"
+
+    def test_quotient_does_not_merge_live_ops(self):
+        """Two concurrent pending writes of the SAME value, one crashed
+        and one live: the live op's return must still require its own
+        linearization (a quotient that grouped live with crashed would
+        wrongly accept firing only the crashed one)."""
+        h = hist(
+            invoke(0, "write", 0), ok(0, "write", 0),
+            invoke(1, "write", 1), info(1, "write", 1),     # crashed
+            invoke(2, "write", 1),                          # live, pending
+            invoke(3, "read"), ok(3, "read", 1),
+            ok(2, "write", 1),                              # live returns
+            invoke(3, "write", 2), ok(3, "write", 2),
+            invoke(3, "read"), ok(3, "read", 1),  # stale: needs BOTH writes
+        )
+        res = frontier.check(m.register(), h, frontier0=64)
+        ref = wgl_ref.check(m.register(), h)
+        assert res["valid"] == ref["valid"]
+
+    def test_distinct_values_not_merged(self):
+        """Crashed writes of DIFFERENT values are different op ids and
+        must stay distinct configs."""
+        h = hist(
+            invoke(0, "write", 0), ok(0, "write", 0),
+            invoke(1, "write", 1), info(1, "write", 1),
+            invoke(2, "write", 2), info(2, "write", 2),
+            invoke(3, "read"), ok(3, "read", 1),
+            invoke(3, "read"), ok(3, "read", 2),
+            invoke(3, "read"), ok(3, "read", 1),   # 1 after 2: impossible
+        )
+        res = frontier.check(m.register(), h, frontier0=64)
+        assert res["valid"] is False
+
+
+class TestCrashedSlotScan:
+    def test_vectorized_matches_reference(self):
+        from jepsen_tpu.checkers import events as ev
+        from jepsen_tpu.checkers import reach
+        from jepsen_tpu.history import pack
+
+        for seed in range(6):
+            h = fixtures.gen_history("cas", n_ops=50, processes=4,
+                                     values=3, crash_p=0.25, seed=seed)
+            packed = pack(h)
+            memo = reach._cached_memo(m.cas_register(), packed, 100_000)
+            stream = ev.build(packed, memo, max_slots=frontier.MAX_SLOTS)
+            W = max(stream.W, 1)
+            got = frontier._crashed_slots(stream, packed, W)
+            ref = frontier._crashed_slots_ref(stream, packed, W)
+            assert np.array_equal(got, ref), seed
+
+
+class TestLimits:
+    def test_frontier_overflow_raises(self):
+        # distinct-value crashed CAS ops: the quotient cannot collapse
+        # them, so a tiny capacity must overflow
+        h = [invoke(0, "write", 0), ok(0, "write", 0)]
+        for c in range(10):
+            h += [invoke(100 + c, "cas", (c % 5, (c + 1) % 5)),
+                  info(100 + c, "cas", (c % 5, (c + 1) % 5))]
+        for i in range(6):
+            h += [invoke(0, "write", i % 5), ok(0, "write", i % 5)]
+        with pytest.raises(frontier.FrontierOverflow):
+            frontier.check(m.cas_register(), index(h), frontier0=64,
+                           max_frontier=64)
+
+    def test_abort_returns_unknown(self):
+        h = fixtures.gen_history("cas", n_ops=30, processes=3, seed=0)
+        res = frontier.check(m.cas_register(), h, frontier0=64,
+                             should_abort=lambda: True)
+        assert res["valid"] == "unknown"
+        assert res["cause"] == "aborted"
+
+
+class TestFacadeRouting:
+    def test_explicit_algorithm(self):
+        h = fixtures.gen_history("register", n_ops=20, processes=3, seed=1)
+        res = facade.linearizable(m.register(),
+                                  algorithm="frontier",
+                                  frontier0=64).check(None, h)
+        assert res["valid"] is True
+        assert res["engine"] == "frontier"
+
+    def test_auto_falls_back_to_frontier(self):
+        """>20 pending slots (dense engine overflows) with a same-id
+        crashed-op pile-up (exact C++ search explodes): auto must still
+        produce a definitive verdict via the frontier engine."""
+        h = crash_heavy()
+        res = facade.linearizable(
+            m.register(), max_configs=50_000,
+            frontier0=64).check(None, h)
+        assert res["valid"] is True
+        assert res["engine"] in ("frontier-fallback", "frontier")

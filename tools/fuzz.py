@@ -8,8 +8,10 @@ possibly an injected violation, then runs every applicable engine:
 - ``wgl_ref``   — readable Python WGL (the oracle)
 - ``linear``    — sparse JIT-linearization (array/set config sets)
 - ``wgl-native``— C++ memoized DFS
-- ``reach``     — the device engine (XLA walk; pass ``--pallas`` to also
-  run the fused kernel in interpret mode — slow but exact)
+- ``reach``     — the dense device engine (XLA walk; pass ``--pallas`` to
+  also run the fused kernel in interpret mode — slow but exact)
+- ``frontier``  — the sparse batched-frontier device engine (crashed-op
+  quotient), skipped on capacity overflow
 - ``brute``     — exhaustive permutation check on tiny histories
 
 Disagreement on a verdict (True/False; ``"unknown"`` is inconclusive and
@@ -79,6 +81,13 @@ def run_trial(params, seed: int, *, pallas: bool = False):
         verdicts["reach"] = reach.check_packed(model, packed)["valid"]
     except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion) as e:
         verdicts["reach"] = f"skipped: {type(e).__name__}"
+    try:
+        from jepsen_tpu.checkers import frontier
+        verdicts["frontier"] = frontier.check_packed(
+            model, packed, frontier0=64)["valid"]
+    except (frontier.FrontierOverflow, ConcurrencyOverflow,
+            StateExplosion) as e:
+        verdicts["frontier"] = f"skipped: {type(e).__name__}"
     if pallas:
         try:
             from jepsen_tpu.checkers import events as ev
