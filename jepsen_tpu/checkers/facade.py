@@ -72,6 +72,10 @@ class Linearizable(Checker):
       or history-parallel (:mod:`jepsen_tpu.checkers.reach`).
     - ``"frontier"`` — sparse batched-frontier device engine for
       high-concurrency histories (:mod:`jepsen_tpu.checkers.frontier`).
+    - ``"decompose"`` — P-compositional per-key split of single-key
+      multi-register histories into a batched register check
+      (:mod:`jepsen_tpu.checkers.decompose`); ``auto`` tries it first for
+      ``MultiRegister`` models.
     - ``"wgl-native"`` — the C++ WGL search
       (:mod:`jepsen_tpu.checkers.wgl_native`).
     - ``"wgl-cpu"`` — the Python oracle (:mod:`jepsen_tpu.checkers.wgl_ref`).
@@ -122,6 +126,14 @@ class Linearizable(Checker):
         if algorithm == "frontier":
             return frontier.check(model, history,
                                   **_engine_kw(kw, _FRONTIER_KW))
+        if algorithm == "decompose":
+            from jepsen_tpu.checkers import decompose
+            res = decompose.check(model, history,
+                                  **_engine_kw(kw, _DECOMPOSE_KW))
+            if res is None:
+                return {"valid": "unknown", "cause": "not-decomposable",
+                        "engine": "decompose"}
+            return res
         if algorithm == "wgl-native":
             return wgl_native.check(model, history,
                                     **_engine_kw(kw, _NATIVE_KW))
@@ -132,37 +144,65 @@ class Linearizable(Checker):
             return linear.check(model, history,
                                 **_engine_kw(kw, _LINEAR_KW))
         if algorithm == "auto":
-            try:
-                return reach.check(model, history,
-                                   **_engine_kw(kw, _REACH_KW))
-            except (reach.DenseOverflow, ConcurrencyOverflow,
-                    StateExplosion):
-                pass
-            if wgl_native.available():
+            from jepsen_tpu import models as _models
+            if isinstance(model, _models.MultiRegister):
+                # P-compositionality (Herlihy & Wing locality): a history
+                # of single-key ops splits into per-key register
+                # histories, batched as one keyed device call — avoiding
+                # the product-state blowup of the monolithic search. A
+                # decomposed "unknown" is returned as-is: the monolithic
+                # product space is strictly harder, so re-running the
+                # chain on it could only burn the budget again.
+                from jepsen_tpu.checkers import decompose
                 try:
-                    res = wgl_native.check(model, history,
-                                           **_engine_kw(kw, _NATIVE_KW))
-                    if res.get("valid") in (True, False):
-                        res["engine"] = "wgl-native-fallback"
+                    res = decompose.check(model, history,
+                                          **_engine_kw(kw, _DECOMPOSE_KW))
+                    if res is not None:
                         return res
-                except StateExplosion:
-                    pass            # un-memoizable model: lazy Python path
-            try:
-                # the frontier engine's crashed-op quotient can survive
-                # crash-heavy histories that explode the exact C++ search
-                res = frontier.check(model, history,
-                                     **_engine_kw(kw, _FRONTIER_KW))
-                if res.get("valid") in (True, False):
-                    res["engine"] = "frontier-fallback"
-                    return res
-            except Exception:                           # noqa: BLE001
-                pass        # overflow or device failure: Python path next
-            res = wgl_ref.check(model, history, **_engine_kw(kw, _WGL_KW))
-            res["engine"] = "wgl-cpu-fallback"
-            return res
+                except Exception:                       # noqa: BLE001
+                    pass            # fall through to the monolithic chain
+            return auto_check_packed(model, h.pack(history), kw)
         if algorithm == "competition":
             return _competition(model, history, kw)
         raise ValueError(f"unknown algorithm {algorithm!r}")
+
+
+def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
+    """The ``auto`` fallback chain at the packed level: dense device
+    engine → C++ WGL → sparse frontier → Python oracle, first conclusive
+    verdict wins. Shared by :class:`Linearizable` and the per-key
+    fallback in :mod:`jepsen_tpu.checkers.decompose`."""
+    from jepsen_tpu.checkers import frontier, reach, wgl_native, wgl_ref
+    from jepsen_tpu.checkers.events import ConcurrencyOverflow
+    from jepsen_tpu.models.memo import StateExplosion
+
+    try:
+        return reach.check_packed(model, packed,
+                                  **_engine_kw(kw, _REACH_KW))
+    except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion):
+        pass
+    if wgl_native.available():
+        try:
+            res = wgl_native.check_packed(model, packed,
+                                          **_engine_kw(kw, _NATIVE_KW))
+            if res.get("valid") in (True, False):
+                res["engine"] = "wgl-native-fallback"
+                return res
+        except StateExplosion:
+            pass                    # un-memoizable model: lazy Python path
+    try:
+        # the frontier engine's crashed-op quotient can survive
+        # crash-heavy histories that explode the exact C++ search
+        res = frontier.check_packed(model, packed,
+                                    **_engine_kw(kw, _FRONTIER_KW))
+        if res.get("valid") in (True, False):
+            res["engine"] = "frontier-fallback"
+            return res
+    except Exception:                                   # noqa: BLE001
+        pass                # overflow or device failure: Python path next
+    res = wgl_ref.check_packed(model, packed, **_engine_kw(kw, _WGL_KW))
+    res["engine"] = "wgl-cpu-fallback"
+    return res
 
 
 # keyword subsets understood by each engine; user opts are filtered so one
@@ -171,6 +211,7 @@ _REACH_KW = ("max_states", "max_slots", "max_dense")
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
 _FRONTIER_KW = ("max_states", "frontier0", "max_frontier", "time_limit",
                 "should_abort")
+_DECOMPOSE_KW = _REACH_KW + ("devices", "time_limit", "should_abort")
 _WGL_KW = ("time_limit", "max_configs", "strategy", "should_abort")
 _NATIVE_KW = ("time_limit", "max_configs", "max_states", "abort_flag")
 _LINEAR_KW = ("time_limit", "max_configs", "rep", "should_abort")

@@ -1,0 +1,119 @@
+"""P-compositional multi-register decomposition tests: differential
+agreement with the oracle (including crashed ops), witness keys on
+violations, soundness bailouts (multi-key transactions), per-key initial
+values, and facade auto routing."""
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures
+from jepsen_tpu import models as m
+from jepsen_tpu.checkers import decompose, facade, wgl_ref
+from jepsen_tpu.history import index
+from jepsen_tpu.op import info, invoke, ok
+
+
+class TestSplit:
+    def test_rejects_multi_key_transaction(self):
+        h = index([invoke(0, "write", {0: 1, 1: 2}),
+                   ok(0, "write", {0: 1, 1: 2})])
+        assert decompose.split(h) is None
+
+    def test_rejects_non_rw(self):
+        h = index([invoke(0, "cas", {0: (1, 2)}), ok(0, "cas", {0: (1, 2)})])
+        assert decompose.split(h) is None
+
+    def test_splits_pairs_and_dicts(self):
+        h = index([invoke(0, "write", {0: 1}), ok(0, "write", {0: 1}),
+                   invoke(0, "write", [[1, 2]]), ok(0, "write", [[1, 2]])])
+        groups = decompose.split(h)
+        assert set(groups) == {0, 1}
+        assert groups[0][0].op.value == 1
+        assert groups[1][0].op.value == 2
+
+
+class TestVerdicts:
+    def test_agrees_with_oracle(self):
+        for seed in range(6):
+            h = fixtures.gen_history("multi", n_ops=40, processes=4,
+                                     values=3, keys=3, crash_p=0.1,
+                                     seed=seed)
+            model = fixtures.model_for("multi")
+            ref = wgl_ref.check(model, h)
+            got = decompose.check(model, h)
+            assert got is not None
+            assert got["valid"] == ref["valid"], seed
+            assert got["engine"] == "decompose"
+
+    def test_invalid_names_key(self):
+        h = index([
+            invoke(0, "write", {0: 1}), ok(0, "write", {0: 1}),
+            invoke(0, "write", {1: 5}), ok(0, "write", {1: 5}),
+            invoke(0, "read", {1: None}), ok(0, "read", {1: 7}),  # stale
+        ])
+        got = decompose.check(m.multi_register(), h)
+        assert got["valid"] is False
+        assert got["key"] == 1
+        assert got["failures"] == [1]
+        assert got["op"]["f"] == "read"
+
+    def test_initial_values_respected(self):
+        model = m.multi_register({"a": 10, "b": 20})
+        good = index([invoke(0, "read", {"a": None}),
+                      ok(0, "read", {"a": 10}),
+                      invoke(0, "read", {"b": None}),
+                      ok(0, "read", {"b": 20})])
+        bad = index([invoke(0, "read", {"a": None}),
+                     ok(0, "read", {"a": 20})])
+        assert decompose.check(model, good)["valid"] is True
+        res = decompose.check(model, bad)
+        assert res["valid"] is False and res["key"] == "a"
+
+    def test_crashed_write_both_branches(self):
+        base = [invoke(0, "write", {0: 1}), ok(0, "write", {0: 1}),
+                invoke(1, "write", {0: 2}), info(1, "write", {0: 2}),
+                invoke(0, "read", {0: None})]
+        seen = decompose.check(m.multi_register(),
+                               index(base + [ok(0, "read", {0: 2})]))
+        unseen = decompose.check(m.multi_register(),
+                                 index(base + [ok(0, "read", {0: 1})]))
+        assert seen["valid"] is True
+        assert unseen["valid"] is True
+
+    def test_wide_key_space_beyond_monolithic_memo(self):
+        """8 keys x 4 values: the monolithic product state space (4^8)
+        explodes the memoized engines; the decomposition stays tiny."""
+        h = fixtures.gen_history("multi", n_ops=80, processes=4, values=4,
+                                 keys=8, seed=3)
+        got = decompose.check(m.multi_register(), h)
+        assert got["valid"] is True
+        assert got["key-count"] == 8
+
+
+class TestFacadeRouting:
+    def test_auto_uses_decompose_for_multi_register(self):
+        h = fixtures.gen_history("multi", n_ops=30, processes=3, keys=2,
+                                 seed=0)
+        res = facade.linearizable(m.multi_register()).check(None, h)
+        assert res["valid"] is True
+        assert res["engine"] == "decompose"
+
+    def test_transactions_fall_through_to_monolithic(self):
+        h = index([invoke(0, "write", {0: 1, 1: 2}),
+                   ok(0, "write", {0: 1, 1: 2}),
+                   invoke(0, "read", {0: None}), ok(0, "read", {0: 1})])
+        res = facade.linearizable(m.multi_register()).check(None, h)
+        assert res["valid"] is True
+        assert res["engine"] != "decompose"
+
+    def test_explicit_algorithm(self):
+        h = fixtures.gen_history("multi", n_ops=30, processes=3, keys=2,
+                                 seed=1)
+        res = facade.linearizable(m.multi_register(),
+                                  algorithm="decompose").check(None, h)
+        assert res["engine"] == "decompose"
+        txn = index([invoke(0, "write", {0: 1, 1: 2}),
+                     ok(0, "write", {0: 1, 1: 2})])
+        res2 = facade.linearizable(m.multi_register(),
+                                   algorithm="decompose").check(None, txn)
+        assert res2["valid"] == "unknown"
+        assert res2["cause"] == "not-decomposable"

@@ -12,6 +12,8 @@ possibly an injected violation, then runs every applicable engine:
   also run the fused kernel in interpret mode — slow but exact)
 - ``frontier``  — the sparse batched-frontier device engine (crashed-op
   quotient), skipped on capacity overflow
+- ``decompose`` — P-compositional per-key split (multi-register
+  workloads with single-key ops only)
 - ``brute``     — exhaustive permutation check on tiny histories
 
 Disagreement on a verdict (True/False; ``"unknown"`` is inconclusive and
@@ -88,6 +90,11 @@ def run_trial(params, seed: int, *, pallas: bool = False):
     except (frontier.FrontierOverflow, ConcurrencyOverflow,
             StateExplosion) as e:
         verdicts["frontier"] = f"skipped: {type(e).__name__}"
+    if params["kind"] == "multi":
+        from jepsen_tpu.checkers import decompose
+        d = decompose.check(model, h)
+        verdicts["decompose"] = (d["valid"] if d is not None
+                                 else "skipped: not-decomposable")
     if pallas:
         try:
             from jepsen_tpu.checkers import events as ev
