@@ -68,7 +68,10 @@ def split(history: Sequence[Op] = (), *,
 def check(model: models.Model, history: Sequence[Op], *,
           max_states: int = 100_000, max_slots: int = 20,
           max_dense: int = 1 << 22, devices: Optional[Sequence] = None,
-          time_limit: Optional[float] = None, should_abort=None
+          time_limit: Optional[float] = None, should_abort=None,
+          max_configs: Optional[int] = None,
+          frontier0: Optional[int] = None,
+          max_frontier: Optional[int] = None
           ) -> Optional[Dict[str, Any]]:
     """Check a multi-register history by per-key decomposition. Returns
     ``None`` when not applicable (wrong model, multi-key transactions);
@@ -76,9 +79,28 @@ def check(model: models.Model, history: Sequence[Op], *,
     valid iff every key's register subhistory is linearizable."""
     if not isinstance(model, models.MultiRegister):
         return None
+    return check_packed(model, h.pack(history), max_states=max_states,
+                        max_slots=max_slots, max_dense=max_dense,
+                        devices=devices, time_limit=time_limit,
+                        should_abort=should_abort, max_configs=max_configs,
+                        frontier0=frontier0, max_frontier=max_frontier)
+
+
+def check_packed(model: models.Model, packed: h.PackedHistory, *,
+                 max_states: int = 100_000, max_slots: int = 20,
+                 max_dense: int = 1 << 22,
+                 devices: Optional[Sequence] = None,
+                 time_limit: Optional[float] = None, should_abort=None,
+                 max_configs: Optional[int] = None,
+                 frontier0: Optional[int] = None,
+                 max_frontier: Optional[int] = None
+                 ) -> Optional[Dict[str, Any]]:
+    """Packed-level :func:`check` (splits ``packed.entries`` — callers
+    that already packed the history pay no second preprocessing pass)."""
+    if not isinstance(model, models.MultiRegister):
+        return None
     t0 = _time.monotonic()
-    entries = h.analysis_entries(history)
-    groups = split(entries=entries)
+    groups = split(entries=packed.entries)
     if groups is None:
         return None
     keys = sorted(groups, key=repr)
@@ -106,9 +128,9 @@ def check(model: models.Model, history: Sequence[Op], *,
     results: Dict[Any, Dict[str, Any]] = {}
     for iv, ks in buckets:
         reg = models.register(iv)
-        packed = [h.pack_entries(groups[k]) for k in ks]
+        packed_list = [h.pack_entries(groups[k]) for k in ks]
         try:
-            rs = reach.check_many(reg, packed, max_states=max_states,
+            rs = reach.check_many(reg, packed_list, max_states=max_states,
                                   max_slots=max_slots, max_dense=max_dense,
                                   devices=devices)
             results.update(zip(ks, rs))
@@ -117,7 +139,7 @@ def check(model: models.Model, history: Sequence[Op], *,
             # per-key auto chain (shared with the facade), each key
             # picking the engine that fits it, honoring the time budget
             from jepsen_tpu.checkers import facade
-            for k, p in zip(ks, packed):
+            for k, p in zip(ks, packed_list):
                 rem = remaining()
                 if (rem is not None and rem <= 0) or (
                         should_abort is not None and should_abort()):
@@ -125,10 +147,17 @@ def check(model: models.Model, history: Sequence[Op], *,
                     continue
                 kw = {"max_states": max_states, "max_slots": max_slots,
                       "max_dense": max_dense}
+                if devices is not None:
+                    kw["devices"] = devices
                 if rem is not None:
                     kw["time_limit"] = rem
                 if should_abort is not None:
                     kw["should_abort"] = should_abort
+                for name, v in (("max_configs", max_configs),
+                                ("frontier0", frontier0),
+                                ("max_frontier", max_frontier)):
+                    if v is not None:
+                        kw[name] = v
                 results[k] = facade.auto_check_packed(reg, p, kw)
     valids = [r.get("valid") for r in results.values()]
     if all(v is True for v in valids):

@@ -145,6 +145,7 @@ class Linearizable(Checker):
                                 **_engine_kw(kw, _LINEAR_KW))
         if algorithm == "auto":
             from jepsen_tpu import models as _models
+            packed = h.pack(history)
             if isinstance(model, _models.MultiRegister):
                 # P-compositionality (Herlihy & Wing locality): a history
                 # of single-key ops splits into per-key register
@@ -155,13 +156,13 @@ class Linearizable(Checker):
                 # chain on it could only burn the budget again.
                 from jepsen_tpu.checkers import decompose
                 try:
-                    res = decompose.check(model, history,
-                                          **_engine_kw(kw, _DECOMPOSE_KW))
+                    res = decompose.check_packed(
+                        model, packed, **_engine_kw(kw, _DECOMPOSE_KW))
                     if res is not None:
                         return res
                 except Exception:                       # noqa: BLE001
                     pass            # fall through to the monolithic chain
-            return auto_check_packed(model, h.pack(history), kw)
+            return auto_check_packed(model, packed, kw)
         if algorithm == "competition":
             return _competition(model, history, kw)
         raise ValueError(f"unknown algorithm {algorithm!r}")
@@ -210,8 +211,9 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
 _REACH_KW = ("max_states", "max_slots", "max_dense")
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
 _FRONTIER_KW = ("max_states", "frontier0", "max_frontier", "time_limit",
-                "should_abort")
-_DECOMPOSE_KW = _REACH_KW + ("devices", "time_limit", "should_abort")
+                "should_abort", "devices")
+_DECOMPOSE_KW = _REACH_KW + ("devices", "time_limit", "should_abort",
+                              "max_configs", "frontier0", "max_frontier")
 _WGL_KW = ("time_limit", "max_configs", "strategy", "should_abort")
 _NATIVE_KW = ("time_limit", "max_configs", "max_states", "abort_flag")
 _LINEAR_KW = ("time_limit", "max_configs", "rep", "should_abort")
