@@ -202,7 +202,7 @@ def _expand_block(C, pending, grouped, same, rank, T_flat, bitmat,
     return cand.reshape(F * pend_b.shape[0], K1)
 
 
-def _closure(C, count, pending, grouped, same, rank, T_flat, bitmat,
+def _closure(C, pending, grouped, same, rank, T_flat, bitmat,
              word_idx, shift, n_cols, canon: bool):
     """Fixpoint of fire-expansion ∪ dedup — covers every linearization
     order of any subset of pending ops (the union is monotone, so the
@@ -212,7 +212,10 @@ def _closure(C, count, pending, grouped, same, rank, T_flat, bitmat,
     buffers with TRUE capacity semantics: overflow is flagged only when
     the deduplicated config count itself exceeds ``F`` (a candidate
     buffer can never, since a round emits at most ``F·_BLOCK`` rows).
-    Chained fires missed inside a pass are caught by the outer fixpoint."""
+    Chained fires missed inside a pass are caught by the outer fixpoint.
+    Termination compares only DEDUPLICATED pass counts with each other —
+    the entering set's count may be stale (canonicalization can merge
+    rows without re-deduplicating), so it must not seed the comparison."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -236,7 +239,7 @@ def _closure(C, count, pending, grouped, same, rank, T_flat, bitmat,
         return C2, count2, count, overflow
 
     C, count, _, overflow = lax.while_loop(
-        cond, body, (C, count, jnp.int32(-1), False))
+        cond, body, (C, jnp.int32(-1), jnp.int32(-2), False))
     return C, count, overflow
 
 
@@ -293,7 +296,7 @@ def _walk(T_flat, n_cols, canon, ret_slot, slot_ops, crashed_slot, bitmat,
             else:
                 grouped = same = rank = None
             C1, count1, overflow = _closure(
-                C, count, ops_row, grouped, same, rank, T_flat, bitmat,
+                C, ops_row, grouped, same, rank, T_flat, bitmat,
                 word_idx, shift, n_cols, canon)
             C2, count2 = _project(C1, count1, j)
             status = jnp.where(
@@ -485,8 +488,8 @@ def _final_configs(memo: Memo, rs: ev.ReturnStream,
 def check(model: Model, history: Sequence[Op], *,
           max_states: int = 100_000, max_slots: int = MAX_SLOTS,
           frontier0: int = 1 << 10, max_frontier: int = 1 << 14,
-          time_limit: Optional[float] = None, should_abort=None
-          ) -> Dict[str, Any]:
+          time_limit: Optional[float] = None, should_abort=None,
+          devices: Optional[Sequence] = None) -> Dict[str, Any]:
     """Check one history with the sparse frontier engine. Raises
     :class:`FrontierOverflow`,
     :class:`~jepsen_tpu.checkers.events.ConcurrencyOverflow` (needs more
@@ -498,14 +501,14 @@ def check(model: Model, history: Sequence[Op], *,
     return check_packed(model, h.pack(history), max_states=max_states,
                         max_slots=max_slots, frontier0=frontier0,
                         max_frontier=max_frontier, time_limit=time_limit,
-                        should_abort=should_abort)
+                        should_abort=should_abort, devices=devices)
 
 
 def check_packed(model: Model, packed: h.PackedHistory, *,
                  max_states: int = 100_000, max_slots: int = MAX_SLOTS,
                  frontier0: int = 1 << 10, max_frontier: int = 1 << 14,
-                 time_limit: Optional[float] = None, should_abort=None
-                 ) -> Dict[str, Any]:
+                 time_limit: Optional[float] = None, should_abort=None,
+                 devices: Optional[Sequence] = None) -> Dict[str, Any]:
     t0 = _time.monotonic()
     if packed.n == 0 or packed.n_ok == 0:
         return {"valid": True, "engine": "frontier", "events": 0,
@@ -531,9 +534,16 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         _pad_rows(crashed_slot, R_pad),
         ((0, 0), (0, W_pad - crashed_slot.shape[1])))
     F = max(64, frontier0)
-    dead_ret, status, _, _, F = _run_walk(memo, rs, crashed_slot, F,
-                                          max_frontier,
-                                          should_abort=aborted)
+    if devices is not None and len(devices) > 1:
+        # SURVEY §7 phase 4: frontier + dedup sharded over the mesh —
+        # n× capacity, n parallel dedup sorts, all_to_all row routing
+        dead_ret, status, _, _, F = _run_walk_sharded(
+            memo, rs, crashed_slot, F, max_frontier, devices,
+            should_abort=aborted)
+    else:
+        dead_ret, status, _, _, F = _run_walk(memo, rs, crashed_slot, F,
+                                              max_frontier,
+                                              should_abort=aborted)
     if status == _STATUS_ABORT:
         cause = ("timeout" if deadline is not None
                  and _time.monotonic() > deadline else "aborted")
@@ -558,3 +568,286 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     except Exception:                                   # noqa: BLE001
         pass                            # evidence is best-effort garnish
     return out
+
+
+# -- mesh-sharded walk (SURVEY.md §7 phase 4: frontier + dedup over ICI) -----
+#
+# The frontier shards across a 1-D device mesh: each device owns the
+# config rows whose hash lands on it (owner = row-hash mod n), giving n×
+# the capacity and n parallel dedup sorts. Exactness is preserved by
+# construction: a config row deterministically belongs to exactly one
+# shard, so after hash-routing (lax.all_to_all over ICI) a LOCAL
+# sort-unique is a GLOBAL dedup — no cross-shard duplicate can exist.
+# Fire candidates route to their owners each closure round; projection
+# and canonicalization change row bits (and therefore owners), so rows
+# re-route after each. Termination, death, and overflow are psum-reduced
+# so every shard takes identical control-flow decisions (SPMD).
+
+_HASH_A = 0x9E3779B1           # golden-ratio odd constants (uint32 wrap)
+_HASH_B = 0x85EBCA77
+
+
+def _hash_rows_np(rows: np.ndarray, n: int) -> np.ndarray:
+    """Owner shard of each row (host mirror of :func:`_hash_rows`)."""
+    a = np.uint32(_HASH_A)
+    h = np.zeros(len(rows), np.uint32)
+    for c in range(rows.shape[1]):
+        h = (h ^ rows[:, c].astype(np.uint32)) * a
+        h = (h >> np.uint32(16)) ^ (h * np.uint32(_HASH_B))
+    return (h % np.uint32(n)).astype(np.int32)
+
+
+def _hash_rows(rows, n: int):
+    """Owner shard of each row (device; must match the host mirror)."""
+    import jax.numpy as jnp
+
+    a = jnp.uint32(_HASH_A)
+    h = jnp.zeros(rows.shape[0], jnp.uint32)
+    for c in range(rows.shape[1]):
+        h = (h ^ rows[:, c]) * a
+        h = (h >> jnp.uint32(16)) ^ (h * jnp.uint32(_HASH_B))
+    return (h % jnp.uint32(n)).astype(jnp.int32)
+
+
+def _bucket_by_owner(rows, n_dev: int, cap: int):
+    """Scatter rows into ``n_dev`` destination buckets of ``cap`` rows
+    (invalid-filled). Returns ``(send: u32[n_dev, cap, K1], dropped)``
+    where ``dropped`` is true when some bucket overflowed ``cap``."""
+    import jax.numpy as jnp
+
+    N, K1 = rows.shape
+    valid = rows[:, K1 - 1] != jnp.uint32(0xFFFFFFFF)
+    owner = jnp.where(valid, _hash_rows(rows, n_dev), n_dev)
+    bufs = []
+    dropped = False
+    for d in range(n_dev):
+        mask = owner == d
+        pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+        pos = jnp.where(mask & (pos < cap), pos, cap)
+        buf = jnp.full((cap, K1), jnp.uint32(0xFFFFFFFF))
+        bufs.append(buf.at[pos].set(rows, mode="drop"))
+        dropped = dropped | (jnp.sum(mask.astype(jnp.int32)) > cap)
+    return jnp.stack(bufs), dropped
+
+
+def _route_rows(rows, n_dev: int, cap: int, axis: str):
+    """Exchange rows so each lands on its owner shard: bucket by owner,
+    ``all_to_all`` over the mesh, flatten. Returns
+    ``(recv: u32[n_dev*cap, K1], dropped)``."""
+    from jax import lax
+
+    send, dropped = _bucket_by_owner(rows, n_dev, cap)
+    recv = lax.all_to_all(send, axis, 0, 0, tiled=False)
+    return recv.reshape(n_dev * cap, rows.shape[1]), dropped
+
+
+def _closure_sharded(C, pending, grouped, same, rank, T_flat,
+                     bitmat, word_idx, shift, n_cols, canon: bool,
+                     n_dev: int, axis: str):
+    """Sharded fixpoint: expand locally in ``_BLOCK``-slot rounds, route
+    every round's candidates to their owner shards, fold into the local
+    set with a sort-unique (globally deduplicating, by the ownership
+    invariant). The fixpoint test and overflow flag are psum-global, and
+    — as in :func:`_closure` — compare only deduplicated pass counts."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    F_l = C.shape[0]
+    W = pending.shape[0]
+    # per-destination routing depth: a round emits up to F_l·_BLOCK
+    # candidate rows (duplicates included, counted on the send side), so
+    # small meshes need deeper buckets than uniform hashing alone
+    # suggests; skew beyond the cap just flags overflow (sound: the host
+    # escalates)
+    cap = max(F_l, F_l * _BLOCK // n_dev)
+
+    def cond(c):
+        _, gcount, prev, overflow = c
+        return (gcount != prev) & ~overflow
+
+    def body(c):
+        C, gcount, _, _ = c
+        C2, lcount2, overflow = C, jnp.int32(0), False
+        for lo in range(0, W, _BLOCK):
+            cand = _expand_block(C, pending, grouped, same, rank, T_flat,
+                                 bitmat, word_idx, shift, n_cols, lo,
+                                 canon)
+            recv, dropped = _route_rows(cand, n_dev, cap, axis)
+            U = jnp.concatenate([C2, recv], axis=0)
+            C2, lcount2 = _sort_unique_compact(U, F_l)
+            overflow = overflow | (lcount2 > F_l) | dropped
+        gcount2 = lax.psum(lcount2, axis)
+        goverflow = lax.psum(overflow.astype(jnp.int32), axis) > 0
+        return C2, gcount2, gcount, goverflow
+
+    C, gcount, _, overflow = lax.while_loop(
+        cond, body, (C, jnp.int32(-1), jnp.int32(-2), False))
+    return C, gcount, overflow
+
+
+def _reroute_full(C, n_dev: int, axis: str):
+    """Re-establish the ownership invariant after rows changed bits
+    (canonicalize / projection): route all local rows, then local
+    sort-unique (which also merges configs that canonicalization made
+    equal). Send buckets are F_l-deep, so sends never drop."""
+    import jax.numpy as jnp
+
+    F_l = C.shape[0]
+    recv, _ = _route_rows(C, n_dev, F_l, axis)
+    return _sort_unique_compact(recv, F_l)
+
+
+def _walk_sharded(n_cols, canon, n_dev, axis, T_flat, ret_slot, slot_ops,
+                  crashed_slot, bitmat, word_idx, shift, C0, count0):
+    """Per-shard body of the sharded segment walk (run under
+    ``shard_map``); mirrors :func:`_walk` with psum-global liveness."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    Rn = ret_slot.shape[0]
+    F_l = C0.shape[0]
+
+    def cond(c):
+        r, _, _, status = c
+        return (r < Rn) & (status == _STATUS_RUNNING)
+
+    def body(c):
+        r, C, gcount, _ = c
+        j = ret_slot[r]
+
+        def do(C, gcount):
+            ops_row = slot_ops[r]
+            overflow0 = False
+            if canon:
+                grouped, same, rank = _slot_groups(ops_row, crashed_slot[r])
+                C = _canonicalize(C, grouped, same, rank, word_idx, shift,
+                                  bitmat)
+                C, lcount = _reroute_full(C, n_dev, axis)
+                overflow0 = lcount > F_l
+            else:
+                grouped = same = rank = None
+            C1, gcount1, overflow1 = _closure_sharded(
+                C, ops_row, grouped, same, rank, T_flat, bitmat,
+                word_idx, shift, n_cols, canon, n_dev, axis)
+            C2, lcount2 = _project(C1, gcount1, j)
+            C2, lcount2b = _reroute_full(C2, n_dev, axis)
+            gcount2 = lax.psum(lcount2b, axis)
+            goverflow = lax.psum(
+                (overflow0 | overflow1 | (lcount2b > F_l))
+                .astype(jnp.int32), axis) > 0
+            status = jnp.where(
+                goverflow, _STATUS_OVERFLOW,
+                jnp.where(gcount2 == 0, _STATUS_DEAD, _STATUS_RUNNING))
+            return C2, gcount2, status
+
+        def pad(C, gcount):
+            return C, gcount, jnp.int32(_STATUS_RUNNING)
+
+        C, gcount, status = lax.cond(j >= 0, do, pad, C, gcount)
+        r = jnp.where(status == _STATUS_RUNNING, r + 1, r)
+        return r, C, gcount, status
+
+    return lax.while_loop(
+        cond, body, (jnp.int32(0), C0, count0,
+                     jnp.int32(_STATUS_RUNNING)))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_walk_sharded(mesh_devs: tuple, axis: str):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from jepsen_tpu import parallel as par
+
+    m = par.mesh(axis, list(mesh_devs))
+    n_dev = len(mesh_devs)
+
+    def run(T_flat, n_cols, canon, ret_slot, slot_ops, crashed_slot,
+            bitmat, word_idx, shift, C0, count0):
+        body = functools.partial(_walk_sharded, n_cols, canon, n_dev, axis)
+        sm = jax.shard_map(
+            body, mesh=m,
+            in_specs=(P(), P(), P(), P(), P(), P(), P(), P(axis), P()),
+            out_specs=(P(), P(axis), P(), P()))
+        return sm(T_flat, ret_slot, slot_ops, crashed_slot, bitmat,
+                  word_idx, shift, C0, count0)
+
+    return jax.jit(run, static_argnums=(1, 2))
+
+
+def _initial_frontier_sharded(F_l: int, K: int, initial_state: int,
+                              n_dev: int) -> np.ndarray:
+    """Global ``u32[n_dev*F_l, K+1]`` with the initial config placed on
+    its owner shard (host hash must match the device hash)."""
+    C0 = np.full((n_dev * F_l, K + 1), 0xFFFFFFFF, np.uint32)
+    row = np.zeros((1, K + 1), np.uint32)
+    row[0, K] = initial_state
+    owner = int(_hash_rows_np(row, n_dev)[0])
+    C0[owner * F_l] = row[0]
+    return C0
+
+
+def _run_walk_sharded(memo: Memo, rs: ev.ReturnStream,
+                      crashed_slot: np.ndarray, F: int, max_frontier: int,
+                      devices: Sequence, should_abort=None):
+    """Sharded analogue of :func:`_run_walk`: ``F`` is the TOTAL frontier
+    capacity, split evenly over ``devices``. Escalation re-embeds the
+    carried global frontier (host re-hash) into 4× buffers."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from jepsen_tpu import parallel as par
+
+    n_dev = len(devices)
+    axis = "shards"
+    W = rs.W
+    K, word_idx, shift, bitmat = _slot_geometry(W)
+    S, O = memo.table.shape
+    F_l = max(64, -(-F // n_dev))
+    walk = _jitted_walk_sharded(tuple(devices), axis)
+    m = par.mesh(axis, list(devices))
+    sharded = NamedSharding(m, P(axis))
+    T_flat = jnp.asarray(memo.table.reshape(-1))
+    bitmat_d, word_idx_d, shift_d = (jnp.asarray(bitmat),
+                                     jnp.asarray(word_idx),
+                                     jnp.asarray(shift))
+    canon = bool(crashed_slot.any())
+    C = jax.device_put(
+        _initial_frontier_sharded(F_l, K, memo.initial, n_dev), sharded)
+    count = jnp.int32(1)
+    base = 0
+    while base < rs.R:
+        if should_abort is not None and should_abort():
+            return -1, _STATUS_ABORT, C, count, n_dev * F_l
+        sl = slice(base, base + _SEG)
+        r, C2, count2, status = walk(
+            T_flat, O, canon, jnp.asarray(rs.ret_slot[sl]),
+            jnp.asarray(rs.slot_ops[sl]), jnp.asarray(crashed_slot[sl]),
+            bitmat_d, word_idx_d, shift_d, C, count)
+        status = int(status)
+        if status == _STATUS_OVERFLOW:
+            # re-embed: collect live rows, re-hash onto bigger shards
+            # (keep growing until the most-loaded shard fits too)
+            rows = np.asarray(C)
+            rows = rows[rows[:, K] != np.uint32(0xFFFFFFFF)]
+            owners = _hash_rows_np(rows, n_dev)
+            load = np.bincount(owners, minlength=n_dev).max() if len(rows) \
+                else 0
+            F_l *= 4
+            while F_l < load:
+                F_l *= 4
+            if n_dev * F_l > max(max_frontier, n_dev * 64):
+                raise FrontierOverflow(
+                    f"reachable config set exceeds {max_frontier} rows")
+            C_np = np.full((n_dev * F_l, K + 1), 0xFFFFFFFF, np.uint32)
+            for d in range(n_dev):
+                mine = rows[owners == d]
+                C_np[d * F_l:d * F_l + len(mine)] = mine
+            C = jax.device_put(C_np, sharded)
+            continue
+        if status != _STATUS_RUNNING:
+            return base + int(r), status, C2, count2, n_dev * F_l
+        C, count = C2, count2
+        base += _SEG
+    return rs.R, _STATUS_RUNNING, C, count, n_dev * F_l
