@@ -12,12 +12,23 @@
 //   probabilistic false-valid verdicts;
 // - model semantics enter only through the dense transition table
 //   precomputed by jepsen_tpu.models.memo (upstream model.memo): the
-//   search never steps a model object.
+//   search never steps a model object;
+// - crashed-op quotient (absent upstream — the "info ops are expensive"
+//   2^k blowup): whenever the search fires a crashed (never-returning)
+//   op, it fires the LOWEST unfired crashed entry with the same op id
+//   instead. The lower twin is legal whenever the higher one is (its
+//   invoke is earlier, so the Wing-Gong bound inv[j] < m is weaker) and
+//   steps to the same state, and an exchange argument shows restricting
+//   to lowest-first firings preserves completeness. Reachable masks are
+//   therefore canonical by construction, so the memo collapses the
+//   whole 2^k interchangeable class to its k+1 canonical members with
+//   no key rewriting.
 //
 // Exposed as a C ABI for ctypes (no pybind11 in the image).
 
 #include <chrono>
 #include <cstdint>
+#include <unordered_map>
 #include <unordered_set>
 #include <vector>
 
@@ -49,6 +60,10 @@ struct Wgl {
     std::vector<i32> nxt, prv;                     // dll; index n = head
     std::vector<u64> key_buf;
     std::unordered_set<std::vector<u64>, KeyHash> seen;
+    // crashed-op quotient: entries sharing (crashed, op id), in entry
+    // (= invocation) order; group_of[i] indexes groups, -1 = ungrouped
+    std::vector<std::vector<i32>> groups;
+    std::vector<i32> group_of;
     i64 explored = 0;
     i32 remaining_ok = 0;
     i32 total_ok = 0;
@@ -69,6 +84,20 @@ struct Wgl {
         mask[i >> 6] &= ~(u64(1) << (i & 63));
         nxt[prv[i]] = i;
         prv[nxt[i]] = i;
+    }
+
+    bool fired(i32 i) const {
+        return (mask[i >> 6] >> (i & 63)) & 1;
+    }
+
+    // canonical member of a crashed pick's interchangeability class:
+    // the lowest unfired twin (see header comment)
+    i32 canonical_pick(i32 pick) const {
+        i32 g = group_of[pick];
+        if (g < 0) return pick;
+        for (i32 m : groups[g])
+            if (!fired(m)) return m;
+        return pick;                               // unreachable: pick unfired
     }
 
     // Normalized memo key: every entry below p (the lowest unlinearized
@@ -123,6 +152,24 @@ i64 wgl_check(const i32* table, i32 S, i32 O,
     w.nxt[n] = 0;                                  // head sentinel
     w.prv[0] = n;
     w.remaining_ok = w.total_ok;
+    w.group_of.assign(n, -1);
+    {
+        std::unordered_map<i32, i32> gid;          // op id -> group index
+        for (i32 i = 0; i < n; ++i) {
+            if (!crashed[i]) continue;
+            auto it = gid.find(op_id[i]);
+            if (it == gid.end()) {
+                it = gid.emplace(op_id[i],
+                                 static_cast<i32>(w.groups.size())).first;
+                w.groups.emplace_back();
+            }
+            w.groups[it->second].push_back(i);     // ascending entry order
+            w.group_of[i] = it->second;
+        }
+        for (i32 i = 0; i < n; ++i)                // singletons: no redirect
+            if (w.group_of[i] >= 0 && w.groups[w.group_of[i]].size() < 2)
+                w.group_of[i] = -1;
+    }
     out[0] = 1;
     out[1] = -1;
     out[2] = 0;
@@ -201,6 +248,7 @@ i64 wgl_check(const i32* table, i32 S, i32 O,
             continue;
         }
         ++w.explored;
+        if (w.ret[pick] == INF) pick = w.canonical_pick(pick);
         w.lift(pick);
         bool is_ok = (w.ret[pick] != INF);
         if (is_ok && --w.remaining_ok == 0) {
