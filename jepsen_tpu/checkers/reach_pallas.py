@@ -51,18 +51,37 @@ def _gather_G(slot_ops_ref, P_ref, k: int, W: int, O1: int):
     return jnp.concatenate(Gs, axis=1)            # [S, W*S]
 
 
+def _one_fire_pass(R, G_all, W: int, M: int, S: int):
+    """One Jacobi fire pass: ONE fused [M,S]@[S,W·S] matmul computes every
+    config's image under every slot's op; the per-slot loop then only
+    reshuffles halves (VPU). No scatter in Mosaic: rebuild via stacked
+    halves. Semantics match ``reach._ret_step``'s einsum."""
+    import jax.numpy as jnp
+
+    F = jnp.dot(R, G_all, preferred_element_type=jnp.float32)
+    for jj in range(W):
+        Fj = F[:, jj * S:(jj + 1) * S]
+        half, blk = M >> (jj + 1), 1 << jj
+        Rr = R.reshape(half, 2, blk, S)
+        Fr = Fj.reshape(half, 2, blk, S)
+        hi = jnp.maximum(
+            Rr[:, 1], (Fr[:, 0] > 0.5).astype(jnp.float32))
+        R = jnp.stack([Rr[:, 0], hi], axis=1).reshape(M, S)
+    return R
+
+
 def _fire_and_project(R, G_all, j, W: int, M: int, S: int):
     """One return event on the dense config set ``R`` [M, S] f32:
 
-    - W fire passes (Jacobi): ONE fused [M,S]@[S,W·S] matmul per pass
-      computes every config's image under every slot's op; the per-slot
-      loop then only reshuffles halves (VPU). Passes run until the config
-      count stops growing (fire is monotone, so popcount stability ==
-      fixpoint), capped at W (a fire chain sets ≥1 new bit per pass). The
-      projected set from the previous return is already closed under its
-      still-pending ops, so typically only the 1-2 ops invoked since then
-      fire and this exits after ~2 passes instead of the static worst
-      case W. Semantics match ``reach._ret_step``'s einsum.
+    - fire passes run to the between-returns fixpoint (fire is monotone,
+      so popcount stability == fixpoint), capped at W total (a fire chain
+      sets ≥1 new bit per pass). The projected set from the previous
+      return is already closed under its still-pending ops, so 2 passes
+      almost always suffice — and Mosaic's ``while_loop`` carry costs
+      more than a tiny matmul here (measured ~1.5× on the headline
+      config), so the first two passes are UNROLLED unconditionally and
+      the loop runs only in the rare case the second pass still grew the
+      set;
     - projection on the (dynamic) returning slot ``j``: scalar-predicate
       vector selects don't legalize in Mosaic, so blend all W static
       projections with scalar 0/1 indicator multiplies — exactly one is
@@ -71,27 +90,24 @@ def _fire_and_project(R, G_all, j, W: int, M: int, S: int):
     import jax
     import jax.numpy as jnp
 
-    def fire_cond(c):
-        Rv, prev, it = c
-        return jnp.logical_and(it < W, jnp.sum(Rv) > prev)
+    if W <= 2:
+        for _ in range(W):                  # W passes ARE the fixpoint
+            R = _one_fire_pass(R, G_all, W, M, S)
+    else:
+        R = _one_fire_pass(R, G_all, W, M, S)
+        s1 = jnp.sum(R)
+        R = _one_fire_pass(R, G_all, W, M, S)
 
-    def fire_body(c):
-        Rv, prev, it = c
-        s = jnp.sum(Rv)
-        F = jnp.dot(Rv, G_all, preferred_element_type=jnp.float32)
-        for jj in range(W):
-            Fj = F[:, jj * S:(jj + 1) * S]
-            half, blk = M >> (jj + 1), 1 << jj
-            Rr = Rv.reshape(half, 2, blk, S)
-            Fr = Fj.reshape(half, 2, blk, S)
-            hi = jnp.maximum(
-                Rr[:, 1], (Fr[:, 0] > 0.5).astype(jnp.float32))
-            # no scatter in Mosaic: rebuild via stacked halves
-            Rv = jnp.stack([Rr[:, 0], hi], axis=1).reshape(M, S)
-        return Rv, s, it + 1
+        def fire_cond(c):
+            Rv, prev, it = c
+            return jnp.logical_and(it < W, jnp.sum(Rv) > prev)
 
-    R, _, _ = jax.lax.while_loop(
-        fire_cond, fire_body, (R, jnp.float32(-1.0), 0))
+        def fire_body(c):
+            Rv, prev, it = c
+            s = jnp.sum(Rv)
+            return _one_fire_pass(Rv, G_all, W, M, S), s, it + 1
+
+        R, _, _ = jax.lax.while_loop(fire_cond, fire_body, (R, s1, 2))
 
     acc = R * (j < 0).astype(jnp.float32)
     for jj in range(W):
