@@ -53,7 +53,7 @@ def _cmd_run(args) -> int:
     from jepsen_tpu import core
     from jepsen_tpu.fake import FakeBroker
     from jepsen_tpu.suites import (counter as counter_suite, etcd, mutex,
-                                   queue, register, set_suite)
+                                   queue, redis, register, set_suite)
 
     logging.basicConfig(
         level=logging.INFO,
@@ -87,6 +87,11 @@ def _cmd_run(args) -> int:
             concurrency=args.concurrency, seed=args.seed,
             with_nemesis=not args.no_nemesis, store=True, nodes=nodes or 5),
         "etcd": lambda: etcd.etcd_test(
+            mode=args.mode, time_limit=args.time_limit,
+            concurrency=args.concurrency, seed=args.seed,
+            with_nemesis=not args.no_nemesis, store=True,
+            algorithm=args.algorithm, nodes=nodes or 5),
+        "redis": lambda: redis.redis_test(
             mode=args.mode, time_limit=args.time_limit,
             concurrency=args.concurrency, seed=args.seed,
             with_nemesis=not args.no_nemesis, store=True,

@@ -1,0 +1,108 @@
+"""Redis-over-RESP suite: real sockets, RESP2 framing, EVAL-script CAS,
+full harness runs (suites/redis.py + fake/resp.py)."""
+import socket
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.fake import FakeCluster
+from jepsen_tpu.fake.resp import CAS_SCRIPT, RespKVFrontend
+from jepsen_tpu.op import invoke
+from jepsen_tpu.suites import redis
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+@pytest.fixture
+def frontend():
+    cluster = FakeCluster(NODES, mode="linearizable")
+    fe = RespKVFrontend(cluster, timeout_hold_s=0.3).start()
+    yield cluster, fe
+    fe.stop()
+
+
+def client_for(fe, node, timeout_s=0.5):
+    c = redis.RespClient("k", timeout_s=timeout_s)
+    return c.open({"endpoints": fe.endpoints}, node)
+
+
+def test_resp_dialect(frontend):
+    cluster, fe = frontend
+    c = client_for(fe, "n1")
+    assert c._command("PING") == "PONG"
+    assert c._command("GET", "k") is None               # nil bulk
+    assert c._command("SET", "k", "5") == "OK"
+    # replication: read through a DIFFERENT node
+    c3 = client_for(fe, "n3")
+    assert c3._command("GET", "k") == "5"
+    # EVAL compare-and-set: success then compare failure
+    assert c._command("EVAL", CAS_SCRIPT, "1", "k", "5", "6") == 1
+    assert c._command("EVAL", CAS_SCRIPT, "1", "k", "5", "7") == 0
+    assert c._command("GET", "k") == "6"
+    # CAS on a missing key compares unequal (script's nil)
+    assert c._command("EVAL", CAS_SCRIPT, "1", "nope", "0", "1") == 0
+    # unknown commands answer -ERR
+    with pytest.raises(redis.RespError):
+        c._command("FLUSHALL")
+
+
+def test_partitioned_node_clusterdown(frontend):
+    cluster, fe = frontend
+    c1 = client_for(fe, "n1")
+    assert c1._command("SET", "k", "1") == "OK"
+    for other in NODES[1:]:
+        cluster.drop_link("n5", other)
+        cluster.drop_link(other, "n5")
+    c5 = client_for(fe, "n5")
+    with pytest.raises(redis.RespError) as e:
+        c5._command("GET", "k")
+    assert e.value.message.startswith("CLUSTERDOWN")
+    cluster.heal()
+    assert c5._command("GET", "k") == "1"
+
+
+def test_client_completion_mapping(frontend):
+    cluster, fe = frontend
+    test = {"endpoints": fe.endpoints}
+    c1 = client_for(fe, "n1", timeout_s=0.2)
+    # read of unset key -> ok None
+    r = c1.invoke(test, invoke(0, "read"))
+    assert r.type == "ok" and r.value is None
+    # write -> ok; read back -> int-parsed
+    assert c1.invoke(test, invoke(0, "write", 3)).type == "ok"
+    r = c1.invoke(test, invoke(0, "read"))
+    assert r.type == "ok" and r.value == 3
+    # cas mismatch -> clean fail; cas hit -> ok
+    assert c1.invoke(test, invoke(0, "cas", [9, 1])).type == "fail"
+    assert c1.invoke(test, invoke(0, "cas", [3, 4])).type == "ok"
+    # partitioned -> CLUSTERDOWN -> fail (no effect)
+    for other in NODES[1:]:
+        cluster.drop_link("n1", other)
+        cluster.drop_link(other, "n1")
+    assert c1.invoke(test, invoke(0, "write", 5)).type == "fail"
+    cluster.heal()
+    # paused node -> held socket -> timeout -> indeterminate info
+    cluster.pause_node("n1")
+    assert c1.invoke(test, invoke(0, "write", 6)).type == "info"
+    cluster.resume_node("n1")
+    # the poisoned connection was dropped: next op re-dials and works
+    assert c1.invoke(test, invoke(0, "write", 7)).type == "ok"
+
+
+def test_redis_run_linearizable():
+    t = redis.redis_test(mode="linearizable", time_limit=1.5, seed=4,
+                         with_nemesis=True, nemesis_interval=0.3,
+                         concurrency=5)
+    done = core.run(t)
+    assert done["results"]["results"]["linear"]["valid"] is True
+    assert len(done["history"]) > 50
+    # the nemesis really partitioned: some ops failed or timed out
+    assert any(op.type in ("fail", "info") for op in done["history"])
+
+
+def test_redis_run_sloppy_finds_violation():
+    t = redis.redis_test(mode="sloppy", time_limit=2.0, seed=11,
+                         with_nemesis=True, nemesis_interval=0.25,
+                         concurrency=5)
+    done = core.run(t)
+    assert done["results"]["results"]["linear"]["valid"] is False

@@ -21,14 +21,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build(suite: str, mode: str, seed: int, time_limit: float):
-    from jepsen_tpu.suites import (counter, etcd, mutex, queue, register,
-                                   set_suite)
+    from jepsen_tpu.suites import (counter, etcd, mutex, queue, redis,
+                                   register, set_suite)
     kw = dict(time_limit=time_limit, seed=seed, store=False,
               with_nemesis=True, nemesis_interval=0.3)
     if suite == "register":
         return register.register_test(mode, concurrency=5, **kw)
     if suite == "etcd":
         return etcd.etcd_test(mode, concurrency=5, **kw)
+    if suite == "redis":
+        return redis.redis_test(mode, concurrency=5, **kw)
     if suite == "mutex":
         return mutex.mutex_test(mode, concurrency=4, **kw)
     if suite == "queue":
@@ -50,6 +52,8 @@ CONFIGS = [
     ("register", "sloppy", False),
     ("etcd", "linearizable", True),
     ("etcd", "sloppy", False),
+    ("redis", "linearizable", True),
+    ("redis", "sloppy", False),
     ("mutex", "linearizable", True),
     ("queue", "safe", True),
     ("queue", "lossy", False),
