@@ -172,36 +172,62 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
     """The ``auto`` fallback chain at the packed level: dense device
     engine → C++ WGL → sparse frontier → Python oracle, first conclusive
     verdict wins. Shared by :class:`Linearizable` and the per-key
-    fallback in :mod:`jepsen_tpu.checkers.decompose`."""
+    fallback in :mod:`jepsen_tpu.checkers.decompose`.
+
+    A ``time_limit`` in ``kw`` budgets the chain as a whole: the deadline
+    is computed once here and each wall-clock-limited fallback stage
+    (C++ WGL, frontier, Python oracle) receives only the time remaining,
+    so a history that times out in every stage costs ~1× the configured
+    limit, not 1× per stage. (The dense first stage is bounded by
+    structure — ``max_dense``/``max_states`` — not wall-clock, and runs
+    before the budget is consulted.)"""
+    import time as _time
+
     from jepsen_tpu.checkers import frontier, reach, wgl_native, wgl_ref
     from jepsen_tpu.checkers.events import ConcurrencyOverflow
     from jepsen_tpu.models.memo import StateExplosion
+
+    tl = kw.get("time_limit")
+    deadline = _time.monotonic() + tl if tl else None
+
+    def _spent() -> bool:
+        return deadline is not None and _time.monotonic() >= deadline
+
+    def _budgeted(ekw: Dict[str, Any]) -> Dict[str, Any]:
+        if deadline is not None:
+            ekw["time_limit"] = max(1e-3, deadline - _time.monotonic())
+        return ekw
 
     try:
         return reach.check_packed(model, packed,
                                   **_engine_kw(kw, _REACH_KW))
     except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion):
         pass
-    if wgl_native.available():
+    if wgl_native.available() and not _spent():
         try:
-            res = wgl_native.check_packed(model, packed,
-                                          **_engine_kw(kw, _NATIVE_KW))
+            res = wgl_native.check_packed(
+                model, packed, **_budgeted(_engine_kw(kw, _NATIVE_KW)))
             if res.get("valid") in (True, False):
                 res["engine"] = "wgl-native-fallback"
                 return res
         except StateExplosion:
             pass                    # un-memoizable model: lazy Python path
-    try:
-        # the frontier engine's crashed-op quotient can survive
-        # crash-heavy histories that explode the exact C++ search
-        res = frontier.check_packed(model, packed,
-                                    **_engine_kw(kw, _FRONTIER_KW))
-        if res.get("valid") in (True, False):
-            res["engine"] = "frontier-fallback"
-            return res
-    except Exception:                                   # noqa: BLE001
-        pass                # overflow or device failure: Python path next
-    res = wgl_ref.check_packed(model, packed, **_engine_kw(kw, _WGL_KW))
+    if not _spent():
+        try:
+            # the frontier engine's crashed-op quotient can survive
+            # crash-heavy histories that explode the exact C++ search
+            res = frontier.check_packed(
+                model, packed, **_budgeted(_engine_kw(kw, _FRONTIER_KW)))
+            if res.get("valid") in (True, False):
+                res["engine"] = "frontier-fallback"
+                return res
+        except Exception:                               # noqa: BLE001
+            pass            # overflow or device failure: Python path next
+    if _spent():
+        return {"valid": "unknown", "cause": "timeout",
+                "engine": "auto-chain"}
+    res = wgl_ref.check_packed(model, packed,
+                               **_budgeted(_engine_kw(kw, _WGL_KW)))
     res["engine"] = "wgl-cpu-fallback"
     return res
 

@@ -837,7 +837,10 @@ def _run_walk_sharded(memo: Memo, rs: ev.ReturnStream,
             F_l *= 4
             while F_l < load:
                 F_l *= 4
-            if n_dev * F_l > max(max_frontier, n_dev * 64):
+            # the caller's total cap bounds escalation directly; only the
+            # INITIAL allocation may exceed a tiny cap, via the
+            # unavoidable n_dev*64 per-shard minimum buffer
+            if n_dev * F_l > max_frontier:
                 raise FrontierOverflow(
                     f"reachable config set exceeds {max_frontier} rows")
             C_np = np.full((n_dev * F_l, K + 1), 0xFFFFFFFF, np.uint32)

@@ -18,7 +18,10 @@ Completion mapping:
 - nil bulk on read         → :ok read of nil (key unset)
 - ``:0`` from the script   → :fail (CAS compare failed — no effect)
 - ``-CLUSTERDOWN`` / conn refused → :fail (definitely no effect)
-- socket timeout / conn reset mid-command → :info (indeterminate)
+- parse-time rejections (``-ERR unknown command`` / arity /
+  ``-WRONGTYPE`` …) → :fail (rejected before execution, no effect)
+- socket timeout / conn reset mid-command / other ``-ERR`` replies
+  (possible effect before the error) → :info (indeterminate)
 """
 from __future__ import annotations
 
@@ -41,6 +44,15 @@ class RespError(Exception):
     def __init__(self, message: str):
         super().__init__(message)
         self.message = message
+
+
+# error-reply prefixes a server emits while rejecting a command BEFORE
+# executing it — definitely no effect, so the op completes :fail
+_DEFINITE_REJECTIONS = (
+    "ERR unknown command",
+    "ERR wrong number of arguments",
+    "WRONGTYPE",
+)
 
 
 class RespClient(cl.Client):
@@ -127,6 +139,14 @@ class RespClient(cl.Client):
         except RespError as e:
             if e.message.startswith("CLUSTERDOWN"):
                 return cl.fail(op, "node unavailable")
+            # a complete error reply the server produced while PARSING the
+            # command (unknown command, arity, type) is a definite
+            # no-effect rejection — a clean :fail that keeps checker
+            # concurrency down. Anything else (script errors mid-write,
+            # "-ERR timeout", LOADING, …) may have applied an effect
+            # before failing, so it stays indeterminate :info.
+            if e.message.startswith(_DEFINITE_REJECTIONS):
+                return cl.fail(op, e.message)
             return cl.info(op, e.message)
         except ConnectionRefusedError:
             self._drop()
