@@ -620,17 +620,29 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         P_np = _build_P(memo, S_pad)
         if (_use_pallas() and _pallas_fits(S_pad, M, memo.n_ops)
                 and rs.n_returns >= _PALLAS_MIN_RETURNS):
-            from jepsen_tpu.checkers import reach_pallas
             R0_np = np.zeros((S_pad, M), bool)
             R0_np[0, 0] = True
+            dead = None
             try:
-                dead, _ = reach_pallas.walk_returns(
+                # second-generation kernel: exact fixed-W-pass walk,
+                # ~1.1 us/return at the headline config (for W > 5, a
+                # sound 5-pass walk with an exact rescue on death)
+                from jepsen_tpu.checkers import reach_lane
+                dead, _ = reach_lane.walk_returns(
                     P_np, rs.ret_slot, rs.slot_ops, R0_np, fetch_R=False)
             except Exception as e:                      # noqa: BLE001
-                # Mosaic lowering / VMEM allocation failure — the XLA
-                # walk below handles every history the fast path admits
                 _warn_pallas_failed(repr(e))
-                dead = None
+                try:
+                    from jepsen_tpu.checkers import reach_pallas
+                    dead, _ = reach_pallas.walk_returns(
+                        P_np, rs.ret_slot, rs.slot_ops, R0_np,
+                        fetch_R=False)
+                except Exception as e2:                 # noqa: BLE001
+                    # Mosaic lowering / VMEM allocation failure — the
+                    # XLA walk below handles every history the fast
+                    # path admits
+                    _warn_pallas_failed(repr(e2))
+                    dead = None
             if dead is not None:
                 elapsed = _time.monotonic() - t0
                 if dead < 0:

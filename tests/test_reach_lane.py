@@ -1,0 +1,174 @@
+"""Differential tests for the second-generation (lane) returns-walk
+kernel (interpret mode on CPU; on TPU the same kernel is the default
+single-history fast path ahead of the first-generation kernel).
+
+The lane kernel runs a FIXED number of fire passes (no data-dependent
+control flow): ``min(W, 5)`` in the fast walk — exact outright for the
+common ``W <= 5`` — with an exact ``W``-pass rescue walk when a
+``W > 5`` fast walk's config set empties. These tests cover both
+walks, the checkpoint-based death refinement, and the deep-chain
+histories that force the rescue.
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from jepsen_tpu import fixtures, models
+from jepsen_tpu.checkers import events as ev
+from jepsen_tpu.checkers import reach, reach_lane
+from jepsen_tpu.history import pack
+from jepsen_tpu.op import invoke, ok
+
+
+def _operands(model, history):
+    packed = pack(history)
+    memo, stream, T, S_pad, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20, max_dense=1 << 22)
+    W = max(stream.W, 1)
+    rs = ev.returns_view(stream)
+    P = reach._build_P(memo, S_pad)
+    R0 = np.zeros((S_pad, M), bool)
+    R0[0, 0] = True
+    return memo, stream, rs, P, R0, W, M, S_pad
+
+
+def _xla_walk(P, rs, R0, W, M):
+    rs_p = ev.pad_returns(rs, max(reach._UNROLL,
+                                  reach._bucket(rs.n_returns,
+                                                reach._UNROLL)))
+    xc, bm = reach._xor_bitmask(W, M)
+    ptr, Rf, alive, Rb = reach._jitted_walk_returns()(
+        jnp.asarray(P), jnp.asarray(xc), jnp.asarray(bm),
+        jnp.asarray(rs_p.ret_slot), jnp.asarray(rs_p.slot_ops),
+        jnp.asarray(R0))
+    return rs_p, int(ptr), np.asarray(Rf, bool), bool(alive), Rb
+
+
+@pytest.mark.parametrize("kind,model_fn", [
+    ("cas", models.cas_register),
+    ("register", models.register),
+    ("mutex", models.mutex),
+])
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_lane_matches_xla_walk(kind, model_fn, corrupt):
+    mismatches = 0
+    corrupted_any = False
+    for seed in range(4):
+        h = fixtures.gen_history(kind, n_ops=40, processes=3, seed=seed)
+        if corrupt:
+            try:
+                h = fixtures.corrupt(h, seed=seed)
+                corrupted_any = True
+            except ValueError:      # e.g. mutex histories have no reads
+                continue
+        memo, stream, rs, P, R0, W, M, S_pad = _operands(model_fn(), h)
+        rs_p, ptr, Rf, alive, Rb = _xla_walk(P, rs, R0, W, M)
+        dead, R_out = reach_lane.walk_returns(
+            P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+        assert (dead < 0) == alive
+        if alive:
+            assert np.array_equal(R_out, Rf)
+        else:
+            xc, bm = reach._xor_bitmask(W, M)
+            de_xla = reach._refine_dead(jnp.asarray(P), jnp.asarray(xc),
+                                        jnp.asarray(bm), rs_p, ptr, Rb)
+            assert int(rs.ret_event[dead]) == de_xla
+            mismatches += 1
+    if corrupt and corrupted_any:
+        assert mismatches > 0      # corruption produced real violations
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_lane_multiblock_grid(monkeypatch, corrupt):
+    """Many sequential grid steps: covers the R_scr carry across steps,
+    the per-block checkpoints, and death refinement in a middle block."""
+    monkeypatch.setattr(reach_lane, "_BLOCK", 8)
+    h = fixtures.gen_history("cas", n_ops=120, processes=4, seed=9)
+    if corrupt:
+        h = fixtures.corrupt(h, seed=2)
+    memo, stream, rs, P, R0, W, M, S_pad = _operands(
+        models.cas_register(), h)
+    assert rs.n_returns > 3 * 8          # genuinely multi-block
+    rs_p, ptr, Rf, alive, Rb = _xla_walk(P, rs, R0, W, M)
+    dead, R_out = reach_lane.walk_returns(
+        P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+    assert (dead < 0) == alive
+    if alive:
+        assert np.array_equal(R_out, Rf)
+    else:
+        xc, bm = reach._xor_bitmask(W, M)
+        de_xla = reach._refine_dead(jnp.asarray(P), jnp.asarray(xc),
+                                    jnp.asarray(bm), rs_p, ptr, Rb)
+        assert int(rs.ret_event[dead]) == de_xla
+
+
+def _deep_chain_history(depth: int):
+    """A linearizable history whose FIRST return can only be fired as a
+    ``depth``-long chain in one event: cas(0,1), cas(1,2), …,
+    cas(depth-2, depth-1) and a read of depth-1 are all concurrently
+    pending when the read returns first — the configs must linearize
+    every cas and then the read inside that single return."""
+    h = [invoke(0, "write", 0), ok(0, "write", 0)]   # seed value 0
+    for p in range(depth - 1):
+        h.append(invoke(p, "cas", (p, p + 1)))
+    h.append(invoke(depth - 1, "read"))
+    h.append(ok(depth - 1, "read", depth - 1))
+    for p in range(depth - 1):
+        h.append(ok(p, "cas", (p, p + 1)))
+    return h
+
+
+@pytest.mark.parametrize("depth", [3, 4, 5])
+def test_lane_deep_chains_stay_exact(depth):
+    """Chains deeper than the fast walk's pass count force the exact
+    rescue walk; the verdict must remain "linearizable" either way."""
+    h = _deep_chain_history(depth)
+    model = models.cas_register()
+    ref = reach.check_packed(model, pack(h))
+    assert ref["valid"] is True
+    memo, stream, rs, P, R0, W, M, S_pad = _operands(model, h)
+    dead, R_out = reach_lane.walk_returns(
+        P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+    assert dead < 0
+
+
+def test_lane_rescue_path_forced(monkeypatch):
+    """With the fast walk capped at 2 passes, a 4-deep chain history
+    falsely dies in the fast walk and must be rescued by the exact
+    walk — the final verdict stays valid."""
+    monkeypatch.setattr(reach_lane, "_FAST_PASSES", 2)
+    h = _deep_chain_history(4)
+    memo, stream, rs, P, R0, W, M, S_pad = _operands(
+        models.cas_register(), h)
+    assert W >= 4
+    dead, R_out = reach_lane.walk_returns(
+        P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+    assert dead < 0
+
+
+def test_lane_end_to_end_via_check_packed(monkeypatch):
+    """Force the lane path through check_packed (interpret on CPU) and
+    compare verdicts against the default engine."""
+    import functools
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    orig = reach_lane.walk_returns
+    monkeypatch.setattr(reach_lane, "walk_returns",
+                        functools.partial(orig, interpret=True))
+
+    model = models.cas_register()
+    good = fixtures.gen_history("cas", n_ops=60, processes=4, seed=3)
+    res = reach.check_packed(model, pack(good))
+    assert res["valid"] is True
+    assert res["engine"] == "reach-pallas"
+
+    bad = fixtures.corrupt(good, seed=3)
+    res_bad = reach.check_packed(model, pack(bad))
+    monkeypatch.setattr(reach, "_use_pallas", lambda: False)
+    ref = reach.check_packed(model, pack(bad))
+    assert res_bad["valid"] is False
+    assert res_bad["op"] == ref["op"]
+    assert res_bad["dead-event"] == ref["dead-event"]
+    assert res_bad.get("final-configs") is not None
