@@ -8,6 +8,16 @@ for linearizability in <60 s on TPU; metric is ops verified per second, and
 own (SURVEY.md §6) — CPU Knossos folklore is that 100k-op single-key
 histories simply time out.
 
+With ``--engine reach`` (the default) the run also reports a
+kernel-level probe (SURVEY.md §5 tracing): steady-state device time of
+the lane kernel separated from host->device transfer and the
+dispatch/fetch round-trip, plus an honest MFU figure. The probe times
+the kernel by dispatch slope (K queued dispatches + one fetch, minus a
+single dispatch + fetch) because ``block_until_ready`` does not block
+on the tunneled dev platform; transfer completion is observed by
+fetching the smallest operand back (so the figure includes one
+readback round-trip — see ``kernel_probe``).
+
 Usage: python bench.py [--ops N] [--repeat K] [--engine reach|chunked]
 """
 from __future__ import annotations
@@ -16,6 +26,75 @@ import argparse
 import json
 import sys
 import time
+
+
+# peak dense bf16 MXU throughput of one TPU v5-lite chip, for the MFU
+# denominator (the walk is latency-bound tiny-matmul work, so MFU is
+# honestly tiny — the point of reporting it)
+_PEAK_FLOPS = 197e12
+
+
+def kernel_probe(model, packed) -> dict:
+    """Steady-state device-kernel probe for the single-history lane
+    walk: returns kernel_s (dispatch-slope), transfer_s / bytes, the
+    dispatch+fetch round-trip, and MFU. Raises if the lane path does
+    not admit the history (caller treats the probe as best-effort)."""
+    import numpy as np
+
+    import jax
+    from jepsen_tpu.checkers import events as ev
+    from jepsen_tpu.checkers import reach, reach_lane
+
+    memo, stream, T, S, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    W = max(stream.W, 1)
+    rs = ev.returns_view(stream)
+    P_np = reach._build_P(memo, S)
+    R0 = np.zeros((S, M), bool)
+    R0[0, 0] = True
+    R_real = int(rs.ret_slot.shape[0])
+    # marshaling shared with the production path — the probe can never
+    # time a kernel built with stale geometry
+    geom, _, _, host_args = reach_lane.pack_operands(
+        P_np, rs.ret_slot, rs.slot_ops, R0)
+    B, W, M, S, O1, R_pad = geom
+    n_pass = min(W, reach_lane._FAST_PASSES)
+    run = reach_lane._lane_call(B, W, M, S, O1, R_pad, n_pass, False)
+    n_bytes = sum(a.nbytes for a in host_args)
+    args = jax.device_put(host_args)
+    _ = np.asarray(run(*args)[1])               # warm/compile
+    # transfer: one batched put, forced to completion by fetching the
+    # smallest whole operand back (measured warm — the first put pays
+    # allocator setup). Includes ONE readback round-trip (~0.07-0.15 s
+    # on the tunnel): there is no way to observe put completion without
+    # it, so treat small-size figures as put + 1 RTT.
+    t0 = time.monotonic()
+    args = jax.device_put(host_args)
+    _ = np.asarray(args[3])
+    transfer_s = time.monotonic() - t0   # compilation to warm, 1 RTT in
+    t0 = time.monotonic()
+    _ = np.asarray(run(*args)[1])
+    one_s = time.monotonic() - t0               # 1 dispatch + fetch
+    K = 6
+    t0 = time.monotonic()
+    outs = [run(*args) for _ in range(K)]
+    _ = np.asarray(outs[-1][1])
+    many_s = time.monotonic() - t0
+    kernel_s = max(0.0, (many_s - one_s) / (K - 1))
+    # FLOPs: n_pass fire matmuls [M,S]@[S,W*S] per return (the VPU
+    # reshuffles and projection move bytes, not FLOPs)
+    flops = 2.0 * M * S * W * S * n_pass * R_real
+    return {
+        "kernel_s": round(kernel_s, 4),
+        "kernel_ns_per_return": round(kernel_s / max(R_real, 1) * 1e9),
+        "returns": R_real,
+        "transfer_sync_s": round(transfer_s, 4),
+        "transfer_bytes": int(n_bytes),
+        "dispatch_fetch_s": round(one_s - kernel_s, 4),
+        "mfu_pct": round(flops / max(kernel_s, 1e-9) / _PEAK_FLOPS * 100,
+                         4),
+    }
 
 
 def main() -> int:
@@ -89,6 +168,13 @@ def main() -> int:
         "events": res.get("events"),
         "slots": res.get("slots"),
     }
+    if args.engine == "reach":
+        try:
+            out["kernel"] = kernel_probe(model, packed)
+        except Exception as e:                          # noqa: BLE001
+            # probe is diagnostics, not the metric: histories the lane
+            # kernel does not admit (or CPU-only runs) skip it
+            out["kernel"] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps(out))
     return 0
 

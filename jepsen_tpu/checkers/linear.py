@@ -134,6 +134,23 @@ class SetConfigSet:
         bit = 1 << slot
         self.configs = {c for c in self.configs if not c[1] & bit}
 
+    def stash(self):
+        """O(1) reference to the current container (safe to keep across
+        :meth:`project_return`, which rebinds rather than mutates)."""
+        return self.configs
+
+    @staticmethod
+    def decode(stash, limit: int) -> List[tuple]:
+        """Up to ``limit`` ``(state_id, pending_mask)`` pairs from a
+        stashed container — the raw material for knossos-style
+        ``final-configs`` evidence."""
+        out = []
+        for c in stash:
+            out.append(c)
+            if len(out) >= limit:
+                break
+        return out
+
 
 class ArrayConfigSet:
     """Array-backed config set (upstream ``array-config-set``): one sorted
@@ -191,6 +208,19 @@ class ArrayConfigSet:
     def project_return(self, slot: int) -> None:
         bit = np.uint64(1 << slot)
         self.keys = self.keys[(self.keys & bit) == 0]
+
+    def stash(self):
+        """O(1) reference to the current key vector (safe to keep across
+        :meth:`project_return`, which rebinds rather than mutates)."""
+        return self.keys
+
+    @staticmethod
+    def decode(stash, limit: int) -> List[tuple]:
+        """Up to ``limit`` ``(state_id, pending_mask)`` pairs from a
+        stashed key vector — the raw material for knossos-style
+        ``final-configs`` evidence."""
+        return [(int(k >> np.uint64(32)), int(k & _MASK32))
+                for k in stash[:limit]]
 
 
 def check(model: Model, history: Sequence[Op], *,
@@ -271,6 +301,7 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         return None
 
     pending: Dict[int, int] = {}            # slot -> op id (live invocations)
+    last_ok: Optional[int] = None           # entry of last linearized return
     for e, (_rank, k, i) in enumerate(evs):
         s = int(slots[e])
         if k == KIND_INVOKE:
@@ -283,14 +314,33 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
             bad["configs-explored"] = explored
             return bad
         explored += len(configs)
+        # O(1) stash of the closure set's container: project_return
+        # REBINDS (never mutates) it in both reps, so on the failure
+        # path this reference still holds the knossos-style final
+        # configs at the failing event — no per-return copying
+        stash = configs.stash()
         configs.project_return(s)
-        del pending[s]
         if len(configs) == 0:
-            return {"valid": False, "engine": "linear", "rep": configs.rep,
-                    "op": packed.entries[i].op.to_dict(),
-                    "configs-explored": explored, "max-config-set": peak,
-                    "states-materialized": len(table.states)}
+            pend_before = dict(pending)      # still includes slot s
+            final = [
+                {"model": str(table.states[sid]),
+                 "linearized-pending": [
+                     str(table.ops[pend_before[sl]])
+                     for sl in sorted(pend_before)
+                     if not (mask >> sl) & 1]}
+                for sid, mask in configs.decode(stash, 16)]
+            out = {"valid": False, "engine": "linear",
+                   "rep": configs.rep,
+                   "op": packed.entries[i].op.to_dict(),
+                   "final-configs": final,
+                   "configs-explored": explored, "max-config-set": peak,
+                   "states-materialized": len(table.states)}
+            if last_ok is not None:
+                out["previous-ok"] = packed.entries[last_ok].op.to_dict()
+            return out
+        del pending[s]
+        last_ok = i
     return {"valid": True, "engine": "linear", "rep": configs.rep,
             "configs-explored": explored, "max-config-set": peak,
-            "final-configs": len(configs),
+            "final-config-count": len(configs),
             "states-materialized": len(table.states)}

@@ -589,6 +589,58 @@ def _attach_witness(out: Dict[str, Any], memo: Memo, rs, P_np, S_pad, M,
         pass                            # evidence is best-effort garnish
 
 
+def _attach_witness_slow(out: Dict[str, Any], memo: Memo,
+                         stream: ev.EventStream, T, S_pad: int, M: int,
+                         W: int, dead_event: int,
+                         packed: h.PackedHistory,
+                         limit: int = 16) -> None:
+    """Witness evidence for the slow event-walk path (taken when the
+    per-return matrix form doesn't fit): re-walk the event prefix up to
+    the failing event to recover the surviving config set, decode it
+    knossos-style (``final-configs``), and name the last successfully
+    linearized return (``previous-ok``). The slot→op pending map at the
+    failing event is replayed host-side (it is statically determined by
+    the stream)."""
+    import jax.numpy as jnp
+
+    try:
+        E_pad = max(64, _bucket(max(dead_event, 1), 64))
+        kind = np.full(E_pad, ev.KIND_PAD, np.int32)
+        slot = np.zeros(E_pad, np.int32)
+        opid = np.full(E_pad, -1, np.int32)
+        kind[:dead_event] = stream.kind[:dead_event]
+        slot[:dead_event] = stream.slot[:dead_event]
+        opid[:dead_event] = stream.opid[:dead_event]
+        R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
+        slot_op0 = jnp.full((W,), -1, jnp.int32)
+        _, R_prev, _ = _jitted_walk()(
+            jnp.asarray(T), jnp.asarray(kind), jnp.asarray(slot),
+            jnp.asarray(opid), R0, slot_op0)
+        # pending map at the failing event, replayed host-side
+        pending = np.full(W, -1, np.int64)
+        for e in range(dead_event):
+            if stream.kind[e] == ev.KIND_INVOKE:
+                pending[stream.slot[e]] = stream.opid[e]
+            elif stream.kind[e] == ev.KIND_RETURN:
+                pending[stream.slot[e]] = -1
+        alive = np.argwhere(np.asarray(R_prev))
+        configs = []
+        for s, mask in alive[:limit]:
+            lin = [str(memo.distinct_ops[pending[j]])
+                   for j in range(W)
+                   if (int(mask) >> j) & 1 and pending[j] >= 0]
+            configs.append({"model": str(memo.states[s]),
+                            "linearized-pending": lin})
+        out["final-configs"] = configs
+        rets = np.nonzero(
+            stream.kind[:dead_event] == ev.KIND_RETURN)[0]
+        if len(rets):
+            prev = packed.entries[int(stream.entry[int(rets[-1])])]
+            out["previous-ok"] = prev.op.to_dict()
+    except Exception:                                   # noqa: BLE001
+        pass                            # evidence is best-effort garnish
+
+
 def check(model: Model, history: Sequence[Op], *,
           max_states: int = 100_000, max_slots: int = 20,
           max_dense: int = 1 << 22) -> Dict[str, Any]:
@@ -680,8 +732,11 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     elapsed = _time.monotonic() - t0
     if bool(alive):
         return _result_valid("reach", stream, memo, elapsed)
-    return _result_invalid("reach", stream, memo, packed,
-                           int(ptr) - 1, elapsed)
+    out = _result_invalid("reach", stream, memo, packed,
+                          int(ptr) - 1, elapsed)
+    _attach_witness_slow(out, memo, stream, T, S_pad, M, W,
+                         int(ptr) - 1, packed)
+    return out
 
 
 def _union_alphabet(model: Model, packed_list, live, max_states: int):
@@ -773,6 +828,13 @@ def _check_many_keyed(model, rss, preps, live, results, packed_list,
             results[i] = _result_invalid(
                 "reach-keyed", stream, memo, packed_list[i],
                 int(wide[k].ret_event[local]), elapsed)
+            # witness decode runs in the key's LOCAL alphabet/geometry
+            # (wide[k] carries union op ids the per-key memo can't name)
+            rs_k = ev.returns_view(stream)
+            W_k = max(stream.W, 1)
+            _attach_witness(results[i], memo, rs_k,
+                            _build_P(memo, preps[i][3]), preps[i][3],
+                            1 << W_k, W_k, local, packed_list[i])
     return results
 
 
@@ -902,6 +964,11 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                     results[i] = _result_invalid(
                         "reach-batch", stream, memo, packed_list[i],
                         dead_event, elapsed)
+                    dead_ret = int(np.searchsorted(
+                        rss[k].ret_event[:rss[k].n_returns], dead_event))
+                    _attach_witness(results[i], memo, rss[k],
+                                    Ps[k], S_pad, M, W, dead_ret,
+                                    packed_list[i])
             return results  # type: ignore[return-value]
         E_pad = max(preps[i][1].E for i in live)
         Ts, kinds, slots, opids, R0s, slot0s, streams = \
@@ -934,6 +1001,9 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                 results[i] = _result_invalid(
                     "reach-batch", stream, memo, packed_list[i],
                     int(ptrs[k]) - 1, elapsed)
+                _attach_witness_slow(results[i], memo, stream, Ts[k],
+                                     S_pad, M, W, int(ptrs[k]) - 1,
+                                     packed_list[i])
     return results  # type: ignore[return-value]
 
 

@@ -196,31 +196,15 @@ def _refine_dead(P_np, W: int, M: int, ret_slot, slot_ops,
     return start + int(ptr1) - 1
 
 
-def _run_walk(run, ret_slot, slot_ops, P, R0_ms, idx_dt):
-    import jax
-
-    args = jax.device_put((
-        np.ascontiguousarray(ret_slot, np.int8),
-        np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
-        np.ascontiguousarray(P, np.float32),
-        np.ascontiguousarray(R0_ms, np.float32)))
-    return run(*args)
-
-
-def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
-                 slot_ops: np.ndarray, R0_sm: np.ndarray, *,
-                 interpret: bool = False,
-                 fetch_R: bool = True) -> Tuple[int, Optional[np.ndarray]]:
-    """Run the full returns walk on device; same contract as
-    :func:`jepsen_tpu.checkers.reach_pallas.walk_returns`.
-
-    ``P`` f32[O1, S, S] (last row the all-zero sentinel); ``ret_slot``
-    i32[R]; ``slot_ops`` i32[R, W]; ``R0_sm`` bool[S, M]. Returns
-    ``(dead, R_final)``: ``dead`` is the first return index at which
-    the config set emptied (-1 if linearizable) and ``R_final`` the
-    final config set as bool[S, M] (``None`` on invalid histories —
-    the verdict is in ``dead``).
-    """
+def pack_operands(P: np.ndarray, ret_slot: np.ndarray,
+                  slot_ops: np.ndarray, R0_sm: np.ndarray, *,
+                  interpret: bool = False):
+    """Marshal host operands for the lane walk: block-size selection,
+    bucketed padding, narrow index dtypes, and the ``[M, S]`` config
+    layout. Returns ``(geometry, padded_ret_slot, padded_slot_ops,
+    host_args)`` where ``host_args`` feed the jitted program from
+    :func:`_lane_call` directly. Shared by :func:`walk_returns` and the
+    kernel probe in ``bench.py`` so the two can never drift."""
     from jepsen_tpu.checkers.reach import _bucket
 
     O1, S, _ = P.shape
@@ -238,10 +222,37 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
         slot_ops = np.pad(slot_ops, ((0, R_pad - R_real), (0, 0)),
                           constant_values=-1)
     idx_dt = np.int16 if O1 <= np.iinfo(np.int16).max else np.int32
-    R0_ms = np.ascontiguousarray(R0_sm.T, np.float32)
+    host_args = (np.ascontiguousarray(ret_slot, np.int8),
+                 np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
+                 np.ascontiguousarray(P, np.float32),
+                 np.ascontiguousarray(R0_sm.T, np.float32))
+    geom = (B, W, M, S, O1, R_pad)
+    return geom, ret_slot, slot_ops, host_args
+
+
+def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
+                 slot_ops: np.ndarray, R0_sm: np.ndarray, *,
+                 interpret: bool = False,
+                 fetch_R: bool = True) -> Tuple[int, Optional[np.ndarray]]:
+    """Run the full returns walk on device; same contract as
+    :func:`jepsen_tpu.checkers.reach_pallas.walk_returns`.
+
+    ``P`` f32[O1, S, S] (last row the all-zero sentinel); ``ret_slot``
+    i32[R]; ``slot_ops`` i32[R, W]; ``R0_sm`` bool[S, M]. Returns
+    ``(dead, R_final)``: ``dead`` is the first return index at which
+    the config set emptied (-1 if linearizable) and ``R_final`` the
+    final config set as bool[S, M] (``None`` on invalid histories or
+    with ``fetch_R=False`` — the verdict is in ``dead``).
+    """
+    import jax
+
+    R_real = int(ret_slot.shape[0])
+    geom, ret_slot, slot_ops, host_args = pack_operands(
+        P, ret_slot, slot_ops, R0_sm, interpret=interpret)
+    B, W, M, S, O1, R_pad = geom
     n_fast = min(W, _FAST_PASSES)
     run = _lane_call(B, W, M, S, O1, R_pad, n_fast, interpret)
-    ckpt, final = _run_walk(run, ret_slot, slot_ops, P, R0_ms, idx_dt)
+    ckpt, final = run(*jax.device_put(host_args))
     final_np = np.asarray(final)                 # one round-trip
     if final_np.any():
         # sound: fewer-than-W passes only UNDER-approximate the config
@@ -253,8 +264,7 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
         # the exact W-pass kernel (rare — invalid histories and the
         # occasional deep-chain-dependent valid one)
         run = _lane_call(B, W, M, S, O1, R_pad, W, interpret)
-        ckpt, final = _run_walk(run, ret_slot, slot_ops, P, R0_ms,
-                                idx_dt)
+        ckpt, final = run(*jax.device_put(host_args))
         final_np = np.asarray(final)
         if final_np.any():
             return -1, (final_np > 0.5).T if fetch_R else None

@@ -1,0 +1,140 @@
+"""Multi-device scaling curve on the virtual CPU mesh.
+
+Measures the three sharded checker paths at 1/2/4/8 devices
+(`--devices` to override), one subprocess per device count (the XLA
+device count is fixed at backend init):
+
+- **keyed**  — `check_many` with the key axis sharded over the mesh
+  (the `independent` hot path; data-parallel axis);
+- **chunked** — `check_chunked` boolean transfer matrices with the
+  chunk axis sharded via `shard_map` (history/sequence-parallel axis);
+- **frontier** — the sparse engine with config rows hash-routed to
+  owner shards via `all_to_all`.
+
+IMPORTANT caveat, printed with the results: on a host with fewer
+physical cores than virtual devices the curve measures *sharding
+overhead*, not parallel speedup — XLA's virtual CPU devices share the
+host's cores. A flat curve on a 1-core host is the success criterion
+there (the sharded program does ~1x total work); real speedup needs
+real chips (or >= n_devices cores). `__graft_entry__.dryrun_multichip`
+asserts >= 3x keyed speedup at 8 devices when the host has the cores
+to show it.
+
+Usage: python tools/scaling.py [--devices 1,2,4,8] [--keys 512]
+       [--chunk-ops 100000] [--quick]
+Emits one JSON line per (path, n_devices) plus a summary line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+
+def _worker(n_dev: int, keys: int, key_ops: int, chunk_ops: int,
+            n_chunks: int) -> int:
+    """Runs inside the subprocess: measure all three paths on an
+    ``n_dev``-device mesh and print one JSON line per path."""
+    import jax
+
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import frontier, reach
+    from jepsen_tpu.history import pack
+
+    devs = jax.devices()[:n_dev]
+    model = models.cas_register()
+
+    def best_of(fn, n=2):
+        fn()                                     # warm / compile
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.monotonic()
+            fn()
+            best = min(best, time.monotonic() - t0)
+        return best
+
+    # keyed: N keys sharded over the mesh
+    packs = [pack(fixtures.gen_history("cas", n_ops=key_ops, processes=3,
+                                       seed=s))
+             for s in range(keys)]
+    dt = best_of(lambda: reach.check_many(model, packs, devices=devs))
+    print(json.dumps({"path": "keyed", "n_devices": n_dev, "keys": keys,
+                      "key_ops": key_ops, "best_s": round(dt, 3)}),
+          flush=True)
+
+    # chunked: one long history, chunk axis sharded
+    hist = fixtures.gen_history("cas", n_ops=chunk_ops, processes=5,
+                                seed=42)
+    packed = pack(hist)
+    dt = best_of(lambda: reach.check_chunked(
+        model, packed=packed, n_chunks=n_chunks, devices=devs,
+        max_matrix=1 << 28))
+    print(json.dumps({"path": "chunked", "n_devices": n_dev,
+                      "ops": chunk_ops, "n_chunks": n_chunks,
+                      "best_s": round(dt, 3)}), flush=True)
+
+    # frontier: crash-seasoned register history, rows hash-routed.
+    # crash parameters are deliberately light: every crashed op stays
+    # forever-pending, and distinct-value crashed writes multiply the
+    # quotiented config space (2 values / 1% keeps the set ~8k rows)
+    hist = fixtures.gen_history("register", n_ops=1200, processes=4,
+                                crash_p=0.01, values=2, seed=11)
+    dt = best_of(lambda: frontier.check(models.register(), hist,
+                                        frontier0=512, devices=devs),
+                 n=1)
+    print(json.dumps({"path": "frontier", "n_devices": n_dev,
+                      "ops": 1200, "best_s": round(dt, 3)}), flush=True)
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", default="1,2,4,8")
+    ap.add_argument("--keys", type=int, default=512)
+    ap.add_argument("--key-ops", type=int, default=100)
+    ap.add_argument("--chunk-ops", type=int, default=100_000)
+    ap.add_argument("--n-chunks", type=int, default=64)
+    ap.add_argument("--quick", action="store_true",
+                    help="small sizes for CI")
+    ap.add_argument("--_worker", type=int, default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.quick:
+        args.keys, args.chunk_ops, args.n_chunks = 64, 10_000, 16
+
+    if args._worker is not None:
+        return _worker(args._worker, args.keys, args.key_ops,
+                       args.chunk_ops, args.n_chunks)
+
+    counts = [int(x) for x in args.devices.split(",")]
+    cores = os.cpu_count() or 1
+    print(json.dumps({"host_cores": cores, "note":
+                      "with host_cores < n_devices the curve measures "
+                      "sharding overhead, not speedup"}), flush=True)
+    for n in counts:
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count={n}"
+                            ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--_worker", str(n),
+               "--keys", str(args.keys), "--key-ops", str(args.key_ops),
+               "--chunk-ops", str(args.chunk_ops),
+               "--n-chunks", str(args.n_chunks)]
+        r = subprocess.run(cmd, env=env, cwd=_REPO)
+        if r.returncode != 0:
+            print(json.dumps({"n_devices": n, "error": r.returncode}),
+                  flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
