@@ -45,10 +45,9 @@ def kernel_probe(model, packed) -> dict:
     from jepsen_tpu.checkers import events as ev
     from jepsen_tpu.checkers import reach, reach_lane
 
-    memo, stream, T, S, M = reach._prep(
+    memo, stream, _T, S, M = reach._prep(
         model, packed, max_states=100_000, max_slots=20,
         max_dense=1 << 22)
-    W = max(stream.W, 1)
     rs = ev.returns_view(stream)
     P_np = reach._build_P(memo, S)
     R0 = np.zeros((S, M), bool)

@@ -46,6 +46,13 @@ instead *dies* on config-set explosion; here only :class:`FrontierOverflow`
 past ``max_frontier`` gives up, and the facade falls back to the CPU
 searches). Exact, not probabilistic: rows are compared in full — no
 fingerprint hashing — so verdicts cannot be corrupted by collisions.
+
+The default ``max_frontier`` (131072 rows) admits dedup sorts of
+~1.2M rows. (Round 1 capped it at 16384 to dodge a dev-tunnel bug —
+~590k-row ``lax.sort`` calls crashed the TPU worker; re-verified
+2026-07-30 that both bare sorts at 1M+ rows and full F=65536 frontier
+walks now run clean on device, so the cap once again reflects memory
+budget, not a workaround.)
 """
 from __future__ import annotations
 
@@ -487,7 +494,7 @@ def _final_configs(memo: Memo, rs: ev.ReturnStream,
 
 def check(model: Model, history: Sequence[Op], *,
           max_states: int = 100_000, max_slots: int = MAX_SLOTS,
-          frontier0: int = 1 << 10, max_frontier: int = 1 << 14,
+          frontier0: int = 1 << 10, max_frontier: int = 1 << 17,
           time_limit: Optional[float] = None, should_abort=None,
           devices: Optional[Sequence] = None) -> Dict[str, Any]:
     """Check one history with the sparse frontier engine. Raises
@@ -506,7 +513,7 @@ def check(model: Model, history: Sequence[Op], *,
 
 def check_packed(model: Model, packed: h.PackedHistory, *,
                  max_states: int = 100_000, max_slots: int = MAX_SLOTS,
-                 frontier0: int = 1 << 10, max_frontier: int = 1 << 14,
+                 frontier0: int = 1 << 10, max_frontier: int = 1 << 17,
                  time_limit: Optional[float] = None, should_abort=None,
                  devices: Optional[Sequence] = None) -> Dict[str, Any]:
     t0 = _time.monotonic()

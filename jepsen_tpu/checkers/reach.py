@@ -812,11 +812,19 @@ def _check_many_keyed(model, rss, preps, live, results, packed_list,
     except (StateExplosion, DenseOverflow):
         return None
     try:
-        dead = reach_pallas.walk_returns_keyed(
+        # second-generation keyed kernel (unconditional exact passes,
+        # pipelined gather); first-generation kernel as fallback
+        from jepsen_tpu.checkers import reach_lane
+        dead = reach_lane.walk_returns_keyed(
             P, ret_flat, ops_flat, key_flat, len(wide), M)
     except Exception as e:                              # noqa: BLE001
         _warn_pallas_failed(repr(e))
-        return None
+        try:
+            dead = reach_pallas.walk_returns_keyed(
+                P, ret_flat, ops_flat, key_flat, len(wide), M)
+        except Exception as e2:                         # noqa: BLE001
+            _warn_pallas_failed(repr(e2))
+            return None
     elapsed = _time.monotonic() - t0
     for k, i in enumerate(live):
         memo, stream = preps[i][0], preps[i][1]

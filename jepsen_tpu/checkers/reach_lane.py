@@ -174,6 +174,153 @@ def _lane_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
     return jax.jit(run)
 
 
+# -- keyed batch: many independent keys in one kernel ------------------------
+#
+# The per-key (`jepsen.independent`) hot path, upgraded from the first
+# kernel's structure the same way as the single-history walk: W
+# unconditional fire passes (exact, no fixpoint while_loop or popcounts)
+# and the software-pipelined gather. The per-return death check stays —
+# per-key exact dead indices are the kernel's output — as do the
+# key-boundary config-set resets (untaken pl.when is ~free; the reset
+# fires once per key).
+
+def _make_keyed_kernel(B: int, W: int, M: int, S: int, O1: int,
+                       K: int, n_pass: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from jepsen_tpu.checkers.reach_pallas import _gather_G, _one_fire_pass
+
+    def kernel(ret_slot_ref, slot_ops_ref, key_ref, P_ref,
+               dead_ref, R_scr, G_scr, prev_scr):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            prev_scr[0] = jnp.int32(-1)
+
+            def ini(k, _):
+                dead_ref[k] = jnp.int32(-1)
+                return 0
+
+            jax.lax.fori_loop(0, K, ini, 0)
+
+        rows = jax.lax.broadcasted_iota(jnp.int32, (M, S), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (M, S), 1)
+        R0 = jnp.logical_and(rows == 0, cols == 0).astype(jnp.float32)
+        G_scr[0] = _gather_G(slot_ops_ref, P_ref, 0, W, O1)
+
+        def do_return(b, _):
+            r = step * B + b
+            j = ret_slot_ref[b]
+            key = key_ref[b]
+            is_real = key >= 0
+
+            @pl.when(jnp.logical_and(is_real, key != prev_scr[0]))
+            def _new_key():
+                R_scr[:] = R0
+                prev_scr[0] = key
+
+            G_all = G_scr[b % 2]
+            bn = jnp.minimum(b + 1, B - 1)
+            G_scr[(b + 1) % 2] = _gather_G(slot_ops_ref, P_ref, bn, W, O1)
+            R = R_scr[:]
+            for _p in range(n_pass):
+                R = _one_fire_pass(R, G_all, W, M, S)
+            R = _project(R, j, W, M, S)
+            kk = jnp.maximum(key, 0)
+
+            @pl.when(jnp.logical_and(
+                    is_real,
+                    jnp.logical_and(jnp.sum(R) < 0.5, dead_ref[kk] < 0)))
+            def _mark_dead():
+                dead_ref[kk] = r
+
+            R_scr[:] = R
+            return 0
+
+        jax.lax.fori_loop(0, B, do_return, 0)
+
+    return kernel
+
+
+@functools.cache
+def _keyed_call(B: int, W: int, M: int, S: int, O1: int, N_pad: int,
+                K_pad: int, n_pass: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = _make_keyed_kernel(B, W, M, S, O1, K_pad, n_pass)
+    call = pl.pallas_call(
+        kernel,
+        grid=(N_pad // B,),
+        in_specs=[
+            pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((B * W,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((O1, S, S), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            # constant index map: the block stays resident across the
+            # sequential grid, accumulating per-key verdicts
+            pl.BlockSpec((K_pad,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((K_pad,), jnp.int32)],
+        scratch_shapes=[
+            pltpu.VMEM((M, S), jnp.float32),
+            pltpu.VMEM((2, S, W * S), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )
+
+    def run(ret_slot, slot_ops, key_id, P):
+        return call(ret_slot.astype(jnp.int32),
+                    slot_ops.astype(jnp.int32),
+                    key_id.astype(jnp.int32), P)
+
+    return jax.jit(run)
+
+
+def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
+                       slot_ops: np.ndarray, key_id: np.ndarray,
+                       n_keys: int, M: int, *,
+                       interpret: bool = False) -> np.ndarray:
+    """Walk the concatenation of ``n_keys`` return streams in one
+    kernel; same contract as
+    :func:`jepsen_tpu.checkers.reach_pallas.walk_returns_keyed`."""
+    import jax
+
+    from jepsen_tpu.checkers.reach import _bucket
+
+    O1, S, _ = P.shape
+    N = int(ret_slot.shape[0])
+    W = int(slot_ops.shape[1])
+    B = min(32, _BLOCK) if interpret else _BLOCK
+    N_pad = max(B, _bucket(-(-max(N, 1) // B) * B, B))
+    K_pad = max(8, _bucket(n_keys, 8))
+    if N_pad != N:
+        ret_slot = np.pad(ret_slot, (0, N_pad - N), constant_values=-1)
+        slot_ops = np.pad(slot_ops, ((0, N_pad - N), (0, 0)),
+                          constant_values=-1)
+        key_id = np.pad(key_id, (0, N_pad - N), constant_values=-1)
+    run = _keyed_call(B, W, M, S, O1, N_pad, K_pad, W, interpret)
+    idx_dt = np.int16 if O1 <= np.iinfo(np.int16).max else np.int32
+    args = jax.device_put((
+        np.ascontiguousarray(ret_slot, np.int8),
+        np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
+        np.ascontiguousarray(key_id, np.int32),
+        np.ascontiguousarray(P, np.float32)))
+    (dead,) = run(*args)
+    return np.asarray(dead)[:n_keys]
+
+
 def _refine_dead(P_np, W: int, M: int, ret_slot, slot_ops,
                  R0_blk_sm: np.ndarray, start: int, n: int) -> int:
     """Exact dead return index within ``[start, start + n)``: re-walk

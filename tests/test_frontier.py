@@ -288,3 +288,25 @@ class TestFacadeRouting:
             frontier0=64).check(None, index(h))
         assert res["valid"] is True
         assert res["engine"] in ("frontier-fallback", "frontier")
+
+
+class TestBigFrontier:
+    def test_65536_row_frontier(self):
+        """The full walk at F=65536 — dedup sorts of ~590k rows, the
+        exact shape that crashed the round-1 dev tunnel's TPU worker
+        (re-verified clean on device 2026-07-30; the default
+        max_frontier is no longer tuned to that bug). Runs at full
+        capacity from the start so every segment exercises the big
+        sort."""
+        h = fixtures.gen_history("register", n_ops=40, processes=3,
+                                 crash_p=0.1, values=3, seed=7)
+        res = frontier.check(m.register(), h, frontier0=1 << 16,
+                             max_frontier=1 << 17)
+        assert res["valid"] is True
+        ref = wgl_ref.check(m.register(), h)
+        assert ref["valid"] is True
+
+    def test_default_cap_is_lifted(self):
+        import inspect
+        sig = inspect.signature(frontier.check)
+        assert sig.parameters["max_frontier"].default >= 1 << 17

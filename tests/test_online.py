@@ -94,3 +94,109 @@ def test_valid_run_with_online_check():
     online = done["results"]["online-check"]
     assert online["valid"] is True
     assert online["flushes"] >= 1
+
+
+class TestIncremental:
+    """The incremental engine: O(n) total work, exact final verdicts."""
+
+    def test_differential_final_verdict(self):
+        """Streamed through the monitor with run-over finalization, the
+        incremental verdict must equal the post-hoc engine's on the
+        same history — valid, corrupted, and crash-seasoned."""
+        from jepsen_tpu.checkers import reach
+        from jepsen_tpu.checkers.online import IncrementalEngine
+        for seed in range(8):
+            kind = ["cas", "register", "mutex"][seed % 3]
+            h = fixtures.gen_history(kind, n_ops=60, processes=4,
+                                     seed=seed,
+                                     crash_p=0.1 if seed % 2 else 0.0)
+            if seed in (1, 4):
+                try:
+                    h = fixtures.corrupt(h, seed=seed)
+                except ValueError:
+                    pass
+            ref = reach.check(fixtures.model_for(kind), h)
+            eng = IncrementalEngine(fixtures.model_for(kind))
+            v = None
+            for op in h:
+                eng.feed(op)
+                v = v or eng.advance()
+            v = v or eng.advance(run_over=True)
+            got = v is None
+            assert got == (ref["valid"] is True), \
+                f"seed {seed} {kind}: incremental={got} ref={ref['valid']}"
+
+    def test_flush_cost_independent_of_prefix_length(self):
+        """Each settled return is walked exactly once across the whole
+        run: total walked events equal the settled-return count, not
+        O(flushes x prefix) — the structural form of 'flush cost is
+        independent of prefix length'."""
+        h = fixtures.gen_history("cas", n_ops=4000, processes=4, seed=9)
+        mon = OnlineLinearizable(fixtures.model_for("cas"))
+        for i, op in enumerate(h):
+            mon.observe(op)
+            if i % 100 == 99:
+                mon.flush()
+        res = mon.stop()
+        assert res["valid"] is True
+        eng = mon._engine
+        assert eng is not None, "incremental mode fell back"
+        assert eng.walked_events == eng.settled_returns
+        # every completed pair settled by the final flush
+        assert res["ops-checked"] == len(h)
+
+    def test_fail_completions_are_stripped(self):
+        """A failed op must not constrain the walk: write(1) fails, a
+        concurrent read correctly sees the previous value."""
+        from jepsen_tpu.checkers.online import IncrementalEngine
+        from jepsen_tpu.op import fail, invoke, ok
+        h = [invoke(0, "write", 0), ok(0, "write", 0),
+             invoke(1, "write", 1),              # will fail
+             invoke(2, "read"), ok(2, "read", 0),
+             fail(1, "write", 1),
+             invoke(2, "read"), ok(2, "read", 0)]
+        eng = IncrementalEngine(fixtures.model_for("register"))
+        for op in h:
+            eng.feed(op)
+        assert eng.advance(run_over=True) is None
+        assert eng.settled_returns == 3
+
+    def test_alphabet_and_slot_growth(self):
+        """New values appearing late (alphabet growth re-encodes the
+        carried states) and concurrency growth (mask-axis re-embed)
+        keep the walk exact."""
+        from jepsen_tpu.checkers import reach
+        from jepsen_tpu.checkers.online import IncrementalEngine
+        from jepsen_tpu.op import invoke, ok
+        h = [invoke(0, "write", 0), ok(0, "write", 0)]
+        # low concurrency with values {0, 1}
+        for i in range(10):
+            h += [invoke(0, "write", i % 2), ok(0, "write", i % 2),
+                  invoke(0, "read"), ok(0, "read", i % 2)]
+        # then 4-way concurrency with fresh values {7, 8, 9}
+        h += [invoke(p, "write", 7 + p % 3) for p in range(1, 5)]
+        h += [ok(p, "write", 7 + p % 3) for p in range(1, 5)]
+        h += [invoke(0, "read"), ok(0, "read", 9)]
+        ref = reach.check(fixtures.model_for("register"), h)
+        eng = IncrementalEngine(fixtures.model_for("register"))
+        for op in h:
+            eng.feed(op)
+        v = eng.advance(run_over=True)
+        assert (v is None) == (ref["valid"] is True)
+        assert eng.W >= 4
+
+    def test_incremental_violation_is_sticky_and_early(self):
+        h = fixtures.corrupt(
+            fixtures.gen_history("cas", n_ops=200, processes=4, seed=6),
+            seed=6)
+        mon = OnlineLinearizable(fixtures.model_for("cas"),
+                                 min_new_ops=1)
+        detected = None
+        for i, op in enumerate(h):
+            mon.observe(op)
+            if i % 10 == 9 and mon.flush() is not None and detected is None:
+                detected = i
+        res = mon.stop()
+        assert res["valid"] is False
+        assert res["engine"] == "online-incremental"
+        assert detected is not None and detected < len(h)

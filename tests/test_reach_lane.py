@@ -172,3 +172,67 @@ def test_lane_end_to_end_via_check_packed(monkeypatch):
     assert res_bad["op"] == ref["op"]
     assert res_bad["dead-event"] == ref["dead-event"]
     assert res_bad.get("final-configs") is not None
+
+
+def test_keyed_lane_matches_per_key_checks():
+    """Concatenated multi-key walk on the lane keyed kernel vs
+    independent single-key verdicts: mixed valid/corrupt keys, shared
+    alphabet, exact dead mapping."""
+    from jepsen_tpu.checkers import events as _ev
+    model = models.cas_register()
+    histories = []
+    for seed in range(6):
+        h = fixtures.gen_history("cas", n_ops=30, processes=3, seed=seed)
+        if seed % 2:
+            h = fixtures.corrupt(h, seed=seed)
+        histories.append(h)
+    packed = [pack(h) for h in histories]
+    preps = [reach._prep(model, p, max_states=100_000, max_slots=20,
+                         max_dense=1 << 22) for p in packed]
+    live = list(range(len(packed)))
+    W = max(max(p[1].W, 1) for p in preps)
+    M = 1 << W
+    rss = [_ev.returns_view(p[1]) for p in preps]
+    P, ret_flat, ops_flat, key_flat, offsets, wide = \
+        reach._keyed_operands(model, packed, rss, live, W, 100_000)
+    dead = reach_lane.walk_returns_keyed(
+        P, ret_flat, ops_flat, key_flat, len(wide), M, interpret=True)
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        if ref["valid"]:
+            assert dead[k] < 0, f"key {k}"
+        else:
+            local = int(dead[k]) - int(offsets[k])
+            assert 0 <= local < wide[k].n_returns
+            assert int(wide[k].ret_event[local]) == ref["dead-event"], \
+                f"key {k}"
+
+
+def test_keyed_lane_multiblock(monkeypatch):
+    """Key boundaries crossing grid-step boundaries (R_scr and the
+    pipelined gather carried across steps)."""
+    from jepsen_tpu.checkers import events as _ev
+    monkeypatch.setattr(reach_lane, "_BLOCK", 16)
+    model = models.register()
+    histories = []
+    for seed in range(8):
+        h = fixtures.gen_history("register", n_ops=25, processes=3,
+                                 seed=seed)
+        if seed in (2, 5):
+            h = fixtures.corrupt(h, seed=seed)
+        histories.append(h)
+    packed = [pack(h) for h in histories]
+    preps = [reach._prep(model, p, max_states=100_000, max_slots=20,
+                         max_dense=1 << 22) for p in packed]
+    live = list(range(len(packed)))
+    W = max(max(p[1].W, 1) for p in preps)
+    M = 1 << W
+    rss = [_ev.returns_view(p[1]) for p in preps]
+    P, ret_flat, ops_flat, key_flat, offsets, wide = \
+        reach._keyed_operands(model, packed, rss, live, W, 100_000)
+    assert len(ret_flat) > 3 * 16
+    dead = reach_lane.walk_returns_keyed(
+        P, ret_flat, ops_flat, key_flat, len(wide), M, interpret=True)
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        assert (dead[k] < 0) == bool(ref["valid"]), f"key {k}"

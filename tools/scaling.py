@@ -17,8 +17,9 @@ overhead*, not parallel speedup — XLA's virtual CPU devices share the
 host's cores. A flat curve on a 1-core host is the success criterion
 there (the sharded program does ~1x total work); real speedup needs
 real chips (or >= n_devices cores). `__graft_entry__.dryrun_multichip`
-asserts >= 3x keyed speedup at 8 devices when the host has the cores
-to show it.
+asserts a conservative >= 2x keyed speedup floor at 8 devices when the
+host has the cores to show it (the timed region includes serial host
+prep and per-iteration liveness all-reduces).
 
 Usage: python tools/scaling.py [--devices 1,2,4,8] [--keys 512]
        [--chunk-ops 100000] [--quick]
@@ -87,8 +88,7 @@ def _worker(n_dev: int, keys: int, key_ops: int, chunk_ops: int,
     hist = fixtures.gen_history("register", n_ops=1200, processes=4,
                                 crash_p=0.01, values=2, seed=11)
     dt = best_of(lambda: frontier.check(models.register(), hist,
-                                        frontier0=512, devices=devs),
-                 n=1)
+                                        frontier0=512, devices=devs))
     print(json.dumps({"path": "frontier", "n_devices": n_dev,
                       "ops": 1200, "best_s": round(dt, 3)}), flush=True)
     return 0
