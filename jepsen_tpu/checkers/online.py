@@ -18,9 +18,11 @@ Two flush strategies:
   value, failed, or crashed) — because an op's transition is not known
   until its value is (a concurrent read may linearize before its return,
   but only with the value it eventually returns). The unsettled tail is
-  at most the in-flight window (≤ concurrency ops) and is checked each
-  flush from a copy of the carried set with unresolved ops treated as
-  crashed — an over-approximation, so a tail alarm is still sound. On
+  usually the in-flight window (≤ concurrency ops) — though one
+  long-pending op queues every later return behind it — and a bounded
+  prefix of it is checked each flush from a copy of the carried set
+  with unresolved ops treated as crashed: an over-approximation, so a
+  tail alarm is still sound. On
   anything the dense representation cannot hold (slot overflow, state
   explosion, model without a finite memo) the monitor permanently falls
   back to the re-check strategy below. Measured: a 100k-op cas stream
@@ -202,17 +204,20 @@ class IncrementalEngine:
                 R[new_id[old_memo.states[sid]]] |= old_R[sid]
         self.R = R
 
-    def _intern_rows(self, b: _Binding,
-                     snap: List[_Binding]) -> np.ndarray:
+    def _intern_rows(self, b: _Binding, snap: List[_Binding],
+                     n_crashed: int) -> np.ndarray:
         """Materialize a return event's pending map to op-id rows —
         called only once every binding in it is resolved (or, for the
-        tail alarm, with unresolved ops as crashed wildcards). Interning
-        happens BEFORE any caller copies ``self.R``: it may rebuild the
-        state coding."""
+        tail alarm, with unresolved ops as crashed wildcards).
+        ``n_crashed`` is the crashed-list length at the return's feed
+        time (crashes recorded later were invoked later and are NOT in
+        this event's pending map). Interning happens BEFORE any caller
+        copies ``self.R``: it may rebuild the state coding."""
+        members = snap + self._crashed[:n_crashed] + [b]
         self._intern_batch([(x.inv.f, x.value)
-                            for x in snap + [b] if x.status != "fail"])
+                            for x in members if x.status != "fail"])
         rows = np.full(self.W, -1, np.int64)
-        for x in snap + [b]:
+        for x in members:
             if x.status == "fail":
                 continue            # stripped, exactly like post-hoc
             rows[x.slot] = self.alphabet[(x.inv.f, hashable(x.value))]
@@ -253,12 +258,16 @@ class IncrementalEngine:
             return                      # completion without invoke: ignore
         if op.type == OK:
             b.resolve("ok", op.value)
-            # pending at this return: live invocations + forever-crashed;
-            # the slot frees NOW (walk order still projects it correctly:
-            # a reused slot's new op cannot fire before this return's
-            # event is walked, so its bit is still clear then)
-            self._queue.append((b, list(self._proc.values())
-                                + list(self._crashed)))
+            # pending at this return: live invocations + the
+            # forever-crashed ops so far. The crashed list only appends,
+            # so its membership at THIS moment is captured by its length
+            # alone — an O(1) snapshot instead of copying an ever-growing
+            # list per return. The slot frees NOW (walk order still
+            # projects it correctly: a reused slot's new op cannot fire
+            # before this return's event is walked, so its bit is still
+            # clear then)
+            self._queue.append((b, list(self._proc.values()),
+                                len(self._crashed)))
             heapq.heappush(self._free, b.slot)
         elif op.type == FAIL:
             # definitely no effect: stripped. The carried set holds no
@@ -289,11 +298,11 @@ class IncrementalEngine:
                 del self._proc[p]
                 self._crashed.append(b)
         while self._queue:
-            b, snap = self._queue[0]
+            b, snap, n_crashed = self._queue[0]
             if not all(x.resolved for x in snap):
                 break
             self._queue.popleft()
-            rows = self._intern_rows(b, snap)
+            rows = self._intern_rows(b, snap, n_crashed)
             self.R = _walk_return(self.R, rows, b.slot, self.P)
             self.settled_returns += 1
             self.walked_events += 1
@@ -302,17 +311,26 @@ class IncrementalEngine:
                 return self.violation
         return None
 
+    # per-flush cap on the tail walk: the queue can grow far beyond the
+    # in-flight window when ONE op stays pending for a long time (every
+    # later return blocks behind it), and re-walking the whole queue
+    # each flush would be the O(n²) this engine exists to avoid. The
+    # oldest _TAIL_CAP events still give a sound early alarm; deeper
+    # events wait for settlement (or the exact final flush).
+    _TAIL_CAP = 512
+
     def tail_alarm(self) -> Optional[Dict[str, Any]]:
-        """Check the unsettled tail from a copy of the carried set with
-        unresolved ops treated as crashed (they may fire anytime or
-        never — a sound over-approximation of any eventual completion,
-        so an alarm here is a real violation). Early detection only;
-        the carried state is untouched."""
+        """Check (a bounded prefix of) the unsettled tail from a copy of
+        the carried set with unresolved ops treated as crashed (they may
+        fire anytime or never — a sound over-approximation of any
+        eventual completion, so an alarm here is a real violation).
+        Early detection only; the carried state is untouched."""
         if self.violation is not None or not self._queue:
             return None
         # intern everything FIRST: interning may re-encode self.R
-        rows_list = [(b, self._intern_rows(b, snap))
-                     for b, snap in self._queue]
+        rows_list = [(b, self._intern_rows(b, snap, n_crashed))
+                     for b, snap, n_crashed
+                     in list(self._queue)[:self._TAIL_CAP]]
         R = self.R.copy()
         for b, rows in rows_list:
             R = _walk_return(R, rows, b.slot, self.P)

@@ -200,3 +200,35 @@ class TestIncremental:
         assert res["valid"] is False
         assert res["engine"] == "online-incremental"
         assert detected is not None and detected < len(h)
+
+
+def test_long_pending_op_bounds_flush_work():
+    """One never-completing invoke queues every later return behind it;
+    the tail walk must stay bounded per flush (no O(n^2) re-walks) and
+    the final verdict exact once the straggler resolves as crashed."""
+    from jepsen_tpu.checkers import reach
+    from jepsen_tpu.checkers.online import IncrementalEngine
+    from jepsen_tpu.op import invoke, ok
+
+    h = [invoke(0, "write", 0), ok(0, "write", 0),
+         invoke(99, "write", 1)]            # never completes
+    for i in range(3000):
+        h += [invoke(1, "read"), ok(1, "read", [0, 1][0])]
+    # interleave a second valid value occasionally via the crashed write
+    eng = IncrementalEngine(fixtures.model_for("register"))
+    import time
+    flush_times = []
+    for i, op in enumerate(h):
+        eng.feed(op)
+        if i % 500 == 499:
+            t0 = time.monotonic()
+            assert eng.advance() is None
+            assert eng.tail_alarm() is None
+            flush_times.append(time.monotonic() - t0)
+    assert len(eng._queue) > eng._TAIL_CAP    # genuinely backed up
+    # bounded: later flushes walk the same capped prefix, not the whole
+    # ever-growing queue (allow generous noise on a shared host)
+    assert flush_times[-1] < 10 * max(flush_times[0], 0.05)
+    assert eng.advance(run_over=True) is None
+    ref = reach.check(fixtures.model_for("register"), h)
+    assert ref["valid"] is True

@@ -98,7 +98,7 @@ def run_trial(params, seed: int, *, pallas: bool = False):
     if pallas:
         try:
             from jepsen_tpu.checkers import events as ev
-            from jepsen_tpu.checkers import reach_pallas
+            from jepsen_tpu.checkers import reach_lane, reach_pallas
             memo, stream, T, S_pad, M = reach._prep(
                 model, packed, max_states=100_000, max_slots=20,
                 max_dense=1 << 22)
@@ -113,6 +113,28 @@ def run_trial(params, seed: int, *, pallas: bool = False):
             verdicts["reach-pallas"] = dead < 0
         except Exception as e:                          # noqa: BLE001
             verdicts["reach-pallas"] = f"skipped: {type(e).__name__}"
+        else:
+            # separate guard: a lane failure must not discard the
+            # already-computed first-generation verdict
+            try:
+                dead2, _ = reach_lane.walk_returns(
+                    P, rs.ret_slot, rs.slot_ops, R0, interpret=True,
+                    fetch_R=False)
+                verdicts["reach-lane"] = dead2 < 0
+            except Exception as e:                      # noqa: BLE001
+                verdicts["reach-lane"] = f"skipped: {type(e).__name__}"
+    # the incremental monitor is a third implementation of the dense
+    # walk (host NumPy, settled-prefix advance): feed it the raw stream
+    try:
+        from jepsen_tpu.checkers.online import IncrementalEngine, _Overflow
+        eng = IncrementalEngine(model)
+        v = None
+        for op in h:
+            eng.feed(op)
+        v = eng.advance(run_over=True)
+        verdicts["online-inc"] = v is None
+    except _Overflow as e:
+        verdicts["online-inc"] = f"skipped: {type(e).__name__}"
     if packed.n <= 7:
         verdicts["brute"] = brute.check(model, h)["valid"]
 
