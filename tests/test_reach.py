@@ -201,6 +201,7 @@ class TestBatched:
         assert [(_o.f, _o.value) for _o in p1.distinct_ops] != \
             [(_o.f, _o.value) for _o in p2.distinct_ops]
         reach._MEMO_CACHE.clear()
+        reach._SUPERSET_SEEDS.clear()   # seeds also serve these lookups
         m1 = reach._cached_memo(model, p1, 100_000)
         assert len(reach._MEMO_CACHE) == 1
         m2 = reach._cached_memo(model, p2, 100_000)
@@ -270,3 +271,70 @@ class TestChunked:
             got = reach.check_chunked(model, h, n_chunks=8,
                                       devices=devs)["valid"]
             assert got == want, seed
+
+
+class TestSupersetSeeds:
+    def test_superset_projection_is_semantically_exact(self):
+        """A seeded union-alphabet memo serves subset-alphabet lookups
+        by column projection; the projected table must satisfy the same
+        semantic invariant as a fresh BFS, and verdicts must agree."""
+        from jepsen_tpu.models import is_inconsistent
+        from jepsen_tpu.op import invoke, ok
+
+        def seq_history(writes):
+            evs, p = [], 0
+            for w in writes:
+                evs += [invoke(p, "write", w), ok(p, "write", w),
+                        invoke(p, "read"), ok(p, "read", w)]
+            return hist(*evs)
+
+        model = fixtures.model_for("cas")
+        full = pack(seq_history([1, 2, 3, 4]))
+        sub = pack(seq_history([2, 4]))           # strict subset alphabet
+        reach._MEMO_CACHE.clear()
+        reach._SUPERSET_SEEDS.clear()
+        reach._seed_union_memo(model, [full], 100_000)
+        assert len(reach._SUPERSET_SEEDS) == 1
+        m = reach._cached_memo(model, sub, 100_000)
+        assert len(reach._MEMO_CACHE) == 0        # served by the seed
+        # the projection restricts to subset-reachable states: S (and
+        # so S_pad and every capacity gate) must match a fresh BFS
+        from jepsen_tpu.models.memo import memo_ops
+        fresh = memo_ops(model, sub.distinct_ops, max_states=100_000)
+        assert m.n_states == fresh.n_states
+        assert m.distinct_ops == sub.distinct_ops
+        assert m.states[m.initial] == model
+        for s, st in enumerate(m.states):
+            for i, op in enumerate(m.distinct_ops):
+                nxt = st.step(op)
+                if is_inconsistent(nxt):
+                    assert m.table[s, i] == -1
+                else:
+                    assert m.states[m.table[s, i]] == nxt
+        assert reach.check_packed(model, sub)["valid"] is True
+
+    def test_check_many_seeds_one_union_bfs(self):
+        """check_many over uniform keys must run ONE BFS (the union
+        seed), not one per key."""
+        import jepsen_tpu.models.memo as memo_mod
+        model = fixtures.model_for("cas")
+        packs = [pack(fixtures.gen_history("cas", n_ops=40, processes=3,
+                                           seed=s)) for s in range(24)]
+        reach._MEMO_CACHE.clear()
+        reach._SUPERSET_SEEDS.clear()
+        calls = []
+        orig = memo_mod.memo_ops
+
+        def counting(*a, **kw):
+            calls.append(1)
+            return orig(*a, **kw)
+
+        try:
+            memo_mod.memo_ops = counting
+            reach.memo_ops = counting
+            res = reach.check_many(model, packs)
+        finally:
+            memo_mod.memo_ops = orig
+            reach.memo_ops = orig
+        assert all(r["valid"] is True for r in res)
+        assert len(calls) <= 2, f"{len(calls)} BFS runs for 24 keys"
