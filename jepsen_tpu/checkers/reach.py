@@ -475,10 +475,26 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
         # different SUBSET of one underlying alphabet (a 100-op cas
         # history hits ~30 of 36 possible ops), so exact-signature
         # lookups almost always miss across keys. check_many seeds the
-        # union-alphabet memo up front for precisely this hit.
+        # union-alphabet memo up front for precisely this hit. The
+        # projection is ALSO inserted into the exact cache (canonical
+        # order) so repeated checks over the same alphabet — the online
+        # monitor's flushes, competition re-runs — go back to dict hits.
         m2 = _project_from_seeds(model, keys, max_states,
                                  packed.distinct_ops)
         if m2 is not None:
+            inv_lut = np.empty(len(keys), np.int32)
+            for col, i in enumerate(order):
+                inv_lut[col] = i
+            canon = Memo(
+                table=np.ascontiguousarray(m2.table[:, inv_lut]),
+                states=m2.states,
+                distinct_ops=tuple(packed.distinct_ops[i]
+                                   for i in order),
+                initial=m2.initial)
+            with _MEMO_CACHE_LOCK:
+                if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
+                    _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)), None)
+                _MEMO_CACHE[sig] = canon
             return m2
         canonical_ops = tuple(packed.distinct_ops[i] for i in order)
         m = memo_ops(model, canonical_ops, max_states=max_states)
@@ -538,14 +554,15 @@ def _project_from_seeds(model: Model, keys: Sequence[Any],
                 fresh = nxt[~reach_mask[nxt]]
                 reach_mask[fresh] = True
                 frontier = fresh
-            keep = np.nonzero(reach_mask)[0]            # sorted, 0 first
+            keep = np.nonzero(reach_mask)[0]            # sorted
             new_id = np.full(m2.n_states + 1, -1, np.int32)
             new_id[keep] = np.arange(len(keep), dtype=np.int32)
             Tk = T[keep]
             Tk = np.where(Tk >= 0, new_id[Tk], -1)
             return Memo(table=np.ascontiguousarray(Tk),
                         states=tuple(m2.states[i] for i in keep),
-                        distinct_ops=distinct_ops, initial=0)
+                        distinct_ops=distinct_ops,
+                        initial=int(new_id[m2.initial]))
     return None
 
 

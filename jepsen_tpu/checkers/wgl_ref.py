@@ -108,7 +108,10 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     seen: Set[Tuple[int, int]] = {(0, 0)}
     explored = 0
     best_cover = 0
-    best_config: Tuple[int, int] = (0, 0)
+    # every configuration reaching the deepest ok-coverage (capped 16);
+    # expand() always runs on (0, 0) first, so the initial config is
+    # captured without a placeholder
+    best_configs: List[Tuple[int, int]] = []
     full = (1 << n) - 1
     found: List[Any] = []
 
@@ -116,11 +119,15 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         """Candidate successors of a configuration: unlinearized i in
         invocation order while inv[i] < min ret over unlinearized j < i
         (scan order)."""
-        nonlocal explored, best_cover, best_config
+        nonlocal explored, best_cover
         explored += 1
         cover = (mask & ok_mask).bit_count()
-        if cover > best_cover:
-            best_cover, best_config = cover, (sid, mask)
+        if cover > best_cover or not best_configs:
+            best_cover = cover
+            best_configs.clear()
+            best_configs.append((sid, mask))
+        elif cover == best_cover and len(best_configs) < 16:
+            best_configs.append((sid, mask))
         out: List[Tuple[int, int]] = []
         m = INF
         rest = full & ~mask
@@ -190,12 +197,28 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                 "states-materialized": len(states)}
 
     # exhausted: non-linearizable. Report the first ok op that the deepest
-    # configuration could not linearize.
-    sid, mask = best_config
+    # configuration could not linearize, plus the deepest configurations
+    # themselves (knossos's :final-paths analogue: model state + the
+    # linearized ops CONCURRENT with the stuck op — the same
+    # pending-window scope the device engines decode).
+    sid, mask = best_configs[0] if best_configs else (0, 0)
     stuck = _lowest_bit(ok_mask & ~mask)
     op = packed.entries[stuck].op.to_dict() if stuck >= 0 else None
+    final = []
+    for s2, m2 in best_configs:
+        if stuck >= 0:
+            lin = [str(packed.entries[i].op) for i in range(n)
+                   if (m2 >> i) & 1 and ret_ev[i] > inv[stuck]]
+        else:
+            lin = []
+        if not lin:             # fully-sequential window: show the tail
+            lin = [str(packed.entries[i].op)
+                   for i in range(n) if (m2 >> i) & 1][-8:]
+        final.append({"model": repr(states[s2]),
+                      "linearized-pending": lin})
     return {"valid": False, "op": op, "max-linearized": best_cover,
             "configs-explored": explored,
+            "final-configs": final,
             "final-state": repr(states[sid])}
 
 

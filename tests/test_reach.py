@@ -296,7 +296,12 @@ class TestSupersetSeeds:
         reach._seed_union_memo(model, [full], 100_000)
         assert len(reach._SUPERSET_SEEDS) == 1
         m = reach._cached_memo(model, sub, 100_000)
-        assert len(reach._MEMO_CACHE) == 0        # served by the seed
+        # served by the seed, and the projection is interned for exact
+        # hits on repeat lookups
+        assert len(reach._MEMO_CACHE) == 1
+        m_again = reach._cached_memo(model, sub, 100_000)
+        assert len(reach._MEMO_CACHE) == 1
+        assert np.array_equal(m_again.table, m.table)
         # the projection restricts to subset-reachable states: S (and
         # so S_pad and every capacity gate) must match a fresh BFS
         from jepsen_tpu.models.memo import memo_ops

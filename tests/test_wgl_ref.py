@@ -184,3 +184,17 @@ class TestDifferentialVsBrute:
                                  [o.to_dict() for o in h])
             checked += 1
         assert checked == 120
+
+
+def test_invalid_carries_final_configs():
+    """The oracle's invalid verdicts carry knossos-style evidence: the
+    deepest configurations (model state + recently linearized ops)."""
+    from jepsen_tpu import fixtures
+    h = fixtures.corrupt(
+        fixtures.gen_history("cas", n_ops=40, processes=3, seed=5), seed=5)
+    res = wgl_ref.check(fixtures.model_for("cas"), h)
+    assert res["valid"] is False
+    assert res["op"]
+    cfgs = res["final-configs"]
+    assert cfgs and all("model" in c and "linearized-pending" in c
+                        for c in cfgs)
