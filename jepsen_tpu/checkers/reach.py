@@ -491,21 +491,11 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
                 distinct_ops=tuple(packed.distinct_ops[i]
                                    for i in order),
                 initial=m2.initial)
-            with _MEMO_CACHE_LOCK:
-                if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
-                    _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)), None)
-                _MEMO_CACHE[sig] = canon
+            _cache_put(sig, canon)
             return m2
         canonical_ops = tuple(packed.distinct_ops[i] for i in order)
         m = memo_ops(model, canonical_ops, max_states=max_states)
-        if (m.table.nbytes <= _MEMO_CACHE_MAX_ENTRY_BYTES
-                and m.n_states <= _MEMO_CACHE_MAX_ENTRY_STATES):
-            # facade races engines on threads and the online monitor
-            # flushes from its own — guard lookup/insert/eviction
-            with _MEMO_CACHE_LOCK:
-                if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
-                    _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)), None)
-                _MEMO_CACHE[sig] = m
+        _cache_put(sig, m)
     # local op id i lives in canonical column lut[i]
     lut = np.empty(len(keys), np.int32)
     for col, i in enumerate(order):
@@ -513,6 +503,21 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
     return Memo(table=np.ascontiguousarray(m.table[:, lut]),
                 states=m.states, distinct_ops=packed.distinct_ops,
                 initial=m.initial)
+
+
+def _cache_put(sig, m: Memo) -> None:
+    """Insert into the exact-signature cache, applying the size gates
+    (big memos are cheap to rebuild relative to their footprint and are
+    not worth pinning) and the shared evict-on-full policy. The facade
+    races engines on threads and the online monitor flushes from its
+    own — lookup/insert/eviction stay lock-guarded."""
+    if (m.table.nbytes > _MEMO_CACHE_MAX_ENTRY_BYTES
+            or m.n_states > _MEMO_CACHE_MAX_ENTRY_STATES):
+        return
+    with _MEMO_CACHE_LOCK:
+        if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
+            _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)), None)
+        _MEMO_CACHE[sig] = m
 
 
 # superset seeds: a few union-alphabet memos with precomputed

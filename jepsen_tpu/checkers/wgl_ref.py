@@ -206,9 +206,13 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     op = packed.entries[stuck].op.to_dict() if stuck >= 0 else None
     final = []
     for s2, m2 in best_configs:
-        if stuck >= 0:
+        # window relative to each config's OWN stuck op (tied configs
+        # may be stuck on different ops)
+        stuck2 = _lowest_bit(ok_mask & ~m2)
+        if stuck2 >= 0:
             lin = [str(packed.entries[i].op) for i in range(n)
-                   if (m2 >> i) & 1 and ret_ev[i] > inv[stuck]]
+                   if (m2 >> i) & 1 and i != stuck2
+                   and ret_ev[i] > inv[stuck2]]
         else:
             lin = []
         if not lin:             # fully-sequential window: show the tail

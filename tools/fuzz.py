@@ -164,8 +164,13 @@ def run_many(n: int, seed: int, *, pallas: bool = False,
                                "params": params, "verdicts": verdicts})
             print(f"MISMATCH trial {t}: {params} seed={trial_seed} "
                   f"-> {verdicts}", file=sys.stderr)
-        elif verbose and t % 50 == 0:
-            print(f"{t}/{n} ok ({time.monotonic() - t0:.0f}s)")
+        elif t % 25 == 24:
+            # checkpoint progress unconditionally: XLA-CPU's JIT
+            # intermittently dies of "LLVM compilation error: Cannot
+            # allocate memory" on long runs, and a crash at trial N
+            # must not erase the N-1 clean results
+            print(f"progress {t + 1}/{n} ok, {invalid_seen} invalid "
+                  f"({time.monotonic() - t0:.0f}s)", flush=True)
     return mismatches, invalid_seen
 
 
