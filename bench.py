@@ -70,7 +70,7 @@ def kernel_probe(model, packed) -> dict:
     # it, so treat small-size figures as put + 1 RTT.
     t0 = time.monotonic()
     args = jax.device_put(host_args)
-    _ = np.asarray(args[3])
+    _ = np.asarray(args[-1])             # R0, the smallest whole operand
     transfer_s = time.monotonic() - t0   # compilation to warm, 1 RTT in
     t0 = time.monotonic()
     _ = np.asarray(run(*args)[1])
@@ -81,9 +81,12 @@ def kernel_probe(model, packed) -> dict:
     _ = np.asarray(outs[-1][1])
     many_s = time.monotonic() - t0
     kernel_s = max(0.0, (many_s - one_s) / (K - 1))
-    # FLOPs: n_pass fire matmuls [M,S]@[S,W*S] per return (the VPU
+    # FLOPs: min(c_r, n_pass) fire matmuls [M,S]@[S,W*S] per return —
+    # the gate ladder executes exactly the pending-count bound (the VPU
     # reshuffles and projection move bytes, not FLOPs)
-    flops = 2.0 * M * S * W * S * n_pass * R_real
+    executed = np.minimum(
+        (rs.slot_ops >= 0).sum(axis=1), n_pass).sum()
+    flops = 2.0 * M * S * W * S * float(executed)
     return {
         "kernel_s": round(kernel_s, 4),
         "kernel_ns_per_return": round(kernel_s / max(R_real, 1) * 1e9),

@@ -1,56 +1,46 @@
-"""Second-generation Pallas TPU kernel for the dense-reachability
+"""Third-generation Pallas TPU kernel for the dense-reachability
 returns walk — the single-history hot path.
 
-The first kernel (:mod:`.reach_pallas`, kept for the keyed batch path)
-measured ~1.28 µs/return at the headline config (S=8 states, W=5 slots,
-M=32 masks). An on-device ablation broke that down to ~600 ns of
-fixpoint ``while_loop`` machinery (loop carry + two popcounts per
-return), ~330 ns of per-return transition gather, ~140 ns of per-return
-death checking — and only ~180 ns per actual fire pass. Three design
-changes remove the overheads while keeping the engine exact:
+Generation history (all measured on one v5-lite chip at the headline
+config: S=8 states, W=5 slots, M=32 masks, cas-100k = 73.7k returns):
 
-- **unconditional passes + sound rescue, no fixpoint loop.** Mosaic
-  data-dependent control flow is brutally expensive here: a
-  ``while_loop`` costs ~600 ns/return just to evaluate, and a taken
-  ``pl.when`` tail ~1 µs (pipeline disruption), so the kernel runs a
-  FIXED number of Jacobi fire passes with no convergence check at all.
-  A fire chain sets at least one new bit per pass, so ``W`` passes
-  always reach the between-returns fixpoint; the fast kernel runs
-  ``min(W, 5)`` passes — exact outright for the common ``W ≤ 5``.
-  Beyond that, running fewer than ``W`` passes can only
-  UNDER-approximate the config set, and both firing and projection are
-  monotone, so a non-empty final set under the fast kernel still
-  certifies the exact verdict "linearizable"; only when its set
-  empties does the exact ``W``-pass kernel re-walk the history to
-  decide for real. (Headline-config measurements: 96.3% of returns
-  reach fixpoint in 2 passes, 99.5% in 3 — but the straggler rate is
-  high enough that benchmark histories routinely NEED pass 5, so a
-  lower fast-pass count just pays for both walks.)
-- **software-pipelined transition gather.** The per-return fire operand
-  ``G_all = concat(P[slot_ops[r]])`` does not depend on the config
-  set, so iteration ``k`` gathers ``G_all`` for return ``k+1`` into a
-  double-buffered VMEM scratch while the MXU chain for return ``k`` is
-  in flight (measured: −210 ns/return).
-- **no per-return death check.** Emptiness is monotone under both
-  firing and projection, so the kernel only snapshots the config set
-  at each 1024-return block boundary (streamed out) plus the final
-  set. The verdict needs one fetch of the final set; on the rare dead
-  history the host locates the first empty checkpoint and re-walks
-  that single block with the exact XLA walk
-  (:func:`jepsen_tpu.checkers.reach._walk_returns`) to recover the
-  exact knossos-style failing return.
+- gen 1 (:mod:`.reach_pallas`): 2 unrolled passes + fixpoint
+  ``while_loop`` — ~1.28 µs/return (~600 ns was while machinery).
+- gen 2 (round 2 of this module): 5 UNCONDITIONAL Jacobi fire passes
+  (no data-dependent control flow at all), software-pipelined
+  transition gather, block-checkpoint death detection —
+  ~0.96-1.19 µs/return.
+- gen 3 (this round): the **pending-count gate ladder**
+  (:func:`_ladder_fire`). Between returns, a fire chain linearizes
+  DISTINCT pending slots, so chains are ≤ c_r (the pending count at
+  return r) long and c_r monotone passes reach the closure exactly.
+  c_r is host-known: the kernel runs 1 unconditional pass plus passes
+  2..n_pass each under ``pl.when(c_r > passes_so_far)`` — executing
+  exactly ``min(c_r, n_pass)`` passes per return. On benchmark
+  histories E[c_r] ≈ 3.0 vs 5, and an untaken ``pl.when`` is ~free
+  (a TAKEN when with an SMEM-scalar predicate and an R_scr-only body
+  measured ~tens of ns — NOT the ~1.3 µs of the round-2 ablation's
+  mid-pipeline data-dependent tail). Measured: **~0.74 µs/return
+  exact** (54 ms kernel-only at cas-100k, vs the C++ WGL engine's
+  74-190 ms band), with a 2× return-loop unroll worth ~10% more.
 
-Layout note: the config set stays in the first kernel's ``[M, S]``
-orientation (pending-set masks on sublanes, states on lanes). A
-transposed one-tile ``[S, M]`` layout with lane-roll mask updates
-measured WORSE (~400 ns per ``pltpu.roll``-based projection vs ~30 ns
-for the sublane reshape/stack blend; tall-LHS matmuls against a
-VMEM-resident ``P_all`` cost ~500 ns per pass vs ~180 ns here), and a
-streamed pre-gathered ``[B, W·S, S]`` operand lane-pads 16× and blows
-VMEM. Measured per-return cost at the headline config: ~1.07-1.19 µs
-for the exact 5-pass walk (vs 1.28 µs for the first kernel's
-2-pass-plus-while structure), ~760 ns for a 4-pass walk (usable only
-as the sound fast path when W > 5).
+Round-3 ablations that LOST (kept in ``tools/ablate_lane.py``):
+counts-semantics passes (drop the >0.5 compare+cast for adds,
++15-20%), projection as a gathered [M,M]@[M,S] matmul (+20%), a
+pre-gathered HBM-streamed G operand replacing the in-kernel gather
+(+15%), alternating-direction Gauss-Seidel sweeps at reduced pass
+counts (the under-approximation dies on benchmark histories, paying
+for both walks — confirming the round-2 finding that pass-count cuts
+without the c_r bound don't survive).
+
+Other structure is unchanged from gen 2: software-pipelined gather,
+no per-return death check (block checkpoints + host refinement), the
+``[M, S]`` layout (the transposed ``[S, M]``/lane-roll layout and
+streamed operands measured worse — see the round-2 notes in git
+history). For ``W > 5`` the fast walk caps the ladder at 5 passes
+(sound: under-approximation + monotone emptiness ⇒ a surviving final
+set still certifies "linearizable"); death rescues with the exact
+``n_pass = W`` ladder.
 
 Semantics are identical to ``reach._walk_returns`` (upstream analogue:
 ``knossos/src/knossos/linear.clj``'s per-event config-set advance);
@@ -87,16 +77,53 @@ def _project(R, j, W: int, M: int, S: int):
     return acc
 
 
+def _ladder_fire(R_scr, R, pend_c, G_all, n_pass: int, W: int, M: int,
+                 S: int):
+    """Closure passes with the pending-count gate ladder: ONE
+    unconditional fire pass, then passes 2..n_pass each under
+    ``pl.when(pending_count > passes_so_far)``.
+
+    Exactness: between returns, a fire chain sets one mask bit of a
+    distinct pending slot per step, so chains are at most ``c_r`` (the
+    pending count at return r) long and ``c_r`` monotone passes reach
+    the closure. The ladder therefore executes exactly
+    ``min(c_r, n_pass)`` passes — the full closure whenever
+    ``n_pass >= W >= c_r``. On the cas-100k benchmark E[c_r] ≈ 3.0
+    vs the round-2 kernel's 5 unconditional passes, and the untaken
+    ``pl.when`` is ~free (measured: the ladder is ~30% faster
+    end-to-end; a TAKEN when costs only ~tens of ns here, not the
+    ~1.3 µs a mid-pipeline data-dependent tail was measured at —
+    the predicate is an SMEM scalar and the body writes only R_scr).
+
+    ``R_scr`` carries the set across gate bodies; returns the final R
+    (read back from R_scr).
+    """
+    from jax.experimental import pallas as pl
+
+    from jepsen_tpu.checkers.reach_pallas import _one_fire_pass
+
+    R = _one_fire_pass(R, G_all, W, M, S)
+    if n_pass <= 1:
+        return R
+    R_scr[:] = R
+    for off in range(1, n_pass):
+        def _deep():
+            Rd = R_scr[:]
+            R_scr[:] = _one_fire_pass(Rd, G_all, W, M, S)
+        pl.when(pend_c > off)(_deep)
+    return R_scr[:]
+
+
 def _make_kernel(B: int, W: int, M: int, S: int, O1: int,
-                 n_blocks: int, n_pass: int):
+                 n_blocks: int, n_pass: int, unroll: int):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    from jepsen_tpu.checkers.reach_pallas import _gather_G, _one_fire_pass
+    from jepsen_tpu.checkers.reach_pallas import _gather_G
 
-    def kernel(ret_slot_ref, slot_ops_ref, P_ref, R0_ref, ckpt_ref,
-               final_ref, R_scr, G_scr):
+    def kernel(ret_slot_ref, slot_ops_ref, pend_ref, P_ref, R0_ref,
+               ckpt_ref, final_ref, R_scr, G_scr):
         step = pl.program_id(0)
 
         @pl.when(step == 0)
@@ -106,20 +133,25 @@ def _make_kernel(B: int, W: int, M: int, S: int, O1: int,
         ckpt_ref[0] = R_scr[:]                   # set at block START
         G_scr[0] = _gather_G(slot_ops_ref, P_ref, 0, W, O1)
 
-        def do_return(k, _):
+        def one(k, R):
             j = ret_slot_ref[k]
             G_all = G_scr[k % 2]
             # prefetch the NEXT return's fire operand while this
             # return's MXU chain is in flight (G does not depend on R)
             kn = jnp.minimum(k + 1, B - 1)
             G_scr[(k + 1) % 2] = _gather_G(slot_ops_ref, P_ref, kn, W, O1)
+            R = _ladder_fire(R_scr, R, pend_ref[k], G_all, n_pass,
+                             W, M, S)
+            return _project(R, j, W, M, S)
+
+        def do_return(i, _):
             R = R_scr[:]
-            for _p in range(n_pass):
-                R = _one_fire_pass(R, G_all, W, M, S)
-            R_scr[:] = _project(R, j, W, M, S)
+            for u in range(unroll):
+                R = one(i * unroll + u, R)
+            R_scr[:] = R
             return 0
 
-        jax.lax.fori_loop(0, B, do_return, 0)
+        jax.lax.fori_loop(0, B // unroll, do_return, 0)
 
         @pl.when(step == n_blocks - 1)
         def _finish():
@@ -137,7 +169,8 @@ def _lane_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
     from jax.experimental.pallas import tpu as pltpu
 
     n_blocks = R_pad // B
-    kernel = _make_kernel(B, W, M, S, O1, n_blocks, n_pass)
+    unroll = 2 if B % 2 == 0 else 1
+    kernel = _make_kernel(B, W, M, S, O1, n_blocks, n_pass, unroll)
     call = pl.pallas_call(
         kernel,
         grid=(n_blocks,),
@@ -145,6 +178,7 @@ def _lane_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
             pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
             pl.BlockSpec((B * W,), lambda i: (i,),
                          memory_space=pltpu.SMEM),
+            pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
             pl.BlockSpec((O1, S, S), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((M, S), lambda i: (0, 0),
@@ -167,22 +201,22 @@ def _lane_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
         interpret=interpret,
     )
 
-    def run(ret_slot, slot_ops, P, R0):
+    def run(ret_slot, slot_ops, pend, P, R0):
         return call(ret_slot.astype(jnp.int32),
-                    slot_ops.astype(jnp.int32), P, R0)
+                    slot_ops.astype(jnp.int32),
+                    pend.astype(jnp.int32), P, R0)
 
     return jax.jit(run)
 
 
 # -- keyed batch: many independent keys in one kernel ------------------------
 #
-# The per-key (`jepsen.independent`) hot path, upgraded from the first
-# kernel's structure the same way as the single-history walk: W
-# unconditional fire passes (exact, no fixpoint while_loop or popcounts)
-# and the software-pipelined gather. The per-return death check stays —
-# per-key exact dead indices are the kernel's output — as do the
-# key-boundary config-set resets (untaken pl.when is ~free; the reset
-# fires once per key).
+# The per-key (`jepsen.independent`) hot path, with the same
+# pending-count gate ladder as the single-history walk (exact
+# min(c_r, n_pass) passes per return) and the software-pipelined
+# gather. The per-return death check stays — per-key exact dead
+# indices are the kernel's output — as do the key-boundary config-set
+# resets (untaken pl.when is ~free; the reset fires once per key).
 
 def _make_keyed_kernel(B: int, W: int, M: int, S: int, O1: int,
                        K: int, n_pass: int):
@@ -190,9 +224,9 @@ def _make_keyed_kernel(B: int, W: int, M: int, S: int, O1: int,
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    from jepsen_tpu.checkers.reach_pallas import _gather_G, _one_fire_pass
+    from jepsen_tpu.checkers.reach_pallas import _gather_G
 
-    def kernel(ret_slot_ref, slot_ops_ref, key_ref, P_ref,
+    def kernel(ret_slot_ref, slot_ops_ref, pend_ref, key_ref, P_ref,
                dead_ref, R_scr, G_scr, prev_scr):
         step = pl.program_id(0)
 
@@ -225,9 +259,8 @@ def _make_keyed_kernel(B: int, W: int, M: int, S: int, O1: int,
             G_all = G_scr[b % 2]
             bn = jnp.minimum(b + 1, B - 1)
             G_scr[(b + 1) % 2] = _gather_G(slot_ops_ref, P_ref, bn, W, O1)
-            R = R_scr[:]
-            for _p in range(n_pass):
-                R = _one_fire_pass(R, G_all, W, M, S)
+            R = _ladder_fire(R_scr, R_scr[:], pend_ref[b], G_all,
+                             n_pass, W, M, S)
             R = _project(R, j, W, M, S)
             kk = jnp.maximum(key, 0)
 
@@ -262,6 +295,7 @@ def _keyed_call(B: int, W: int, M: int, S: int, O1: int, N_pad: int,
             pl.BlockSpec((B * W,), lambda i: (i,),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
             pl.BlockSpec((O1, S, S), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
@@ -280,9 +314,10 @@ def _keyed_call(B: int, W: int, M: int, S: int, O1: int, N_pad: int,
         interpret=interpret,
     )
 
-    def run(ret_slot, slot_ops, key_id, P):
+    def run(ret_slot, slot_ops, pend, key_id, P):
         return call(ret_slot.astype(jnp.int32),
                     slot_ops.astype(jnp.int32),
+                    pend.astype(jnp.int32),
                     key_id.astype(jnp.int32), P)
 
     return jax.jit(run)
@@ -312,9 +347,12 @@ def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
         key_id = np.pad(key_id, (0, N_pad - N), constant_values=-1)
     run = _keyed_call(B, W, M, S, O1, N_pad, K_pad, W, interpret)
     idx_dt = np.int16 if O1 <= np.iinfo(np.int16).max else np.int32
+    pend = (slot_ops >= 0).sum(axis=1)
+    pend_dt = np.int8 if W <= 127 else np.int16
     args = jax.device_put((
         np.ascontiguousarray(ret_slot, np.int8),
         np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
+        np.ascontiguousarray(pend, pend_dt),
         np.ascontiguousarray(key_id, np.int32),
         np.ascontiguousarray(P, np.float32)))
     (dead,) = run(*args)
@@ -369,8 +407,13 @@ def pack_operands(P: np.ndarray, ret_slot: np.ndarray,
         slot_ops = np.pad(slot_ops, ((0, R_pad - R_real), (0, 0)),
                           constant_values=-1)
     idx_dt = np.int16 if O1 <= np.iinfo(np.int16).max else np.int32
+    # pending count per return: the gate ladder's exact per-return pass
+    # bound (fire chains set distinct pending slots, so c_r passes close)
+    pend = (slot_ops >= 0).sum(axis=1)
+    pend_dt = np.int8 if W <= 127 else np.int16
     host_args = (np.ascontiguousarray(ret_slot, np.int8),
                  np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
+                 np.ascontiguousarray(pend, pend_dt),
                  np.ascontiguousarray(P, np.float32),
                  np.ascontiguousarray(R0_sm.T, np.float32))
     geom = (B, W, M, S, O1, R_pad)
@@ -399,19 +442,27 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
     B, W, M, S, O1, R_pad = geom
     n_fast = min(W, _FAST_PASSES)
     run = _lane_call(B, W, M, S, O1, R_pad, n_fast, interpret)
-    ckpt, final = run(*jax.device_put(host_args))
+    dargs = jax.device_put(host_args)            # one upload, reused
+    ckpt, final = run(*dargs)
     final_np = np.asarray(final)                 # one round-trip
     if final_np.any():
         # sound: fewer-than-W passes only UNDER-approximate the config
         # set, and emptiness is monotone, so a surviving set certifies
         # linearizability exactly
+        if n_fast < W and fetch_R:
+            # the surviving set may be an under-approximation when the
+            # ladder was capped below W; consumers of R_final (evidence
+            # decoding) get the exact set from the W-pass kernel
+            run = _lane_call(B, W, M, S, O1, R_pad, W, interpret)
+            _, final = run(*dargs)
+            final_np = np.asarray(final)
         return -1, (final_np > 0.5).T if fetch_R else None
     if n_fast < W:
         # the fast kernel's verdict may be a false death: decide with
         # the exact W-pass kernel (rare — invalid histories and the
         # occasional deep-chain-dependent valid one)
         run = _lane_call(B, W, M, S, O1, R_pad, W, interpret)
-        ckpt, final = run(*jax.device_put(host_args))
+        ckpt, final = run(*dargs)
         final_np = np.asarray(final)
         if final_np.any():
             return -1, (final_np > 0.5).T if fetch_R else None
