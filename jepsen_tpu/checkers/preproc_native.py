@@ -18,6 +18,10 @@ from jepsen_tpu.checkers._native_build import NativeLib
 _I32P = ctypes.POINTER(ctypes.c_int32)
 
 
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+
 def _declare(lib: ctypes.CDLL) -> None:
     lib.jt_assign_slots.restype = ctypes.c_int64
     lib.jt_assign_slots.argtypes = [
@@ -27,6 +31,11 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.jt_returns_view.argtypes = [
         ctypes.c_int64, _I32P, _I32P, _I32P, _I32P,
         ctypes.c_int32, _I32P, _I32P, _I32P, _I32P]
+    lib.jt_build_keyed.restype = ctypes.c_int64
+    lib.jt_build_keyed.argtypes = [
+        ctypes.c_int64, _I64P, _I32P, _I32P, _I32P, _U8P, _U8P,
+        ctypes.c_int32, ctypes.c_int32,
+        _I32P, _I32P, _I32P, _I32P, _I32P, _I32P]
 
 
 _NATIVE = NativeLib("preproc.cpp", "libjepsen_preproc.so", _declare)
@@ -81,3 +90,39 @@ def returns_view(kind: np.ndarray, slot: np.ndarray, opid: np.ndarray,
         max(W, 1), _p(ret_slot), _p(slot_ops), _p(ret_event),
         _p(ret_entry)))
     return ret_slot[:R], slot_ops[:R], ret_event[:R], ret_entry[:R], R
+
+
+def build_keyed(entry_off: np.ndarray, inv_rank: np.ndarray,
+                ret_rank: np.ndarray, opid: np.ndarray,
+                crashed: np.ndarray, noop_op: np.ndarray,
+                max_slots: int, w_cap: int):
+    """Batched per-key event building (``jt_build_keyed``): one native
+    call builds every key's slotted return stream into flat arrays.
+    Returns ``(ret_slot, slot_ops[:, :w_cap], pend, key_W, key_R,
+    ret_entry, R_total)`` or None when the native lib is unavailable —
+    callers fall back to the per-key Python/ctypes pipeline."""
+    lib = _load()
+    if lib is None:
+        return None
+    K = len(entry_off) - 1
+    N = int(entry_off[-1])
+    entry_off = np.ascontiguousarray(entry_off, np.int64)
+    inv_rank = np.ascontiguousarray(inv_rank, np.int32)
+    ret_rank = np.ascontiguousarray(ret_rank, np.int32)
+    opid = np.ascontiguousarray(opid, np.int32)
+    crashed = np.ascontiguousarray(crashed, np.uint8)
+    noop_op = np.ascontiguousarray(noop_op, np.uint8)
+    ret_slot = np.empty(N, np.int32)
+    slot_ops = np.empty((N, max(w_cap, 1)), np.int32)
+    pend = np.empty(N, np.int32)
+    key_W = np.empty(K, np.int32)
+    key_R = np.empty(K, np.int32)
+    ret_entry = np.empty(N, np.int32)
+    R = int(lib.jt_build_keyed(
+        K, entry_off.ctypes.data_as(_I64P), _p(inv_rank), _p(ret_rank),
+        _p(opid), crashed.ctypes.data_as(_U8P),
+        noop_op.ctypes.data_as(_U8P), int(max_slots), int(max(w_cap, 1)),
+        _p(ret_slot), _p(slot_ops), _p(pend), _p(key_W), _p(key_R),
+        _p(ret_entry)))
+    return (ret_slot[:R], slot_ops[:R], pend[:R], key_W, key_R,
+            ret_entry[:R], R)

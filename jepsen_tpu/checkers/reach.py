@@ -461,7 +461,7 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
     permuted back to this history's local op-id order and its
     ``distinct_ops`` are THIS history's ops — callers and failure
     witnesses never see another history's op objects."""
-    keys = [(op.f, hashable(op.value)) for op in packed.distinct_ops]
+    keys = list(h.op_keys_of(packed))
     try:
         order = sorted(range(len(keys)), key=lambda i: _op_sort_key(keys[i]))
         sig = (model, max_states, tuple(keys[i] for i in order))
@@ -598,8 +598,9 @@ def _seed_union_memo(model: Model,
     union: Dict[Any, Op] = {}
     try:
         for packed in packed_list:
-            for op in packed.distinct_ops:
-                union.setdefault((op.f, hashable(op.value)), op)
+            for key, op in zip(h.op_keys_of(packed),
+                               packed.distinct_ops):
+                union.setdefault(key, op)
         keys = list(union)
         order = sorted(range(len(keys)),
                        key=lambda i: _op_sort_key(keys[i]))
@@ -884,8 +885,8 @@ def _union_alphabet(model: Model, packed_list, live, max_states: int):
     union: Dict[Any, int] = {}          # (f, hashable(value)) -> union id
     union_ops: List[Op] = []
     for i in live:
-        for op in packed_list[i].distinct_ops:
-            key = (op.f, hashable(op.value))
+        p = packed_list[i]
+        for key, op in zip(h.op_keys_of(p), p.distinct_ops):
             if key not in union:
                 union[key] = len(union_ops)
                 union_ops.append(op)
@@ -893,10 +894,9 @@ def _union_alphabet(model: Model, packed_list, live, max_states: int):
                            max_states=max_states)
     luts = {}
     for i in live:
-        ops_i = packed_list[i].distinct_ops
-        lut = np.fromiter(
-            (union[(op.f, hashable(op.value))] for op in ops_i),
-            np.int32, count=len(ops_i))
+        keys_i = h.op_keys_of(packed_list[i])
+        lut = np.fromiter((union[k] for k in keys_i),
+                          np.int32, count=len(keys_i))
         luts[i] = np.append(lut, np.int32(-1))
     return memo_u, luts
 
@@ -982,6 +982,131 @@ def _check_many_keyed(model, rss, preps, live, results, packed_list,
     return results
 
 
+def _check_many_native(model: Model,
+                       packed_list: Sequence[h.PackedHistory],
+                       max_states: int, max_slots: int, max_dense: int,
+                       t0: float) -> Optional[List[Dict[str, Any]]]:
+    """Uniform-workload fast lane for :func:`check_many`: ONE union
+    memo + ONE batched native preprocessing call
+    (``preproc_native.build_keyed``) replace the per-key
+    memo-signature/BFS-projection/event-build/ctypes pipeline that cost
+    ~2 s of host time at 4096 keys. The union alphabet serves every key
+    (per-key memos are only needed for failure witnesses, decoded
+    lazily per failed key). Returns the results list, or None to fall
+    through to the general path (native lib unavailable, union
+    explosion, kernel budgets exceeded, or too few returns to beat the
+    XLA batch). Raises :class:`~jepsen_tpu.checkers.events.ConcurrencyOverflow`
+    exactly where the per-key path would (a key needing > max_slots)."""
+    from jepsen_tpu.checkers import preproc_native, reach_pallas
+
+    if not (_use_pallas() and preproc_native.available()):
+        return None
+    live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
+    total_returns = sum(packed_list[i].n_ok for i in live)
+    if not live or total_returns < _PALLAS_MIN_RETURNS:
+        return None
+    # one memo over the union of every key's alphabet (op identities
+    # precomputed at pack time — no hashable() recomputation per key)
+    union: Dict[Any, int] = {}
+    union_ops: List[Op] = []
+    try:
+        for i in live:
+            p = packed_list[i]
+            for key, op in zip(h.op_keys_of(p), p.distinct_ops):
+                if key not in union:
+                    union[key] = len(union_ops)
+                    union_ops.append(op)
+        memo_u = _memo_for_ops(model, tuple(union_ops),
+                               max_states=max_states)
+    except (StateExplosion, TypeError):
+        return None
+    S_pad = max(2, _next_pow2(memo_u.n_states))
+    tbl = memo_u.table
+    states = np.arange(tbl.shape[0], dtype=tbl.dtype)[:, None]
+    noop_op = np.all((tbl == states) | (tbl == -1), axis=0)
+    # concatenate the keys' packed arrays, op ids remapped to union ids
+    opids, invs, rets, crs = [], [], [], []
+    offs = np.zeros(len(live) + 1, np.int64)
+    for j, i in enumerate(live):
+        p = packed_list[i]
+        keys = h.op_keys_of(p)
+        lut = np.fromiter((union[k] for k in keys), np.int32,
+                          count=len(keys))
+        opids.append(lut[p.op_id])
+        invs.append(p.inv_ev)
+        rets.append(p.ret_ev)
+        crs.append(p.crashed)
+        offs[j + 1] = offs[j] + p.n
+    opid_cat = np.concatenate(opids)
+    crs_cat = np.concatenate(crs)
+    built = preproc_native.build_keyed(
+        offs, np.concatenate(invs), np.concatenate(rets), opid_cat,
+        crs_cat, noop_op, max_slots, max_slots)
+    if built is None:
+        return None
+    ret_flat, ops_wide, pend, key_W, key_R, ret_entry_flat, R_tot = built
+    if (key_W < 0).any():
+        raise ev.ConcurrencyOverflow(
+            f"history needs >{max_slots} pending-op slots")
+    W = max(int(key_W.max()), 1)
+    M = 1 << W
+    if not (_fast_ok(S_pad, W, M, memo_u.n_ops)
+            and _pallas_fits(S_pad, M, memo_u.n_ops)):
+        return None                     # general path may still fit
+    ops_flat = np.ascontiguousarray(ops_wide[:, :W])
+    key_flat = np.repeat(np.arange(len(live), dtype=np.int32), key_R)
+    offsets = np.concatenate([[0], np.cumsum(key_R)])
+    P = _build_P(memo_u, S_pad)
+    try:
+        from jepsen_tpu.checkers import reach_lane
+        dead = reach_lane.walk_returns_keyed(
+            P, ret_flat, ops_flat, key_flat, len(live), M)
+    except Exception as e:                              # noqa: BLE001
+        _warn_pallas_failed(repr(e))
+        try:
+            dead = reach_pallas.walk_returns_keyed(
+                P, ret_flat, ops_flat, key_flat, len(live), M)
+        except Exception as e2:                         # noqa: BLE001
+            _warn_pallas_failed(repr(e2))
+            return None
+    elapsed = _time.monotonic() - t0
+    # per-key dropped-crashed-noop counts (vectorized over the concat;
+    # every live key has n >= 1, so reduceat segments are non-empty)
+    drop_cat = (crs_cat & noop_op[opid_cat]).astype(np.int64)
+    drop_per_key = np.add.reduceat(drop_cat, offs[:-1])
+    results: List[Optional[Dict[str, Any]]] = [
+        {"valid": True, "engine": "reach-batch", "events": 0,
+         "time-s": 0.0} if (packed_list[i].n == 0
+                            or packed_list[i].n_ok == 0) else None
+        for i in range(len(packed_list))]
+    for k, i in enumerate(live):
+        p = packed_list[i]
+        dropped = int(drop_per_key[k])
+        if int(dead[k]) < 0:
+            results[i] = {
+                "valid": True, "engine": "reach-keyed",
+                "events": (p.n - dropped) + int(key_R[k]),
+                "slots": int(key_W[k]), "states": memo_u.n_states,
+                "dropped-crashed-noops": dropped, "time-s": elapsed}
+        else:
+            # rare: decode the failure in the key's LOCAL geometry with
+            # the full per-key pipeline (same return ordering — drops
+            # only remove crashed entries, which never return)
+            local = int(dead[k]) - int(offsets[k])
+            memo_k, stream_k, _Tk, S_k, M_k = _prep(
+                model, p, max_states=max_states, max_slots=max_slots,
+                max_dense=max_dense)
+            rs_k = ev.returns_view(stream_k)
+            W_k = max(stream_k.W, 1)
+            results[i] = _result_invalid(
+                "reach-keyed", stream_k, memo_k, p,
+                int(rs_k.ret_event[local]), elapsed)
+            _attach_witness(results[i], memo_k, rs_k,
+                            _build_P(memo_k, S_k), S_k, M_k, W_k,
+                            local, p)
+    return results  # type: ignore[return-value]
+
+
 def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                max_states: int = 100_000, max_slots: int = 20,
                max_dense: int = 1 << 22,
@@ -998,6 +1123,13 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
+    if devices is None or len(devices) <= 1:
+        out = _check_many_native(model, packed_list,
+                                 max_states=max_states,
+                                 max_slots=max_slots,
+                                 max_dense=max_dense, t0=t0)
+        if out is not None:
+            return out
     _seed_union_memo(model, [p for p in packed_list
                              if p.n and p.n_ok], max_states)
     preps = []

@@ -159,6 +159,11 @@ class PackedHistory:
     inf_ev: int
     distinct_ops: Tuple[Op, ...]
     entries: Tuple[Entry, ...]
+    # hashable (f, value) identity per distinct op, aligned with
+    # ``distinct_ops`` — precomputed at pack time so the per-key batch
+    # checkers (union-alphabet mapping, memo-cache signatures) never
+    # recompute ``hashable`` over thousands of keys' op values
+    op_keys: Tuple[Any, ...] = ()
 
     @property
     def n_ok(self) -> int:
@@ -195,7 +200,17 @@ def pack_entries(entries: Sequence[Entry]) -> PackedHistory:
         op_id[i] = distinct[key]
     return PackedHistory(
         n=n, inv_ev=inv_ev, ret_ev=ret_ev, op_id=op_id, crashed=crashed,
-        inf_ev=int(inf_ev), distinct_ops=tuple(ops), entries=tuple(entries))
+        inf_ev=int(inf_ev), distinct_ops=tuple(ops), entries=tuple(entries),
+        op_keys=tuple(distinct))
+
+
+def op_keys_of(packed: PackedHistory) -> Tuple[Any, ...]:
+    """The hashable distinct-op identities of ``packed``, from the
+    pack-time cache when present (PackedHistory instances built by
+    other constructors may lack it)."""
+    if len(packed.op_keys) == len(packed.distinct_ops):
+        return packed.op_keys
+    return tuple((op.f, hashable(op.value)) for op in packed.distinct_ops)
 
 
 # -- serialization -----------------------------------------------------------

@@ -1,0 +1,158 @@
+"""Tests for the batched native keyed preprocessing
+(``native/preproc.cpp jt_build_keyed`` + ``reach._check_many_native``):
+the round-3 fast lane that replaces the per-key memo/event pipeline
+with one union memo and one native call.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures, models
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers import events as ev
+from jepsen_tpu.checkers import preproc_native, reach, reach_lane, \
+    reach_pallas
+from jepsen_tpu.history import pack
+
+pytestmark = pytest.mark.skipif(not preproc_native.available(),
+                                reason="native preproc unavailable")
+
+
+def _rand_packs(n_keys, seed0=0, crash_p=0.0, corrupt_every=0):
+    packs = []
+    for s in range(n_keys):
+        hist = fixtures.gen_history(
+            "cas", n_ops=20 + (s * 7) % 40, processes=2 + s % 3,
+            crash_p=crash_p, seed=seed0 + s)
+        if corrupt_every and s % corrupt_every == 1:
+            try:
+                hist = fixtures.corrupt(hist, seed=s)
+            except ValueError:
+                pass
+        packs.append(pack(hist))
+    return packs
+
+
+def _union_build(model, packs, max_slots=20):
+    """Run the native batched builder over ``packs`` (union alphabet),
+    returning its flat outputs plus the union lut per key."""
+    union, union_ops = {}, []
+    for p in packs:
+        for key, op in zip(h.op_keys_of(p), p.distinct_ops):
+            if key not in union:
+                union[key] = len(union_ops)
+                union_ops.append(op)
+    memo_u = reach._memo_for_ops(model, tuple(union_ops),
+                                 max_states=100_000)
+    tbl = memo_u.table
+    states = np.arange(tbl.shape[0], dtype=tbl.dtype)[:, None]
+    noop_op = np.all((tbl == states) | (tbl == -1), axis=0)
+    offs = np.zeros(len(packs) + 1, np.int64)
+    opids, invs, rets, crs = [], [], [], []
+    luts = []
+    for j, p in enumerate(packs):
+        lut = np.fromiter((union[k] for k in h.op_keys_of(p)),
+                          np.int32, count=len(p.distinct_ops))
+        luts.append(lut)
+        opids.append(lut[p.op_id])
+        invs.append(p.inv_ev)
+        rets.append(p.ret_ev)
+        crs.append(p.crashed)
+        offs[j + 1] = offs[j] + p.n
+    built = preproc_native.build_keyed(
+        offs, np.concatenate(invs), np.concatenate(rets),
+        np.concatenate(opids), np.concatenate(crs), noop_op,
+        max_slots, max_slots)
+    return built, memo_u, luts
+
+
+def test_build_keyed_matches_per_key_pipeline():
+    """The one-call native builder must produce, key for key, the same
+    slotted return stream as the per-key events.build + returns_view
+    pipeline (mapped into the union alphabet)."""
+    model = models.cas_register()
+    packs = _rand_packs(17, crash_p=0.08)
+    built, memo_u, luts = _union_build(model, packs)
+    assert built is not None
+    ret_slot, slot_ops, pend, key_W, key_R, ret_entry, R_tot = built
+    off = 0
+    for k, p in enumerate(packs):
+        memo_k = reach._cached_memo(model, p, 100_000)
+        stream = ev.build(p, memo_k, max_slots=20)
+        rs = ev.returns_view(stream)
+        assert int(key_W[k]) == max(stream.W, 0), f"key {k}"
+        assert int(key_R[k]) == rs.n_returns, f"key {k}"
+        sl = slice(off, off + rs.n_returns)
+        np.testing.assert_array_equal(ret_slot[sl], rs.ret_slot,
+                                      err_msg=f"key {k} ret_slot")
+        # per-key slot_ops carry local ids; map to union for comparison
+        lut_pad = np.append(luts[k], np.int32(-1))
+        W_k = rs.slot_ops.shape[1]
+        np.testing.assert_array_equal(
+            slot_ops[sl, :W_k], lut_pad[rs.slot_ops],
+            err_msg=f"key {k} slot_ops")
+        assert (slot_ops[sl, W_k:] == -1).all()
+        np.testing.assert_array_equal(
+            pend[sl], (rs.slot_ops >= 0).sum(axis=1),
+            err_msg=f"key {k} pend")
+        np.testing.assert_array_equal(ret_entry[sl], rs.ret_entry,
+                                      err_msg=f"key {k} ret_entry")
+        off += rs.n_returns
+    assert off == R_tot
+
+
+def test_build_keyed_overflow_key_flagged():
+    """A key needing more slots than max_slots comes back W = -1 and
+    contributes no returns; other keys are unaffected."""
+    model = models.cas_register()
+    packs = _rand_packs(3, seed0=5)
+    wide = pack(fixtures.gen_history("cas", n_ops=40, processes=6,
+                                     seed=99))
+    built, _, _ = _union_build(model, [packs[0], wide, packs[1]],
+                               max_slots=3)
+    ret_slot, slot_ops, pend, key_W, key_R, ret_entry, R_tot = built
+    assert key_W[1] == -1 and key_R[1] == 0
+    assert key_W[0] > 0 and key_W[2] > 0
+    assert R_tot == key_R[0] + key_R[2]
+
+
+def test_fast_lane_matches_general_path(monkeypatch):
+    """check_many through the native fast lane (forced, interpret
+    kernels) agrees verdict-for-verdict with the general path on mixed
+    valid/invalid/crashy keys, and invalid keys carry witness."""
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    monkeypatch.setattr(
+        reach_lane, "walk_returns_keyed",
+        functools.partial(reach_lane.walk_returns_keyed, interpret=True))
+    monkeypatch.setattr(
+        reach_pallas, "walk_returns_keyed",
+        functools.partial(reach_pallas.walk_returns_keyed,
+                          interpret=True))
+    model = models.cas_register()
+    packs = _rand_packs(12, crash_p=0.1, corrupt_every=4)
+    packs.insert(3, pack([]))           # empty key passthrough
+    fast = reach.check_many(model, packs)
+    assert fast[3]["valid"] is True
+    assert any(r["engine"] == "reach-keyed" for r in fast)
+    monkeypatch.setattr(reach, "_use_pallas", lambda: False)
+    ref = reach.check_many(model, packs)
+    for i, (a, b) in enumerate(zip(fast, ref)):
+        assert a["valid"] == b["valid"], f"key {i}: {a} vs {b}"
+        if a["valid"] is False:
+            assert a["op"] == b["op"], f"key {i}"
+            assert a.get("final-configs"), f"key {i} missing witness"
+
+
+def test_fast_lane_concurrency_overflow(monkeypatch):
+    """An over-wide key raises ConcurrencyOverflow from the fast lane,
+    matching the general path's behavior."""
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    model = models.cas_register()
+    packs = _rand_packs(3)
+    packs.append(pack(fixtures.gen_history("cas", n_ops=60,
+                                           processes=8, seed=7)))
+    with pytest.raises(ev.ConcurrencyOverflow):
+        reach.check_many(model, packs, max_slots=4)
