@@ -20,6 +20,7 @@ _I32P = ctypes.POINTER(ctypes.c_int32)
 
 _I64P = ctypes.POINTER(ctypes.c_int64)
 _U8P = ctypes.POINTER(ctypes.c_uint8)
+_U64P = ctypes.POINTER(ctypes.c_uint64)
 
 
 def _declare(lib: ctypes.CDLL) -> None:
@@ -36,6 +37,10 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.c_int64, _I64P, _I32P, _I32P, _I32P, _U8P, _U8P,
         ctypes.c_int32, ctypes.c_int32,
         _I32P, _I32P, _I32P, _I32P, _I32P, _I32P]
+    lib.jt_walk_dense.restype = ctypes.c_int64
+    lib.jt_walk_dense.argtypes = [
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, _I32P,
+        ctypes.c_int32, _U64P, ctypes.c_int64, _I32P, _I32P]
 
 
 _NATIVE = NativeLib("preproc.cpp", "libjepsen_preproc.so", _declare)
@@ -126,3 +131,25 @@ def build_keyed(entry_off: np.ndarray, inv_rank: np.ndarray,
         _p(ret_entry)))
     return (ret_slot[:R], slot_ops[:R], pend[:R], key_W, key_R,
             ret_entry[:R], R)
+
+
+def walk_dense(T: np.ndarray, R_words: np.ndarray, W: int,
+               ret_slot: np.ndarray, rows: np.ndarray) -> Optional[int]:
+    """Bit-packed dense returns walk (``jt_walk_dense``): ``T``
+    i32[S, O] transition table, ``R_words`` u64[S, n_words] the
+    bit-packed config set (MUTATED in place), ``rows`` i32[L, W] the
+    pending ops per return. Returns the first dead return index (-1 if
+    the set survived), or None when the native lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    S, n_ops = T.shape
+    L = len(ret_slot)
+    n_words = R_words.shape[1]
+    T = np.ascontiguousarray(T, np.int32)
+    ret_slot = np.ascontiguousarray(ret_slot, np.int32)
+    rows = np.ascontiguousarray(rows, np.int32)
+    assert R_words.dtype == np.uint64 and R_words.flags.c_contiguous
+    return int(lib.jt_walk_dense(
+        S, int(W), n_words, _p(T), n_ops,
+        R_words.ctypes.data_as(_U64P), L, _p(ret_slot), _p(rows)))

@@ -232,3 +232,58 @@ def test_long_pending_op_bounds_flush_work():
     assert eng.advance(run_over=True) is None
     ref = reach.check(fixtures.model_for("register"), h)
     assert ref["valid"] is True
+
+
+def test_native_walk_matches_numpy_reference():
+    """The bit-packed C++ walk (preproc_native.walk_dense) agrees with
+    the per-return NumPy fixpoint on random batches, including exact
+    dead indices and the final config set."""
+    import numpy as np
+
+    from jepsen_tpu.checkers import preproc_native
+    from jepsen_tpu.checkers.online import _walk_return
+
+    if not preproc_native.available():
+        import pytest
+        pytest.skip("native preproc unavailable")
+    rng = np.random.default_rng(7)
+    for trial in range(60):
+        S = int(rng.integers(2, 9))
+        # W up to 8 exercises the multi-word bitset path (M = 256 is
+        # four u64 words; slot bits 6-7 shift across word boundaries)
+        W = int(rng.integers(1, 9))
+        O = int(rng.integers(2, 6))
+        M = 1 << W
+        L = int(rng.integers(1, 40))
+        # random transition table (-1 = illegal) and random walk inputs
+        T = rng.integers(-1, S, size=(S, O)).astype(np.int32)
+        rows = rng.integers(-1, O, size=(L, W)).astype(np.int32)
+        slots = rng.integers(0, W, size=L).astype(np.int32)
+        R0 = rng.random((S, M)) < 0.3
+        R0[0, 0] = True
+        # numpy reference
+        P = np.zeros((O, S, S), bool)
+        s = np.arange(S)
+        for o in range(O):
+            okc = T[:, o] >= 0
+            P[o, s[okc], T[okc, o]] = True
+        R_ref = R0.copy()
+        dead_ref = -1
+        for i in range(L):
+            R_ref = _walk_return(R_ref, rows[i], int(slots[i]), P)
+            if not R_ref.any():
+                dead_ref = i
+                break
+        # native
+        packed8 = np.packbits(R0, axis=1, bitorder="little")
+        n_words = max(1, -(-M // 64))
+        buf = np.zeros((S, n_words * 8), np.uint8)
+        buf[:, :packed8.shape[1]] = packed8
+        R_words = np.ascontiguousarray(buf).view(np.uint64)
+        dead = preproc_native.walk_dense(T, R_words, W, slots, rows)
+        assert dead == dead_ref, f"trial {trial}: {dead} vs {dead_ref}"
+        if dead_ref < 0:
+            bits = np.unpackbits(R_words.view(np.uint8), axis=1,
+                                 bitorder="little")[:, :M].astype(bool)
+            np.testing.assert_array_equal(bits, R_ref,
+                                          err_msg=f"trial {trial}")
