@@ -85,22 +85,42 @@ class FrontierOverflow(RuntimeError):
 
 # -- device program ----------------------------------------------------------
 
-def _sort_unique_compact(U, F):
+def _sort_unique_compact(U, F, pack_bits: int = 0):
     """Dedup candidate rows ``U: u32[N, K+1]`` (invalid rows are all-ones):
-    lexicographic sort over all columns, adjacent-unique, compact the first
-    ``F`` unique rows to the front. Returns ``(C: u32[F, K+1], count)``
-    where ``count`` may exceed ``F`` (overflow — compaction drops the
-    excess, caller must re-run at a larger ``F``)."""
+    sort, adjacent-unique, compact the first ``F`` unique rows to the
+    front. Returns ``(C: u32[F, K+1], count)`` where ``count`` may exceed
+    ``F`` (overflow — compaction drops the excess, caller must re-run at
+    a larger ``F``).
+
+    With ``pack_bits = W > 0`` (feasible when ``K == 1`` and the state id
+    fits ``32 - W`` bits — the common case), each row packs into ONE u32
+    key ``(state << W) | word`` and the lexicographic multi-key sort
+    becomes a single-key sort (~2× cheaper; the all-ones sentinel wraps
+    to the all-ones key, so it still sorts last, and clearing a fixed
+    bit in every survivor subtracts the same constant from every key, so
+    :func:`_project`'s no-re-sort invariant is preserved)."""
     import jax.numpy as jnp
     from jax import lax
 
     N, K1 = U.shape
-    cols = lax.sort(tuple(U[:, i] for i in range(K1)), num_keys=K1)
-    Us = jnp.stack(cols, axis=1)                       # u32[N, K+1] sorted
-    valid = Us[:, K1 - 1] != jnp.uint32(0xFFFFFFFF)    # state col sentinel
-    differs = jnp.any(Us != jnp.roll(Us, 1, axis=0), axis=1)
-    differs = differs.at[0].set(True)
-    unique = valid & differs
+    if pack_bits and K1 == 2:
+        key = (U[:, 1] << jnp.uint32(pack_bits)) | U[:, 0]
+        ks = lax.sort(key)
+        valid = ks != jnp.uint32(0xFFFFFFFF)
+        differs = ks != jnp.roll(ks, 1)
+        differs = differs.at[0].set(True)
+        unique = valid & differs
+        word = ks & jnp.uint32((1 << pack_bits) - 1)
+        state = ks >> jnp.uint32(pack_bits)
+        Us = jnp.where(valid[:, None], jnp.stack([word, state], axis=1),
+                       jnp.uint32(0xFFFFFFFF))
+    else:
+        cols = lax.sort(tuple(U[:, i] for i in range(K1)), num_keys=K1)
+        Us = jnp.stack(cols, axis=1)                   # u32[N, K+1] sorted
+        valid = Us[:, K1 - 1] != jnp.uint32(0xFFFFFFFF)
+        differs = jnp.any(Us != jnp.roll(Us, 1, axis=0), axis=1)
+        differs = differs.at[0].set(True)
+        unique = valid & differs
     count = jnp.sum(unique.astype(jnp.int32))
     pos = jnp.cumsum(unique.astype(jnp.int32)) - 1
     pos = jnp.where(unique & (pos < F), pos, F)        # F = drop row
@@ -169,12 +189,24 @@ def _canonicalize(U, grouped, same, rank, word_idx, shift, bitmat):
 
 
 _BLOCK = 8                     # pending slots expanded per dedup round
+                               # (sharded path; the single-device walk
+                               # sizes rounds adaptively, see _round_blk)
+
+# candidate-row budget for one expand round: at small F the whole slot
+# axis fits one round — ONE dedup sort per closure pass instead of
+# ceil(W/8) — while large F keeps rounds bounded (memory ~ budget·K1·4B)
+_CAND_BUDGET = 1 << 21
+
+
+def _round_blk(F: int, W: int) -> int:
+    return max(_BLOCK, min(W, _CAND_BUDGET // max(F, 1)))
 
 
 def _expand_block(C, pending, grouped, same, rank, T_flat, bitmat,
-                  word_idx, shift, n_cols, lo, canon: bool):
+                  word_idx, shift, n_cols, lo, canon: bool,
+                  blk_size: int = _BLOCK):
     """Canonical single-fire successors of every config through pending
-    slots ``[lo, lo+_BLOCK)``: ``u32[F*_BLOCK, K+1]`` (illegal ones
+    slots ``[lo, lo+blk_size)``: ``u32[F*blk_size, K+1]`` (illegal ones
     all-ones). Live pending slots fire when their bit is clear; grouped
     (crashed) slots fire only through the group's next canonical member
     (``rank == fired-count``, computed over the FULL slot axis — groups
@@ -185,7 +217,7 @@ def _expand_block(C, pending, grouped, same, rank, T_flat, bitmat,
 
     F, K1 = C.shape
     K = K1 - 1
-    blk = slice(lo, lo + _BLOCK)
+    blk = slice(lo, lo + blk_size)
     pend_b = pending[blk]
     state = C[:, K].astype(jnp.int32)                  # -1 when invalid
     cvalid = state >= 0
@@ -210,19 +242,23 @@ def _expand_block(C, pending, grouped, same, rank, T_flat, bitmat,
 
 
 def _closure(C, pending, grouped, same, rank, T_flat, bitmat,
-             word_idx, shift, n_cols, canon: bool):
+             word_idx, shift, n_cols, canon: bool,
+             blk_size: int = _BLOCK, pack_bits: int = 0):
     """Fixpoint of fire-expansion ∪ dedup — covers every linearization
     order of any subset of pending ops (the union is monotone, so the
     unique count is stationary exactly at the fixpoint). Each pass
-    expands the slot axis in ``_BLOCK``-sized rounds, folding every round
-    into the running set with a sort over ``F·(_BLOCK+1)`` rows — bounded
-    buffers with TRUE capacity semantics: overflow is flagged only when
-    the deduplicated config count itself exceeds ``F`` (a candidate
-    buffer can never, since a round emits at most ``F·_BLOCK`` rows).
-    Chained fires missed inside a pass are caught by the outer fixpoint.
-    Termination compares only DEDUPLICATED pass counts with each other —
-    the entering set's count may be stale (canonicalization can merge
-    rows without re-deduplicating), so it must not seed the comparison."""
+    expands the slot axis in ``blk_size``-sized rounds (adaptively the
+    WHOLE axis when ``F·W`` fits the candidate budget — the dedup sort
+    is the dominant cost, and one sort of ``F·(W+1)`` rows beats
+    ``ceil(W/8)`` sorts of ``F·9``), folding every round into the
+    running set with a sort — bounded buffers with TRUE capacity
+    semantics: overflow is flagged only when the deduplicated config
+    count itself exceeds ``F`` (a candidate buffer can never, since a
+    round emits at most ``F·blk_size`` rows). Chained fires missed
+    inside a pass are caught by the outer fixpoint. Termination
+    compares only DEDUPLICATED pass counts with each other — the
+    entering set's count may be stale (canonicalization can merge rows
+    without re-deduplicating), so it must not seed the comparison."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -236,12 +272,12 @@ def _closure(C, pending, grouped, same, rank, T_flat, bitmat,
     def body(c):
         C, count, _, _ = c
         C2, count2, overflow = C, count, False
-        for lo in range(0, W, _BLOCK):
+        for lo in range(0, W, blk_size):
             cand = _expand_block(C, pending, grouped, same, rank, T_flat,
                                  bitmat, word_idx, shift, n_cols, lo,
-                                 canon)
+                                 canon, blk_size)
             U = jnp.concatenate([C2, cand], axis=0)
-            C2, count2 = _sort_unique_compact(U, F)
+            C2, count2 = _sort_unique_compact(U, F, pack_bits)
             overflow = overflow | (count2 > F)
         return C2, count2, count, overflow
 
@@ -273,14 +309,18 @@ def _project(C, count, j):
     return out, jnp.sum(keep.astype(jnp.int32))
 
 
-def _walk(T_flat, n_cols, canon, ret_slot, slot_ops, crashed_slot, bitmat,
-          word_idx, shift, C0, count0):
+def _walk(T_flat, n_cols, canon, blk_size, pack_bits,
+          ret_slot, slot_ops,
+          crashed_slot, bitmat, word_idx, shift, C0, count0):
     """Drive one segment of return events over the sparse frontier
     (callers slice the stream into fixed-size segments — bounded device
     programs keep compilations shape-stable and give the host abort/retry
     points between calls). Returns ``(r, C, count, status)``: status 1 =
     the frontier emptied at segment-local return ``r`` (violation
-    witness), 2 = capacity overflow (retry larger)."""
+    witness), 2 = capacity overflow at return ``r``. On a non-running
+    exit ``C``/``count`` are the frontier AT ENTRY of return ``r`` (one
+    [F, K+1] select per return keeps them), so an overflow resumes
+    EXACTLY at the failing return in a 4× buffer — no segment replay."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -298,13 +338,14 @@ def _walk(T_flat, n_cols, canon, ret_slot, slot_ops, crashed_slot, bitmat,
             ops_row = slot_ops[r]
             if canon:
                 grouped, same, rank = _slot_groups(ops_row, crashed_slot[r])
-                C = _canonicalize(C, grouped, same, rank, word_idx, shift,
-                                  bitmat)
+                Cc = _canonicalize(C, grouped, same, rank, word_idx, shift,
+                                   bitmat)
             else:
                 grouped = same = rank = None
+                Cc = C
             C1, count1, overflow = _closure(
-                C, ops_row, grouped, same, rank, T_flat, bitmat,
-                word_idx, shift, n_cols, canon)
+                Cc, ops_row, grouped, same, rank, T_flat, bitmat,
+                word_idx, shift, n_cols, canon, blk_size, pack_bits)
             C2, count2 = _project(C1, count1, j)
             status = jnp.where(
                 overflow, _STATUS_OVERFLOW,
@@ -314,8 +355,11 @@ def _walk(T_flat, n_cols, canon, ret_slot, slot_ops, crashed_slot, bitmat,
         def pad(C, count):
             return C, count, jnp.int32(_STATUS_RUNNING)
 
-        C, count, status = lax.cond(j >= 0, do, pad, C, count)
-        r = jnp.where(status == _STATUS_RUNNING, r + 1, r)
+        C2, count2, status = lax.cond(j >= 0, do, pad, C, count)
+        keep = status == _STATUS_RUNNING
+        C = jnp.where(keep, C2, C)
+        count = jnp.where(keep, count2, count)
+        r = jnp.where(keep, r + 1, r)
         return r, C, count, status
 
     return lax.while_loop(
@@ -326,7 +370,7 @@ def _walk(T_flat, n_cols, canon, ret_slot, slot_ops, crashed_slot, bitmat,
 @functools.lru_cache(maxsize=None)
 def _jitted_walk():
     import jax
-    return jax.jit(_walk, static_argnums=(1, 2))
+    return jax.jit(_walk, static_argnums=(1, 2, 3, 4))
 
 
 # -- host driver -------------------------------------------------------------
@@ -402,18 +446,39 @@ def _crashed_slots(stream: ev.EventStream, packed: h.PackedHistory,
     return out
 
 
-_SEG = 128                     # returns per device call: bounded kernels
-                               # (no tunnel-killing long programs), one
-                               # compilation per (W, F), host abort points
+_SEG = 2048                    # returns per device call: bounded kernels,
+                               # one compilation per (W, F), host abort
+                               # points. Big segments matter on the dev
+                               # tunnel (each host sync is a ~0.13 s
+                               # round trip); exact-resume escalation
+                               # means a large segment costs nothing
+                               # extra on overflow.
+
+
+def _seg_arrays(rs: ev.ReturnStream, crashed_slot: np.ndarray,
+                base: int):
+    """Static-shape [_SEG] segment slices starting at return ``base``
+    (identity-padded past the end) — resume points land on arbitrary
+    return indices, so slices are rebuilt host-side per dispatch."""
+    W = rs.slot_ops.shape[1]
+    ret_slot = np.full(_SEG, -1, np.int32)
+    slot_ops = np.full((_SEG, W), -1, np.int32)
+    crashed = np.zeros((_SEG, W), bool)
+    n = min(_SEG, rs.R - base)
+    ret_slot[:n] = rs.ret_slot[base:base + n]
+    slot_ops[:n] = rs.slot_ops[base:base + n]
+    crashed[:n] = crashed_slot[base:base + n]
+    return ret_slot, slot_ops, crashed, n
 
 
 def _run_walk(memo: Memo, rs: ev.ReturnStream, crashed_slot: np.ndarray,
               F: int, max_frontier: int, should_abort=None):
     """Drive the whole (padded) return stream in ``_SEG``-sized device
     calls, carrying the frontier across segments. On capacity overflow
-    only the failing segment is retried: the carried frontier is
-    re-embedded into a 4× buffer (rows are the configs, so embedding is a
-    pad). Returns ``(dead_ret, status, C, count, F)``; raises
+    the walk resumes EXACTLY at the failing return — the device carries
+    the entry frontier of the current return, so the host re-embeds it
+    into a 4× buffer and dispatches from that return (no replay).
+    Returns ``(dead_ret, status, C, count, F)``; raises
     :class:`FrontierOverflow` past ``max_frontier``."""
     import jax.numpy as jnp
 
@@ -425,6 +490,8 @@ def _run_walk(memo: Memo, rs: ev.ReturnStream, crashed_slot: np.ndarray,
     word_idx_d = jnp.asarray(word_idx)
     shift_d = jnp.asarray(shift)
     canon = bool(crashed_slot.any())
+    # single-key packed dedup when a whole row fits one u32
+    pack_bits = W if (K == 1 and S <= (1 << (32 - W)) - 1) else 0
     C = jnp.asarray(_initial_frontier(F, K, memo.initial))
     count = jnp.int32(1)
     walk = _jitted_walk()
@@ -432,10 +499,12 @@ def _run_walk(memo: Memo, rs: ev.ReturnStream, crashed_slot: np.ndarray,
     while base < rs.R:
         if should_abort is not None and should_abort():
             return -1, _STATUS_ABORT, C, count, F
-        sl = slice(base, base + _SEG)
+        ret_slot, slot_ops, crashed, n = _seg_arrays(rs, crashed_slot,
+                                                     base)
         r, C2, count2, status = walk(
-            T_flat, O, canon, jnp.asarray(rs.ret_slot[sl]),
-            jnp.asarray(rs.slot_ops[sl]), jnp.asarray(crashed_slot[sl]),
+            T_flat, O, canon, _round_blk(F, W), pack_bits,
+            jnp.asarray(ret_slot), jnp.asarray(slot_ops),
+            jnp.asarray(crashed),
             bitmat_d, word_idx_d, shift_d, C, count)
         status = int(status)
         if status == _STATUS_OVERFLOW:
@@ -443,14 +512,19 @@ def _run_walk(memo: Memo, rs: ev.ReturnStream, crashed_slot: np.ndarray,
             if F > max_frontier:
                 raise FrontierOverflow(
                     f"reachable config set exceeds {max_frontier} rows")
+            # C2 is the frontier at entry of the failing return
+            # (sorted-unique rows): sentinel-pad embeds it in the
+            # larger buffer
             C = jnp.asarray(np.pad(
-                np.asarray(C), ((0, F - np.asarray(C).shape[0]), (0, 0)),
+                np.asarray(C2), ((0, F - np.asarray(C2).shape[0]), (0, 0)),
                 constant_values=np.uint32(0xFFFFFFFF)))
-            continue                    # retry this segment, larger buffer
+            count = count2
+            base += int(r)              # resume at the failing return
+            continue
         if status != _STATUS_RUNNING:
             return base + int(r), status, C2, count2, F
         C, count = C2, count2
-        base += _SEG
+        base += n
     return rs.R, _STATUS_RUNNING, C, count, F
 
 
