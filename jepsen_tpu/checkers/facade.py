@@ -199,8 +199,18 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
         return ekw
 
     try:
-        return reach.check_packed(model, packed,
-                                  **_engine_kw(kw, _REACH_KW))
+        ekw = _engine_kw(kw, _REACH_KW)
+        if deadline is not None:
+            # the dense stage also honors the chain budget: its walk
+            # dispatches in bounded segments and turns "unknown" when
+            # the deadline passes (round-2 advisor finding)
+            user_abort = ekw.get("should_abort")
+            ekw["should_abort"] = (
+                (lambda: user_abort() or _spent())
+                if user_abort is not None else _spent)
+        res = reach.check_packed(model, packed, **ekw)
+        if res.get("valid") in (True, False):
+            return res
     except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion):
         pass
     if wgl_native.available() and not _spent():
@@ -234,7 +244,7 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
 
 # keyword subsets understood by each engine; user opts are filtered so one
 # checker config can carry opts for every algorithm it may route to.
-_REACH_KW = ("max_states", "max_slots", "max_dense")
+_REACH_KW = ("max_states", "max_slots", "max_dense", "should_abort")
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
 _FRONTIER_KW = ("max_states", "frontier0", "max_frontier", "time_limit",
                 "should_abort", "devices")
@@ -287,7 +297,11 @@ def _competition(model: Model, history: Sequence[Op],
 
     def run_tpu():
         try:
-            r = reach.check(model, history, **_engine_kw(kw, _REACH_KW))
+            # abortable: a losing device engine frees the chip within
+            # one segment instead of walking the whole history
+            ekw = _engine_kw(kw, _REACH_KW)
+            ekw["should_abort"] = ctl.should_abort
+            r = reach.check(model, history, **ekw)
             verdicts.put(("reach", r))
         except Exception as e:                          # noqa: BLE001
             verdicts.put(("reach", {"valid": "unknown", "error": str(e)}))

@@ -779,20 +779,33 @@ def _attach_witness_slow(out: Dict[str, Any], memo: Memo,
 
 def check(model: Model, history: Sequence[Op], *,
           max_states: int = 100_000, max_slots: int = 20,
-          max_dense: int = 1 << 22) -> Dict[str, Any]:
+          max_dense: int = 1 << 22,
+          should_abort=None) -> Dict[str, Any]:
     """Check one history on device. Raises :class:`DenseOverflow`,
     :class:`~jepsen_tpu.checkers.events.ConcurrencyOverflow`, or
     :class:`~jepsen_tpu.models.memo.StateExplosion` when the history does
     not fit this engine — the :func:`jepsen_tpu.checkers.linearizable`
-    facade catches these and falls back to the CPU search."""
+    facade catches these and falls back to the CPU search. With
+    ``should_abort`` the walk is dispatched in bounded segments and
+    yields ``valid == "unknown"`` when the hook fires (upstream
+    ``knossos.search`` abort semantics)."""
     packed = h.pack(history)
     return check_packed(model, packed, max_states=max_states,
-                        max_slots=max_slots, max_dense=max_dense)
+                        max_slots=max_slots, max_dense=max_dense,
+                        should_abort=should_abort)
+
+
+# XLA-walk segment size under an abort hook (the lane kernel has its
+# own, reach_lane._ABORT_SEG)
+_ABORT_SEG = 32768
+
+_ABORTED = {"valid": "unknown", "cause": "aborted", "engine": "reach"}
 
 
 def check_packed(model: Model, packed: h.PackedHistory, *,
                  max_states: int = 100_000, max_slots: int = 20,
-                 max_dense: int = 1 << 22) -> Dict[str, Any]:
+                 max_dense: int = 1 << 22,
+                 should_abort=None) -> Dict[str, Any]:
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
@@ -811,13 +824,16 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
             R0_np = np.zeros((S_pad, M), bool)
             R0_np[0, 0] = True
             dead = None
+            from jepsen_tpu.checkers import reach_lane
             try:
-                # second-generation kernel: exact fixed-W-pass walk,
-                # ~1.1 us/return at the headline config (for W > 5, a
-                # sound 5-pass walk with an exact rescue on death)
-                from jepsen_tpu.checkers import reach_lane
+                # third-generation kernel: exact gate-ladder walk (for
+                # W > 5, a sound 5-pass-capped walk with an exact
+                # rescue on death)
                 dead, _ = reach_lane.walk_returns(
-                    P_np, rs.ret_slot, rs.slot_ops, R0_np, fetch_R=False)
+                    P_np, rs.ret_slot, rs.slot_ops, R0_np, fetch_R=False,
+                    should_abort=should_abort)
+            except reach_lane.Aborted:
+                return dict(_ABORTED)
             except Exception as e:                      # noqa: BLE001
                 _warn_pallas_failed(repr(e))
                 try:
@@ -846,9 +862,26 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         xc, bm = _xor_bitmask(W, M)
         xc, bm = jnp.asarray(xc), jnp.asarray(bm)
         R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
-        ptr, _, alive, R_block = _jitted_walk_returns()(
-            P, xc, bm, jnp.asarray(rs.ret_slot),
-            jnp.asarray(rs.slot_ops), R0)
+        if should_abort is not None and rs.R > _ABORT_SEG:
+            # abortable serial drive: bounded segments with the config
+            # set carried across dispatches, hook checked between
+            base, R_cur = 0, R0
+            ptr = alive = R_block = None
+            while base < rs.R:
+                if should_abort():
+                    return dict(_ABORTED)
+                seg = min(_ABORT_SEG, rs.R - base)
+                ptr, R_cur, alive, R_block = _jitted_walk_returns()(
+                    P, xc, bm, jnp.asarray(rs.ret_slot[base:base + seg]),
+                    jnp.asarray(rs.slot_ops[base:base + seg]), R_cur)
+                if not bool(alive):
+                    ptr = jnp.int32(base + int(ptr))
+                    break
+                base += seg
+        else:
+            ptr, _, alive, R_block = _jitted_walk_returns()(
+                P, xc, bm, jnp.asarray(rs.ret_slot),
+                jnp.asarray(rs.slot_ops), R0)
         elapsed = _time.monotonic() - t0
         if bool(alive):
             return _result_valid("reach", stream, memo, elapsed)
@@ -1110,7 +1143,8 @@ def _check_many_native(model: Model,
 def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                max_states: int = 100_000, max_slots: int = 20,
                max_dense: int = 1 << 22,
-               devices: Optional[Sequence] = None) -> List[Dict[str, Any]]:
+               devices: Optional[Sequence] = None,
+               should_abort=None) -> List[Dict[str, Any]]:
     """Batched per-key checking (the ``independent`` checker's hot path):
     one vmapped device call over all keys, padded to common shapes. Keys
     whose history does not fit the dense engine raise; callers split those
@@ -1119,10 +1153,16 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
     With ``devices`` (>1), the key axis is sharded over a
     ``jax.sharding.Mesh`` — the data-parallel axis of SURVEY.md §2.4:
     per-key searches are independent, so the only cross-device traffic is
-    the while-loop's all-reduced liveness test."""
+    the while-loop's all-reduced liveness test. ``should_abort`` is
+    consulted once before the batched device dispatch (the batch is one
+    call — per-key granularity would defeat its throughput); when it
+    fires, every live key reports ``valid == "unknown"``."""
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
+    if should_abort is not None and should_abort():
+        return [{"valid": "unknown", "cause": "aborted",
+                 "engine": "reach-batch"} for _ in packed_list]
     if devices is None or len(devices) <= 1:
         out = _check_many_native(model, packed_list,
                                  max_states=max_states,
@@ -1290,7 +1330,8 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
                   n_chunks: int = 8, max_states: int = 100_000,
                   max_slots: int = 20, max_dense: int = 1 << 22,
                   max_matrix: int = 1 << 26,
-                  devices: Optional[Sequence] = None) -> Dict[str, Any]:
+                  devices: Optional[Sequence] = None,
+                  should_abort=None) -> Dict[str, Any]:
     """History-length-parallel check: split the RETURN stream into
     ``n_chunks`` chunks, compute each chunk's D×D boolean transfer matrix
     by running the returns walk over all D basis configs (vmapped over
@@ -1333,6 +1374,9 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
     basis[idx, idx // M, idx % M] = True
     basis_c = np.broadcast_to(basis, (n_chunks, D, S_pad, M))
 
+    if should_abort is not None and should_abort():
+        return {"valid": "unknown", "cause": "aborted",
+                "engine": "reach-chunked"}
     args = (jnp.asarray(P), jnp.asarray(xor_cols), jnp.asarray(bitmask),
             jnp.asarray(ret_slot_c), jnp.asarray(slot_ops_c),
             jnp.asarray(basis_c))

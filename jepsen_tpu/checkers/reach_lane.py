@@ -57,6 +57,17 @@ import numpy as np
 _BLOCK = 1024
 _FAST_PASSES = 5
 
+# returns per device dispatch when a should_abort hook is supplied: the
+# walk then runs serially segment-by-segment (carried config set, one
+# fetch per segment) so a losing competition engine frees the chip
+# within ~one segment instead of holding it for the whole history. The
+# non-abortable path stays a single dispatch — no cost to the headline.
+_ABORT_SEG = 32768
+
+
+class Aborted(RuntimeError):
+    """The caller's ``should_abort`` fired between segments."""
+
 
 def _project(R, j, W: int, M: int, S: int):
     """Projection on the returning slot ``j``: keep configs that fired
@@ -420,10 +431,54 @@ def pack_operands(P: np.ndarray, ret_slot: np.ndarray,
     return geom, ret_slot, slot_ops, host_args
 
 
+def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
+                    should_abort, R_real: int):
+    """Abortable serial drive: ``_ABORT_SEG``-return segments with the
+    config set carried across dispatches and ONE fetch per segment (the
+    fetch doubles as early death exit). Returns ``(dead, final_np)``
+    mirroring the single-dispatch flow; raises :class:`Aborted` between
+    segments when the hook fires."""
+    import jax
+
+    B, W, M, S, O1, R_pad = geom
+    ret_slot, slot_ops_flat, pend, P, R0 = host_args
+    dP = jax.device_put(P)
+    R_cur = jax.device_put(R0)
+    base = 0
+    while base < R_pad:
+        if should_abort():
+            raise Aborted()
+        seg = min(_ABORT_SEG, R_pad - base)
+        run = _lane_call(B, W, M, S, O1, seg, n_pass, interpret)
+        ckpt, final = run(ret_slot[base:base + seg],
+                          slot_ops_flat[base * W:(base + seg) * W],
+                          pend[base:base + seg], dP, R_cur)
+        final_np = np.asarray(final)
+        if not final_np.any():
+            # dead in this segment: locate the first empty checkpoint
+            ckpt_np = np.asarray(ckpt)
+            occupied = ckpt_np.reshape(ckpt_np.shape[0], -1).any(axis=1)
+            first_empty = int(np.argmin(occupied)) \
+                if not occupied.all() else ckpt_np.shape[0]
+            blk = max(0, first_empty - 1)
+            start = base + blk * B
+            dead = _refine_dead(
+                P, W, M,
+                np.asarray(ret_slot),
+                np.asarray(slot_ops_flat).reshape(R_pad, W),
+                ckpt_np[blk].T > 0.5, start,
+                min(B, max(1, R_real - start)))
+            return dead, final_np
+        R_cur = final
+        base += seg
+    return -1, np.asarray(R_cur)
+
+
 def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
                  slot_ops: np.ndarray, R0_sm: np.ndarray, *,
                  interpret: bool = False,
-                 fetch_R: bool = True) -> Tuple[int, Optional[np.ndarray]]:
+                 fetch_R: bool = True,
+                 should_abort=None) -> Tuple[int, Optional[np.ndarray]]:
     """Run the full returns walk on device; same contract as
     :func:`jepsen_tpu.checkers.reach_pallas.walk_returns`.
 
@@ -432,7 +487,11 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
     ``(dead, R_final)``: ``dead`` is the first return index at which
     the config set emptied (-1 if linearizable) and ``R_final`` the
     final config set as bool[S, M] (``None`` on invalid histories or
-    with ``fetch_R=False`` — the verdict is in ``dead``).
+    with ``fetch_R=False`` — the verdict is in ``dead``). With
+    ``should_abort``, the walk dispatches in :data:`_ABORT_SEG`-return
+    segments, checks the hook between them, and raises
+    :class:`Aborted` when it fires (upstream ``knossos.search`` abort
+    semantics).
     """
     import jax
 
@@ -441,6 +500,22 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
         P, ret_slot, slot_ops, R0_sm, interpret=interpret)
     B, W, M, S, O1, R_pad = geom
     n_fast = min(W, _FAST_PASSES)
+    if should_abort is not None:
+        dead, final_np = _walk_segmented(host_args, geom, n_fast,
+                                         interpret, should_abort, R_real)
+        exact = n_fast >= W
+        if dead >= 0 and not exact:
+            # possible false death of the capped ladder: decide exactly
+            dead, final_np = _walk_segmented(host_args, geom, W,
+                                             interpret, should_abort,
+                                             R_real)
+            exact = True
+        if dead >= 0:
+            return dead, None
+        if not exact and fetch_R:
+            _, final_np = _walk_segmented(host_args, geom, W, interpret,
+                                          should_abort, R_real)
+        return -1, (final_np > 0.5).T if fetch_R else None
     run = _lane_call(B, W, M, S, O1, R_pad, n_fast, interpret)
     dargs = jax.device_put(host_args)            # one upload, reused
     ckpt, final = run(*dargs)

@@ -37,3 +37,94 @@ def test_memory_watchdog_fires_on_low_threshold():
         time.sleep(0.1)
         assert ctl.should_abort() is True
         assert ctl.cause == "low-memory"
+
+
+class TestAbortableDenseWalk:
+    """Round-3: the dense device engine honors should_abort between
+    bounded segments (upstream knossos.search abort semantics)."""
+
+    def _history(self, n=600):
+        from jepsen_tpu import fixtures
+        return fixtures.gen_history("cas", n_ops=n, processes=4, seed=3)
+
+    def test_xla_walk_aborts_between_segments(self, monkeypatch):
+        import itertools
+        from jepsen_tpu import models
+        from jepsen_tpu.checkers import reach
+        monkeypatch.setattr(reach, "_ABORT_SEG", 64)
+        calls = itertools.count()
+        res = reach.check(models.cas_register(), self._history(),
+                          should_abort=lambda: next(calls) >= 2)
+        assert res["valid"] == "unknown"
+        assert res["cause"] == "aborted"
+
+    def test_abort_hook_false_matches_plain_run(self, monkeypatch):
+        from jepsen_tpu import fixtures, models
+        from jepsen_tpu.checkers import reach
+        monkeypatch.setattr(reach, "_ABORT_SEG", 64)
+        h = self._history()
+        bad = fixtures.corrupt(h, seed=5)
+        for hist in (h, bad):
+            plain = reach.check(models.cas_register(), hist)
+            seg = reach.check(models.cas_register(), hist,
+                              should_abort=lambda: False)
+            assert seg["valid"] == plain["valid"]
+            if plain["valid"] is False:
+                assert seg["op"] == plain["op"]
+
+    def test_lane_segmented_matches_single_dispatch(self, monkeypatch):
+        import numpy as np
+        import pytest
+        from jepsen_tpu import fixtures, models
+        from jepsen_tpu.checkers import events as ev
+        from jepsen_tpu.checkers import reach, reach_lane
+        from jepsen_tpu.history import pack
+
+        monkeypatch.setattr(reach_lane, "_ABORT_SEG", 2 * reach_lane._BLOCK)
+        model = models.cas_register()
+        for corrupt in (False, True):
+            h = self._history(400)
+            if corrupt:
+                h = fixtures.corrupt(h, seed=9)
+            packed = pack(h)
+            memo, stream, _T, S, M = reach._prep(
+                model, packed, max_states=100_000, max_slots=20,
+                max_dense=1 << 22)
+            rs = ev.returns_view(stream)
+            P = reach._build_P(memo, S)
+            R0 = np.zeros((S, M), bool)
+            R0[0, 0] = True
+            ref_dead, ref_R = reach_lane.walk_returns(
+                P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+            dead, R = reach_lane.walk_returns(
+                P, rs.ret_slot, rs.slot_ops, R0, interpret=True,
+                should_abort=lambda: False)
+            assert dead == ref_dead
+            if ref_dead < 0:
+                np.testing.assert_array_equal(R, ref_R)
+            # an immediately-firing hook raises before any dispatch
+            with pytest.raises(reach_lane.Aborted):
+                reach_lane.walk_returns(
+                    P, rs.ret_slot, rs.slot_ops, R0, interpret=True,
+                    should_abort=lambda: True)
+
+    def test_auto_chain_deadline_reaches_dense_stage(self, monkeypatch):
+        """The auto chain's time budget now gates the dense stage too:
+        an already-expired deadline turns the dense verdict 'unknown'
+        instead of letting stage one run unbounded."""
+        from jepsen_tpu import models
+        from jepsen_tpu.checkers import facade, reach
+        monkeypatch.setattr(reach, "_ABORT_SEG", 64)
+        seen = {}
+        orig = reach.check_packed
+
+        def spy(model, packed, **kw):
+            seen["should_abort"] = kw.get("should_abort")
+            return orig(model, packed, **kw)
+
+        monkeypatch.setattr(reach, "check_packed", spy)
+        res = facade.linearizable(models.cas_register(),
+                                  time_limit=120).check(
+            None, self._history(200))
+        assert res["valid"] is True
+        assert seen["should_abort"] is not None   # budget hook wired in
