@@ -31,7 +31,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
         ctypes.c_int32, ctypes.c_int64, ctypes.c_double,
-        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int32)]
 
 
 _NATIVE = NativeLib("wgl.cpp", "libjepsen_wgl.so", _declare)
@@ -92,6 +94,12 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     op_id = np.ascontiguousarray(packed.op_id, np.int32)
     crashed = np.ascontiguousarray(packed.crashed, np.uint8)
     out = np.zeros(4, np.int32)
+    # failure-evidence buffers: up to _CFG_CAP deepest dead-end configs
+    # as (state id, linearized-mask words) — knossos :final-paths
+    words = (n + 63) // 64 + 1
+    cfg_sid = np.zeros(_CFG_CAP, np.int32)
+    cfg_mask = np.zeros((_CFG_CAP, words), np.uint64)
+    n_cfg = np.zeros(1, np.int32)
 
     def ptr(a, t):
         return a.ctypes.data_as(ctypes.POINTER(t))
@@ -102,7 +110,9 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         ptr(op_id, ctypes.c_int32), ptr(crashed, ctypes.c_uint8),
         n, max_configs, -1.0 if time_limit is None else float(time_limit),
         abort_flag.pointer if abort_flag is not None else None,
-        ptr(out, ctypes.c_int32))
+        ptr(out, ctypes.c_int32),
+        _CFG_CAP, ptr(cfg_sid, ctypes.c_int32),
+        ptr(cfg_mask, ctypes.c_uint64), ptr(n_cfg, ctypes.c_int32))
 
     verdict, stuck, cover, cause = (int(x) for x in out)
     if verdict == 1:
@@ -110,10 +120,45 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                 "configs-explored": int(explored),
                 "states-materialized": memo.n_states}
     if verdict == 0:
-        return {"valid": False, "engine": "wgl-native",
-                "op": packed.entries[stuck].op.to_dict(),
-                "max-linearized": cover,
-                "configs-explored": int(explored)}
+        res = {"valid": False, "engine": "wgl-native",
+               "op": packed.entries[stuck].op.to_dict(),
+               "max-linearized": cover,
+               "configs-explored": int(explored)}
+        res["final-configs"] = _decode_configs(
+            memo, packed, cfg_sid, cfg_mask, int(n_cfg[0]))
+        return res
     return {"valid": "unknown", "engine": "wgl-native",
             "cause": _CAUSES.get(cause, cause),
             "configs-explored": int(explored)}
+
+
+_CFG_CAP = 16
+
+
+def _decode_configs(memo, packed: h.PackedHistory, cfg_sid: np.ndarray,
+                    cfg_mask: np.ndarray, n_cfg: int):
+    """Decode the C engine's (state id, linearized-mask) dead-end
+    configurations into the witness shape every other engine reports —
+    model state plus the linearized ops CONCURRENT with that config's
+    own stuck op (the same pending-window scope as
+    :mod:`jepsen_tpu.checkers.wgl_ref`)."""
+    n = packed.n
+    ok_idx = np.nonzero(~packed.crashed)[0]
+    final = []
+    for c in range(n_cfg):
+        bits = np.unpackbits(cfg_mask[c].view(np.uint8),
+                             bitorder="little")[:n].astype(bool)
+        not_lin_ok = ok_idx[~bits[ok_idx]]
+        stuck2 = int(not_lin_ok[0]) if len(not_lin_ok) else -1
+        lin_idx = np.nonzero(bits)[0]
+        if stuck2 >= 0:
+            lin = [str(packed.entries[i].op) for i in lin_idx
+                   if i != stuck2
+                   and int(packed.ret_ev[i]) > int(packed.inv_ev[stuck2])]
+        else:
+            lin = []
+        if not lin:             # fully-sequential window: show the tail
+            lin = [str(packed.entries[i].op) for i in lin_idx][-8:]
+        final.append({"model": str(memo.states[int(cfg_sid[c])]),
+                      "linearized-pending": lin})
+    return final

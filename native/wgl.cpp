@@ -125,12 +125,22 @@ extern "C" {
 // out[1] stuck entry index (for invalid verdicts)
 // out[2] max ok-ops linearized in any fully-explored config
 // out[3] cause: 0 none, 1 timeout, 2 config-explosion, 3 aborted
+//
+// Failure evidence (knossos :final-paths analogue): with cfg_cap > 0,
+// up to cfg_cap dead-end configurations at the DEEPEST cover are
+// emitted as (cfg_sid[i], cfg_mask[i * mask_words .. +mask_words))
+// where mask_words = (n + 63)/64 + 1 — the caller reconstructs model
+// state and linearized-pending ops from the mask. *n_cfg receives the
+// count. Collection resets whenever a deeper cover is reached, so the
+// survivors are exactly the configurations the search was stuck at.
+//
 // returns configs explored
 i64 wgl_check(const i32* table, i32 S, i32 O,
               const i32* inv_ev, const i64* ret_ev, const i32* op_id,
               const std::uint8_t* crashed, i32 n,
               i64 max_configs, double time_limit_s,
-              const volatile i32* abort_flag, i32* out) {
+              const volatile i32* abort_flag, i32* out,
+              i32 cfg_cap, i32* cfg_sid, u64* cfg_mask, i32* n_cfg) {
     (void)S;
     Wgl w;
     w.table = table;
@@ -174,6 +184,7 @@ i64 wgl_check(const i32* table, i32 S, i32 O,
     out[1] = -1;
     out[2] = 0;
     out[3] = 0;
+    if (n_cfg) *n_cfg = 0;
     if (w.total_ok == 0) return 0;
 
     auto t0 = std::chrono::steady_clock::now();
@@ -238,6 +249,16 @@ i64 wgl_check(const i32* table, i32 S, i32 O,
                 i32 s = w.nxt[n];                  // lowest unlinearized ok
                 while (s < n && w.ret[s] == INF) s = w.nxt[s];
                 w.best_stuck = (s < n) ? s : w.nxt[n];
+                if (n_cfg) *n_cfg = 0;             // deeper: restart evidence
+            }
+            if (cfg_cap > 0 && n_cfg && f.cover == w.best_cover
+                && *n_cfg < cfg_cap) {
+                const i64 words = static_cast<i64>(w.mask.size());
+                cfg_sid[*n_cfg] = f.sid;
+                for (i64 wd = 0; wd < words; ++wd)
+                    cfg_mask[static_cast<i64>(*n_cfg) * words + wd] =
+                        w.mask[static_cast<std::size_t>(wd)];
+                ++*n_cfg;
             }
             i32 ch = f.chosen;
             stack.pop_back();

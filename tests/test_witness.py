@@ -18,7 +18,7 @@ jax = pytest.importorskip("jax")
 
 from jepsen_tpu import fixtures, models
 from jepsen_tpu.checkers import decompose, frontier, linear, reach
-from jepsen_tpu.checkers import reach_lane, reach_pallas
+from jepsen_tpu.checkers import reach_lane, reach_pallas, wgl_ref
 from jepsen_tpu.history import pack
 
 
@@ -130,3 +130,40 @@ def test_decompose_per_key_witness():
     assert res.get("op")
     kr = res.get("key-result", {})
     assert kr.get("final-configs"), kr
+
+
+def test_wgl_cpu_witness():
+    res = wgl_ref.check(models.cas_register(), _bad_history())
+    _assert_witness(res)
+
+
+def test_wgl_native_witness():
+    from jepsen_tpu.checkers import wgl_native
+    if not wgl_native.available():
+        import pytest
+        pytest.skip("native WGL unavailable")
+    res = wgl_native.check(models.cas_register(), _bad_history())
+    _assert_witness(res, "wgl-native")
+
+
+def test_wgl_native_witness_matches_oracle_shape():
+    """The C engine's decoded final-configs carry real model states and
+    a non-empty pending window, differentially sane against the Python
+    oracle on several invalid histories."""
+    from jepsen_tpu.checkers import wgl_native
+    if not wgl_native.available():
+        import pytest
+        pytest.skip("native WGL unavailable")
+    for seed in range(6):
+        h = fixtures.gen_history("cas", n_ops=40, processes=3, seed=seed)
+        try:
+            h = fixtures.corrupt(h, seed=seed)
+        except ValueError:
+            continue
+        rn = wgl_native.check(models.cas_register(), h)
+        rr = wgl_ref.check(models.cas_register(), h)
+        assert rn["valid"] == rr["valid"]
+        if rn["valid"] is False:
+            assert rn["final-configs"], seed
+            for c in rn["final-configs"]:
+                assert c["model"] and "linearized-pending" in c
