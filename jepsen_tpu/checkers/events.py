@@ -219,7 +219,10 @@ def pad_returns(rs: ReturnStream, R: int, W: Optional[int] = None
     """Pad to ``R`` returns (identity rows) / widen to ``W`` slots.
     Direct allocation, not ``np.pad`` — per-key batch preps call this
     thousands of times and np.pad's Python plumbing was ~0.4 s of a
-    4096-key check."""
+    4096-key check.
+
+    When no padding or widening is needed the INPUT stream is returned
+    as-is (aliased arrays): treat the result as read-only."""
     W = rs.W if W is None else W
     if W < rs.W or R < rs.n_returns:
         raise ValueError("cannot shrink a return stream")

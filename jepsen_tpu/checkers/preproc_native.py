@@ -41,6 +41,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.jt_walk_dense.argtypes = [
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, _I32P,
         ctypes.c_int32, _U64P, ctypes.c_int64, _I32P, _I32P]
+    lib.jt_gen_history.restype = ctypes.c_int64
+    lib.jt_gen_history.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, _I32P, _I32P, _I32P, _I32P]
 
 
 _NATIVE = NativeLib("preproc.cpp", "libjepsen_preproc.so", _declare)
@@ -131,6 +135,25 @@ def build_keyed(entry_off: np.ndarray, inv_rank: np.ndarray,
         _p(ret_entry)))
     return (ret_slot[:R], slot_ops[:R], pend[:R], key_W, key_R,
             ret_entry[:R], R)
+
+
+def gen_history(seed: int, n_ops: int, processes: int, values: int,
+                kind: int):
+    """Native benchmark-history simulation (``jt_gen_history``):
+    returns ``(inv_ev, ret_ev, opid, proc, count)`` per surviving
+    entry (in return order), or None when the lib is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    inv_ev = np.empty(n_ops, np.int32)
+    ret_ev = np.empty(n_ops, np.int32)
+    opid = np.empty(n_ops, np.int32)
+    proc = np.empty(n_ops, np.int32)
+    count = int(lib.jt_gen_history(
+        int(seed), int(n_ops), int(processes), int(values), int(kind),
+        _p(inv_ev), _p(ret_ev), _p(opid), _p(proc)))
+    return (inv_ev[:count], ret_ev[:count], opid[:count], proc[:count],
+            count)
 
 
 def walk_dense(T: np.ndarray, R_words: np.ndarray, W: int,

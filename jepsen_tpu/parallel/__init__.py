@@ -83,5 +83,6 @@ def chunked_transfer(args, devs: Sequence):
         check_vma=False)
     R = jax.jit(sm)(P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c,
                     basis_c)
-    D = R.shape[1]
-    return np.asarray(R).reshape(n_chunks, D, D)
+    # [n_chunks, B, S, M] -> [n_chunks, B, D]; B is the (possibly
+    # reachability-restricted) basis row count, D = S·M
+    return np.asarray(R).reshape(R.shape[0], R.shape[1], -1)

@@ -159,6 +159,9 @@ def run_many(n: int, seed: int, *, pallas: bool = False,
         verdicts, bad = run_trial(params, trial_seed, pallas=pallas)
         if any(v is False for v in verdicts.values()):
             invalid_seen += 1
+        if verbose:
+            print(f"trial {t}: {params['kind']} n={params['n_ops']} "
+                  f"-> {verdicts}", flush=True)
         if bad:
             mismatches.append({"trial": t, "seed": trial_seed,
                                "params": params, "verdicts": verdicts})
