@@ -90,6 +90,14 @@ def run_trial(params, seed: int, *, pallas: bool = False):
     except (frontier.FrontierOverflow, ConcurrencyOverflow,
             StateExplosion) as e:
         verdicts["frontier"] = f"skipped: {type(e).__name__}"
+    try:
+        # the length-parallel engine (forward-pass basis restriction +
+        # restricted transfer-matrix fold) is its own walk composition
+        verdicts["reach-chunked"] = reach.check_chunked(
+            model, packed=packed, n_chunks=4)["valid"]
+    except (reach.DenseOverflow, ConcurrencyOverflow,
+            StateExplosion) as e:
+        verdicts["reach-chunked"] = f"skipped: {type(e).__name__}"
     if params["kind"] == "multi":
         from jepsen_tpu.checkers import decompose
         d = decompose.check(model, h)
