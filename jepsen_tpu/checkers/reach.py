@@ -1373,7 +1373,12 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
     # stream provides the boundaries in one dispatch (chunks align to
     # its 1024-return blocks); elsewhere chained XLA chunk walks carry
     # the set across devices with a single fetch at the end.
-    use_lane = (_use_pallas() and (devices is None or len(devices) <= 1)
+    # below this many returns the restriction's extra round trips
+    # (forward chain + per-group dispatches) cost more than the full
+    # D-basis walk they save — tiny histories keep the one-call path
+    restrict = Rn >= 4096
+    use_lane = (restrict and _use_pallas()
+                and (devices is None or len(devices) <= 1)
                 and _pallas_fits(S_pad, M, memo.n_ops)
                 and Rn >= _PALLAS_MIN_RETURNS)
     if use_lane:
@@ -1408,7 +1413,12 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
         except Exception as e:                      # noqa: BLE001
             _warn_pallas_failed(repr(e))
             use_lane = False
-    if not use_lane:
+    if not restrict:
+        # full basis, no forward pass: every config can enter every
+        # chunk; the fold itself detects death
+        bounds = np.ones((n_chunks, S_pad, M), bool)
+        alive_fwd = True
+    elif not use_lane:
         walk = _jitted_walk_returns()
         P_d, xc_d, bm_d = (jnp.asarray(P_np), jnp.asarray(xor_cols),
                            jnp.asarray(bitmask))
