@@ -83,6 +83,14 @@ class FrontierOverflow(RuntimeError):
     knossos.linear dies on config-set explosion)."""
 
 
+def _use_quotient() -> bool:
+    """The dense product-space fast path (:mod:`.reach_q`) is on by
+    default; ``JEPSEN_TPU_NO_QUOTIENT=1`` forces the sparse rows (used
+    by tests that target the sparse walk itself)."""
+    import os
+    return not os.environ.get("JEPSEN_TPU_NO_QUOTIENT")
+
+
 # -- device program ----------------------------------------------------------
 
 def _sort_unique_compact(U, F, pack_bits: int = 0):
@@ -604,6 +612,45 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     max_slots = min(max_slots, MAX_SLOTS)
     memo = reach._cached_memo(model, packed, max_states)
     stream = ev.build(packed, memo, max_slots=max_slots)
+    # round-3 fast path: when the crashed-op quotient's PRODUCT space
+    # (state × 2^live-slots × Π per-group counts) is enumerable, walk
+    # it densely (reach_q) — microseconds per return and one device
+    # dispatch, vs the sparse rows' per-return sort/expand. Budget
+    # overflows (many live slots, too many distinct crashed groups, or
+    # a huge count product) fall through to the sparse walk below.
+    if _use_quotient() and (devices is None or len(devices) <= 1):
+        try:
+            from jepsen_tpu.checkers import reach_q
+        except ImportError:                             # degraded install
+            reach_q = None
+        if reach_q is not None:
+            try:
+                q = reach_q.check_quotient(memo, stream, packed,
+                                           should_abort=aborted)
+                elapsed = _time.monotonic() - t0
+                if q["valid"] is True:
+                    out = reach._result_valid("frontier", stream, memo,
+                                              elapsed)
+                else:
+                    out = reach._result_invalid(
+                        "frontier", stream, memo, packed,
+                        q["dead-event"], elapsed)
+                    for k in ("final-configs", "previous-ok"):
+                        if k in q:
+                            out[k] = q[k]
+                out["quotient"] = "dense-product"
+                out["product-space"] = q["product-space"]
+                return out
+            except reach_q.QuotientOverflow:
+                pass
+            except reach_q.Aborted:
+                cause = ("timeout" if deadline is not None
+                         and _time.monotonic() > deadline else "aborted")
+                return {"valid": "unknown", "cause": cause,
+                        "engine": "frontier",
+                        "time-s": _time.monotonic() - t0}
+            except Exception as e:                      # noqa: BLE001
+                reach._warn_pallas_failed(f"reach_q: {e!r}")
     rs = ev.returns_view(stream)
     crashed_slot = _crashed_slots(stream, packed, rs.W)
     R_pad = -(-max(rs.n_returns, 1) // _SEG) * _SEG

@@ -1373,10 +1373,11 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
     # stream provides the boundaries in one dispatch (chunks align to
     # its 1024-return blocks); elsewhere chained XLA chunk walks carry
     # the set across devices with a single fetch at the end.
-    # below this many returns the restriction's extra round trips
-    # (forward chain + per-group dispatches) cost more than the full
-    # D-basis walk they save — tiny histories keep the one-call path
-    restrict = Rn >= 4096
+    # the restriction's extra round trips (forward chain + per-group
+    # dispatches) only pay off when the full-basis walk's work —
+    # Rn returns × D basis configs — is substantial; tiny histories
+    # over small config spaces keep the one-call path
+    restrict = Rn * D >= 1 << 20
     use_lane = (restrict and _use_pallas()
                 and (devices is None or len(devices) <= 1)
                 and _pallas_fits(S_pad, M, memo.n_ops)

@@ -1,0 +1,348 @@
+"""Dense product-space walk with the crashed-op quotient — the
+frontier engine's fast path for crash-seasoned histories.
+
+Upstream knossos explores crashed (``info``) ops exactly, paying the
+``2^k`` "info ops are expensive" blowup; this framework's sparse
+frontier (:mod:`.frontier`) quotients interchangeable crashed ops to
+per-group fired COUNTS but still pays sort-based dedup per return. This
+engine takes the quotient to its logical conclusion: since two pending
+crashed ops with the same op id are interchangeable (neither returns;
+firing either steps the model identically) and a crashed op never needs
+a live slot (it never returns, so no projection ever targets it), the
+reachable configuration space is exactly the PRODUCT
+
+    state × 2^L × Π_g (k_g + 1)
+
+where ``L`` counts only concurrently-pending RETURNING ops (small — the
+client concurrency) and ``k_g`` is the size of crashed group ``g`` (one
+group per distinct op id). For the crash-heavy benchmark row this is a
+few thousand cells — a dense boolean tensor the device walks at
+microseconds per return, where the sparse frontier pays ~0.3-0.7 ms of
+per-return sort/expand work and knossos pays ``2^k``.
+
+Semantics per return event (fire passes run to a monotone fixpoint):
+
+- live fires: exactly the dense engine's mask-axis update
+  (:mod:`.reach`), batched over the flat count axis;
+- group fires: configs with ``count_g < cap_g(r)`` step the model
+  through the group's op and increment the count — a precomputed
+  gather along the mixed-radix flat count axis. ``cap_g(r)`` is the
+  number of group members invoked before return ``r`` (host-known): a
+  crashed op may linearize anywhere after its invocation, or never;
+- projection on the returning live slot, as the dense engine.
+
+Exactness: the quotient map (forget WHICH group members fired, keep the
+count) is a bisimulation on the dense engine's config graph — fires and
+projections commute with it — so emptiness at each return is preserved
+exactly. No fingerprint hashing anywhere.
+
+Budget-gated: ``S·2^L·Π(k_g+1) <= max_dense`` and ``G <= _MAX_GROUPS``
+(the fire pass unrolls groups); histories beyond it stay on the sparse
+frontier rows.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers import events as ev
+from jepsen_tpu.models.memo import Memo
+
+_MAX_GROUPS = 8
+# returns per device dispatch: bounded programs, shape-stable compiles
+# (the tail segment bucket-pads), and host abort points between
+_SEG = 32768
+
+
+class QuotientOverflow(RuntimeError):
+    """The product space exceeds the budget; callers fall back to the
+    sparse frontier rows."""
+
+
+class Aborted(RuntimeError):
+    """The caller's ``should_abort`` fired between segments."""
+
+
+# -- host geometry -----------------------------------------------------------
+
+def _mixed_radix(sizes: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+    """For count-axis sizes ``k_g + 1``: per-group digit table
+    ``digit[G, C]`` and shift-source table ``src[G, C]`` (the flat index
+    whose count_g is one lower, -1 where digit_g == 0)."""
+    C = int(np.prod(sizes)) if sizes else 1
+    G = len(sizes)
+    digit = np.zeros((max(G, 1), C), np.int32)
+    src = np.full((max(G, 1), C), -1, np.int32)
+    flat = np.arange(C)
+    stride = 1
+    for g in range(G):
+        digit[g] = (flat // stride) % sizes[g]
+        src[g] = np.where(digit[g] > 0, flat - stride, -1)
+        stride *= sizes[g]
+    return digit, src
+
+
+def _prep_quotient(memo: Memo, stream: ev.EventStream,
+                   packed: h.PackedHistory, max_live: int = 16):
+    """Split the event stream into live events (slotted over returning
+    ops only) and crashed groups, and build the walk's operands."""
+    crashed = np.asarray(packed.crashed, bool)
+    E = stream.n_events
+    kind = stream.kind[:E]
+    entry = stream.entry[:E]
+    opid = stream.opid[:E]
+    is_crash_ev = (kind == ev.KIND_INVOKE) & crashed[entry]
+    # live slot assignment over the filtered (non-crashed) events
+    from jepsen_tpu.checkers import preproc_native
+    live_pos = np.nonzero(~is_crash_ev)[0].astype(np.int32)
+    lkind = np.ascontiguousarray(kind[live_pos])
+    lentry = np.ascontiguousarray(entry[live_pos])
+    native = preproc_native.assign_slots(lkind, lentry, packed.n,
+                                         max_live)
+    if native is None:
+        raise QuotientOverflow("native preproc unavailable")
+    lslot, L = native
+    if L < 0:
+        raise QuotientOverflow(f"live concurrency > {max_live}")
+    L = max(L, 1)
+    lopid = np.ascontiguousarray(opid[live_pos])
+    rv = preproc_native.returns_view(lkind, lslot, lopid, lentry, L,
+                                     len(lkind))
+    if rv is None:
+        raise QuotientOverflow("native preproc unavailable")
+    ret_slot, slot_ops, ret_event_l, ret_entry, R = rv
+    # ret_event_l indexes the FILTERED stream; map back to stream events
+    ret_event = live_pos[ret_event_l]
+    # crashed groups by op id (noop-crashed were already dropped by
+    # events.build before this stream was built)
+    crash_pos = np.nonzero(is_crash_ev)[0]
+    crash_ops = opid[crash_pos]
+    gids, ginv = np.unique(crash_ops, return_inverse=True)
+    G = len(gids)
+    if G > _MAX_GROUPS:
+        raise QuotientOverflow(f"{G} crashed groups > {_MAX_GROUPS}")
+    sizes = [int((ginv == g).sum()) + 1 for g in range(G)]
+    C = int(np.prod(sizes)) if sizes else 1
+    # cap_g(r): group members invoked before return r's event
+    caps = np.zeros((max(R, 1), max(G, 1)), np.int32)
+    for g in range(G):
+        inv_ranks = np.sort(crash_pos[ginv == g])
+        caps[:R, g] = np.searchsorted(inv_ranks, ret_event[:R])
+    digit, src = _mixed_radix(sizes)
+    return (L, ret_slot, slot_ops, ret_event, ret_entry, R,
+            gids.astype(np.int32), sizes, C, caps, digit, src)
+
+
+# -- device walk -------------------------------------------------------------
+
+def _q_fire_once(P, xor_cols, bitmask, digit, src, R, Glive, cap_row,
+                 gop_ids):
+    """One monotone fire pass on ``R`` bool[S, M, C]: every live slot
+    plus every crashed group."""
+    import jax.numpy as jnp
+
+    n_groups = gop_ids.shape[0]
+    # live fires: gather bit-clear halves, step, OR into bit-set
+    Rx = R[:, xor_cols]                         # [S, W, M, C]
+    contrib = jnp.einsum("sjmc,jst->tjmc",
+                         Rx.astype(jnp.float32), Glive)
+    add = ((contrib > 0.5) & bitmask[None, :, :, None]).any(axis=1)
+    R = R | add
+    # group fires: step the model, +1 on the group's count digit
+    for g in range(n_groups):
+        fired = jnp.einsum("smc,st->tmc",
+                           R.astype(jnp.float32), P[gop_ids[g]])
+        fired = fired > 0.5
+        # shift along the flat count axis (digit_g += 1), gated on the
+        # result count staying within the invoked availability cap
+        shifted = jnp.where((src[g] >= 0)[None, None, :],
+                            fired[:, :, jnp.clip(src[g], 0)], False)
+        gate = (digit[g] <= cap_row[g])[None, None, :]
+        R = R | (shifted & gate)
+    return R
+
+
+def _q_step(P, xor_cols, bitmask, digit, src, R, j, ops_row, cap_row,
+            gop_ids):
+    """One return event: fire to the monotone fixpoint, then project
+    on live slot ``j`` (``j = -1`` is the identity pad)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    W, M = xor_cols.shape
+    n_ops_pad = P.shape[0] - 1
+    Glive = P[jnp.where(ops_row < 0, n_ops_pad, ops_row)]    # [W, S, S]
+
+    def once(Rv):
+        return _q_fire_once(P, xor_cols, bitmask, digit, src, Rv,
+                            Glive, cap_row, gop_ids)
+
+    def cond(c):
+        prev, cur = c
+        return jnp.any(prev != cur)
+
+    def body(c):
+        _, cur = c
+        return cur, once(cur)
+
+    _, R = lax.while_loop(cond, body, (R, once(R)))
+    jj = jnp.maximum(j, 0)
+    idx = jnp.arange(M)
+    bit = jnp.int32(1) << jj
+    srcm = idx | bit
+    clear = (idx & bit) == 0
+    Rp = jnp.where(clear[None, :, None], R[:, srcm], False)
+    return jnp.where(j >= 0, Rp, R)
+
+
+def _q_walk(P, xor_cols, bitmask, digit, src, gop_ids, ret_slot,
+            slot_ops, caps, R0):
+    """Drive all return events; returns ``(ptr, R, alive)`` — dead at
+    return ``ptr - 1`` when ``alive`` is false."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    Rn = ret_slot.shape[0]
+
+    def cond(c):
+        i, R, alive = c
+        return (i < Rn) & alive
+
+    def body(c):
+        i, R, _ = c
+        R = _q_step(P, xor_cols, bitmask, digit, src, R, ret_slot[i],
+                    slot_ops[i], caps[i], gop_ids)
+        return i + 1, R, R.any()
+
+    return lax.while_loop(cond, body, (jnp.int32(0), R0, R0.any()))
+
+
+@functools.cache
+def _jitted_q_walk():
+    import jax
+    return jax.jit(_q_walk)
+
+
+# -- entry -------------------------------------------------------------------
+
+def _run_segments(P_np, xor_cols, bitmask, digit, src, gids, ret_slot,
+                  slot_ops, caps, R0, R_n: int, should_abort):
+    """Drive the walk in ``_SEG``-return bucket-padded segments (shape
+    cache stays small; the set carries across dispatches); raises
+    :class:`Aborted` between segments when the hook fires. Returns the
+    device ``(global_ptr, R, alive)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers import reach
+
+    walk = _jitted_q_walk()
+    dP = jax.device_put(np.asarray(P_np))
+    dxc, dbm = jax.device_put(xor_cols), jax.device_put(bitmask)
+    ddig, dsrc = jax.device_put(digit), jax.device_put(src)
+    dg = jax.device_put(np.ascontiguousarray(gids, np.int32))
+    R_cur = jnp.asarray(R0)
+    base = 0
+    while base < R_n:
+        if should_abort is not None and should_abort():
+            raise Aborted()
+        n = min(_SEG, R_n - base)
+        L_pad = max(64, reach._bucket(n, 8))
+        seg_slot = np.full(L_pad, -1, np.int32)
+        seg_slot[:n] = ret_slot[base:base + n]
+        W = slot_ops.shape[1]
+        seg_ops = np.full((L_pad, W), -1, np.int32)
+        seg_ops[:n] = slot_ops[base:base + n]
+        G = caps.shape[1]
+        seg_caps = np.zeros((L_pad, G), np.int32)
+        seg_caps[:n] = caps[base:base + n]
+        seg_caps[n:] = caps[base + n - 1]        # idempotent pad rows
+        ptr, R_cur, alive = walk(
+            dP, dxc, dbm, ddig, dsrc, dg, jnp.asarray(seg_slot),
+            jnp.asarray(seg_ops), jnp.asarray(seg_caps), R_cur)
+        if not bool(alive):
+            return base + int(ptr), R_cur, False
+        base += n
+    return R_n, R_cur, True
+
+
+def check_quotient(memo: Memo, stream: ev.EventStream,
+                   packed: h.PackedHistory, *,
+                   max_dense: int = 1 << 22,
+                   should_abort=None) -> Dict[str, Any]:
+    """Run the product-space walk. Raises :class:`QuotientOverflow`
+    when the history does not fit (callers fall back to the sparse
+    rows) or :class:`Aborted` when ``should_abort`` fires between
+    segments. Returns the same verdict dict shape as the other engines
+    (the caller brands the engine name)."""
+    from jepsen_tpu.checkers import reach
+
+    (L, ret_slot, slot_ops, ret_event, ret_entry, R_n, gids, sizes, C,
+     caps, digit, src) = _prep_quotient(memo, stream, packed)
+    S = memo.n_states
+    S_pad = max(2, reach._next_pow2(S))
+    M = 1 << L
+    if S_pad * M * C > max_dense:
+        raise QuotientOverflow(
+            f"product space {S_pad}x{M}x{C} exceeds {max_dense}")
+    if R_n == 0:
+        return {"valid": True, "product-space": [S_pad, 1 << L, C],
+                "live-slots": L, "crash-groups": len(sizes)}
+    P_np = reach._build_P(memo, S_pad)
+    xor_cols, bitmask = reach._xor_bitmask(L, M)
+    R0 = np.zeros((S_pad, M, C), bool)
+    R0[0, 0, 0] = True
+    ptr, R_fin, alive = _run_segments(
+        P_np, xor_cols, bitmask, digit, src, gids,
+        np.ascontiguousarray(ret_slot, np.int32),
+        np.ascontiguousarray(slot_ops, np.int32),
+        np.ascontiguousarray(caps[:R_n], np.int32), R0, R_n,
+        should_abort)
+    if bool(alive):
+        return {"valid": True, "product-space": [S_pad, M, C],
+                "live-slots": L, "crash-groups": len(sizes)}
+    dead_ret = int(ptr) - 1
+    out = {"valid": False, "product-space": [S_pad, M, C],
+           "live-slots": L, "crash-groups": len(sizes),
+           "op": packed.entries[int(ret_entry[dead_ret])].op.to_dict(),
+           "dead-event": int(ret_event[dead_ret]),
+           "max-linearized": dead_ret}
+    if dead_ret > 0:
+        out["previous-ok"] = packed.entries[
+            int(ret_entry[dead_ret - 1])].op.to_dict()
+    # witness: re-walk the prefix for the surviving configs
+    try:
+        _ptr2, R_prev, _ = _run_segments(
+            P_np, xor_cols, bitmask, digit, src, gids,
+            np.ascontiguousarray(ret_slot[:dead_ret], np.int32),
+            np.ascontiguousarray(slot_ops[:dead_ret], np.int32),
+            np.ascontiguousarray(caps[:max(dead_ret, 1)], np.int32),
+            R0, dead_ret, should_abort)
+        out["final-configs"] = _decode(memo, np.asarray(R_prev),
+                                       slot_ops[dead_ret], gids, sizes,
+                                       digit)
+    except Exception:                                   # noqa: BLE001
+        pass                            # evidence is best-effort garnish
+    return out
+
+
+def _decode(memo: Memo, R: np.ndarray, pending_row, gids, sizes,
+            digit, limit: int = 16) -> List[Dict[str, Any]]:
+    S_pad, M, C = R.shape
+    alive = np.argwhere(R)
+    out = []
+    for s, m, c in alive[:limit]:
+        lin = [str(memo.distinct_ops[pending_row[j]])
+               for j in range(len(pending_row))
+               if (int(m) >> j) & 1 and pending_row[j] >= 0]
+        for g in range(len(sizes)):
+            cnt = int(digit[g, c])
+            if cnt:
+                lin.append(f"{cnt}x crashed "
+                           f"{memo.distinct_ops[int(gids[g])]}")
+        out.append({"model": str(memo.states[s]),
+                    "linearized-pending": lin})
+    return out

@@ -13,6 +13,14 @@ from jepsen_tpu.history import index
 from jepsen_tpu.op import info, invoke, ok
 
 
+@pytest.fixture(autouse=True)
+def _sparse_path(monkeypatch):
+    """These tests target the SPARSE frontier machinery; the round-3
+    dense product-space fast path (reach_q) has its own suite
+    (tests/test_reach_q.py) and would otherwise absorb most cases."""
+    monkeypatch.setenv("JEPSEN_TPU_NO_QUOTIENT", "1")
+
+
 def hist(*ops):
     return index(list(ops))
 

@@ -1,0 +1,101 @@
+"""Tests for the dense product-space quotient walk (`reach_q`) — the
+frontier engine's round-3 fast path for crash-seasoned histories:
+config axes (state, 2^live-slots, per-crashed-group fired counts)."""
+import os
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures
+from jepsen_tpu import models as m
+from jepsen_tpu.checkers import frontier, reach_q, wgl_ref
+from jepsen_tpu.history import index, pack
+from jepsen_tpu.op import info, invoke, ok
+
+
+def _check_sparse(model, h, **kw):
+    os.environ["JEPSEN_TPU_NO_QUOTIENT"] = "1"
+    try:
+        return frontier.check(model, h, **kw)
+    finally:
+        del os.environ["JEPSEN_TPU_NO_QUOTIENT"]
+
+
+class TestQuotientDifferential:
+    def test_matches_sparse_and_oracle_crash_mix(self):
+        used = 0
+        for seed in range(24):
+            kind = ["register", "cas"][seed % 2]
+            h = fixtures.gen_history(
+                kind, n_ops=30 + seed, processes=3,
+                crash_p=[0.0, 0.1, 0.3][seed % 3],
+                values=2 + seed % 2, seed=seed)
+            if seed % 4 == 1:
+                try:
+                    h = fixtures.corrupt(h, seed=seed)
+                except ValueError:
+                    pass
+            model = fixtures.model_for(kind)
+            rq = frontier.check(model, h)
+            used += 1 if rq.get("quotient") == "dense-product" else 0
+            rs = _check_sparse(model, h)
+            assert rq["valid"] == rs["valid"], seed
+            rr = wgl_ref.check(model, h, time_limit=30)
+            if rr["valid"] in (True, False):
+                assert rq["valid"] == rr["valid"], seed
+            if rq["valid"] is False:
+                assert rq["op"] == rs["op"], seed
+                assert rq.get("final-configs"), seed
+        assert used >= 20         # the fast path genuinely engages
+
+    def test_interchangeable_crashes_stay_polynomial(self):
+        """24 same-value crashed writes: the quotient holds one count
+        axis of size 25 where knossos would explode at 2^24."""
+        h = [invoke(0, "write", 1), ok(0, "write", 1)]
+        for i in range(24):
+            h.append(invoke(100 + i, "write", 7))
+            h.append(info(100 + i, "write", 7))
+        h += [invoke(0, "read", None), ok(0, "read", 7),
+              invoke(0, "read", None), ok(0, "read", 1)]
+        res = frontier.check(m.register(), index(h))
+        assert res["valid"] is False          # 1 after 7 needs a 2nd writer
+        assert res.get("quotient") == "dense-product"
+        S, M, C = res["product-space"]
+        assert C <= 25 * 2                    # counts, not 2^24
+        h2 = h[:-2]                           # drop the impossible read
+        assert frontier.check(m.register(), index(h2))["valid"] is True
+
+    def test_group_cap_respects_invocation_order(self):
+        """A crashed write can only linearize AFTER its invocation: a
+        read observing the crashed value before any crash invoke is a
+        violation the caps must catch."""
+        h = [invoke(0, "write", 1), ok(0, "write", 1),
+             invoke(1, "read", None), ok(1, "read", 5),
+             invoke(2, "write", 5), info(2, "write", 5)]
+        res = frontier.check(m.register(), index(h))
+        assert res["valid"] is False
+        assert res.get("quotient") == "dense-product"
+        # reordered: crash invoked before the read -> linearizable
+        h2 = [invoke(0, "write", 1), ok(0, "write", 1),
+              invoke(2, "write", 5),
+              invoke(1, "read", None), ok(1, "read", 5),
+              info(2, "write", 5)]
+        assert frontier.check(m.register(), index(h2))["valid"] is True
+
+    def test_overflow_gates_fall_back_to_sparse(self):
+        from jepsen_tpu import history as H
+        from jepsen_tpu.checkers import events as ev
+        from jepsen_tpu.checkers import reach
+        # many distinct crashed op ids -> too many groups
+        h = [invoke(0, "write", 0), ok(0, "write", 0)]
+        for i in range(reach_q._MAX_GROUPS + 2):
+            h.append(invoke(50 + i, "write", i + 1))
+            h.append(info(50 + i, "write", i + 1))
+        packed = H.pack(index(h))
+        model = m.register()
+        memo = reach._cached_memo(model, packed, 100_000)
+        stream = ev.build(packed, memo, max_slots=128)
+        with pytest.raises(reach_q.QuotientOverflow):
+            reach_q.check_quotient(memo, stream, packed)
+        # the engine still answers via the sparse rows
+        assert frontier.check(model, index(h))["valid"] is True
