@@ -11,12 +11,17 @@ histories simply time out.
 With ``--engine reach`` (the default) the run also reports a
 kernel-level probe (SURVEY.md §5 tracing): steady-state device time of
 the lane kernel separated from host->device transfer and the
-dispatch/fetch round-trip, plus an honest MFU figure. The probe times
-the kernel by dispatch slope (K queued dispatches + one fetch, minus a
-single dispatch + fetch) because ``block_until_ready`` does not block
-on the tunneled dev platform; transfer completion is observed by
-fetching the smallest operand back (so the figure includes one
-readback round-trip — see ``kernel_probe``).
+dispatch/fetch round-trip, plus an honest MFU figure. The probe drives
+the PRODUCTION dispatch path (``reach_lane._pipe_walk`` — the same
+segmented programs ``check_packed`` runs) and times the kernel by
+dispatch slope (K queued walks + one fetch, minus a single walk +
+fetch) because ``block_until_ready`` does not block on the tunneled
+dev platform. The bare round-trip latency is sampled separately
+(min of several dispatch+fetch cycles of a jitted scalar reduction
+over the already-resident operand set — the same observer the
+transfer measurement pays) and subtracted from the transfer figure,
+so ``transfer_sync_s`` is bytes on the wire, not latency; raw
+put+observe = ``transfer_sync_s + rtt_s``.
 
 Usage: python bench.py [--ops N] [--repeat K] [--engine reach|chunked]
 """
@@ -53,32 +58,56 @@ def kernel_probe(model, packed) -> dict:
     R0 = np.zeros((S, M), bool)
     R0[0, 0] = True
     R_real = int(rs.ret_slot.shape[0])
-    # marshaling shared with the production path — the probe can never
-    # time a kernel built with stale geometry
+    # marshaling AND dispatch shared with the production path — the
+    # probe runs reach_lane._pipe_walk itself, so it can never time a
+    # kernel or a pipeline production does not execute
     geom, _, _, host_args = reach_lane.pack_operands(
         P_np, rs.ret_slot, rs.slot_ops, R0)
     B, W, M, S, O1, R_pad = geom
     n_pass = min(W, reach_lane._FAST_PASSES)
-    run = reach_lane._lane_call(B, W, M, S, O1, R_pad, n_pass, False)
     n_bytes = sum(a.nbytes for a in host_args)
-    args = jax.device_put(host_args)
-    _ = np.asarray(run(*args)[1])               # warm/compile
-    # transfer: one batched put, forced to completion by fetching the
-    # smallest whole operand back (measured warm — the first put pays
-    # allocator setup). Includes ONE readback round-trip (~0.07-0.15 s
-    # on the tunnel): there is no way to observe put completion without
-    # it, so treat small-size figures as put + 1 RTT.
+    dsegs: dict = {}
+    _, final = reach_lane._pipe_walk(host_args, geom, n_pass, False,
+                                     dsegs)
+    _ = np.asarray(final)                       # warm/compile
+    # put-completion observer: a scalar reduction CONSUMING every
+    # operand, jitted once. Fetching a put array back is free (jax
+    # keeps the committed host copy), so observing transfer completion
+    # requires a device computation that depends on the bytes.
+    import jax.numpy as jnp
+    observe = jax.jit(lambda a, b, c, d: (
+        a.astype(jnp.int32).sum() + b.astype(jnp.int32).sum()
+        + c.sum().astype(jnp.int32) + d.sum().astype(jnp.int32)))
+    args2 = jax.device_put(host_args)
+    _ = int(observe(*args2))                    # warm/compile
+    # bare dispatch+fetch round trip on RESIDENT operands — the latency
+    # floor every sync pays regardless of bytes moved (min of several
+    # samples: single-shot jitter is the same order as the transfer)
+    rtts = []
+    for _i in range(4):
+        t0 = time.monotonic()
+        _ = int(observe(*args2))
+        rtts.append(time.monotonic() - t0)
+    rtt_s = min(rtts)
+    # transfer: one put of the full operand set, forced to completion
+    # by the observer; the observer's own dispatch+fetch is latency,
+    # not transfer, so the sampled floor is subtracted. Raw
+    # put+observe = transfer_sync_s + rtt_s.
     t0 = time.monotonic()
-    args = jax.device_put(host_args)
-    _ = np.asarray(args[-1])             # R0, the smallest whole operand
-    transfer_s = time.monotonic() - t0   # compilation to warm, 1 RTT in
+    args2 = jax.device_put(host_args)
+    _ = int(observe(*args2))
+    transfer_s = max(0.0, time.monotonic() - t0 - rtt_s)
     t0 = time.monotonic()
-    _ = np.asarray(run(*args)[1])
-    one_s = time.monotonic() - t0               # 1 dispatch + fetch
+    _, final = reach_lane._pipe_walk(host_args, geom, n_pass, False,
+                                     dsegs)
+    _ = np.asarray(final)
+    one_s = time.monotonic() - t0         # 1 walk (dispatches) + fetch
     K = 6
     t0 = time.monotonic()
-    outs = [run(*args) for _ in range(K)]
-    _ = np.asarray(outs[-1][1])
+    for _i in range(K):
+        _, final = reach_lane._pipe_walk(host_args, geom, n_pass, False,
+                                         dsegs)
+    _ = np.asarray(final)
     many_s = time.monotonic() - t0
     kernel_s = max(0.0, (many_s - one_s) / (K - 1))
     # FLOPs: min(c_r, n_pass) fire matmuls [M,S]@[S,W*S] per return —
@@ -93,6 +122,7 @@ def kernel_probe(model, packed) -> dict:
         "returns": R_real,
         "transfer_sync_s": round(transfer_s, 4),
         "transfer_bytes": int(n_bytes),
+        "rtt_s": round(rtt_s, 4),
         "dispatch_fetch_s": round(one_s - kernel_s, 4),
         "mfu_pct": round(flops / max(kernel_s, 1e-9) / _PEAK_FLOPS * 100,
                          4),
@@ -103,7 +133,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", type=int, default=100_000)
     ap.add_argument("--processes", type=int, default=5)
-    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--repeat", type=int, default=5)
     ap.add_argument("--engine", default="reach",
                     choices=["reach", "chunked", "wgl-cpu", "wgl-native"])
     ap.add_argument("--seed", type=int, default=42)

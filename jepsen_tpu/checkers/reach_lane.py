@@ -66,12 +66,32 @@ _FAST_PASSES = 8
 # walk then runs serially segment-by-segment (carried config set, one
 # fetch per segment) so a losing competition engine frees the chip
 # within ~one segment instead of holding it for the whole history. The
-# non-abortable path stays a single dispatch — no cost to the headline.
+# non-abortable path stays a single fetch — no cost to the headline.
 _ABORT_SEG = 32768
+
+# the non-abortable walk splits put+dispatch into this many segments
+# (still ONE fetch): the link is idle while the device walks a segment,
+# so the next segment's operand upload rides under kernel execution —
+# measured ~10-20 ms off the cas-100k end-to-end on the dev tunnel,
+# more when the link is slow (the hideable window is the kernel time)
+_PIPE_NSEG = 4
 
 
 class Aborted(RuntimeError):
     """The caller's ``should_abort`` fired between segments."""
+
+
+def _idx_dtype(O1: int):
+    """Narrowest signed dtype holding op indices in [-1, O1): the int32
+    cast happens inside the jitted program, so the wire carries only
+    these bytes — ``slot_ops`` is the dominant operand (R_pad*W
+    entries), and at the headline config (O1=36) int8 halves total
+    host->device transfer vs the former int16."""
+    if O1 <= np.iinfo(np.int8).max:
+        return np.int8
+    if O1 <= np.iinfo(np.int16).max:
+        return np.int16
+    return np.int32
 
 
 def _project(R, j, W: int, M: int, S: int):
@@ -217,10 +237,14 @@ def _lane_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
         interpret=interpret,
     )
 
-    def run(ret_slot, slot_ops, pend, P, R0):
-        return call(ret_slot.astype(jnp.int32),
-                    slot_ops.astype(jnp.int32),
-                    pend.astype(jnp.int32), P, R0)
+    def run(ret_slot, slot_ops, P, R0):
+        # pending count per return — the gate ladder's exact per-return
+        # pass bound (fire chains set distinct pending slots, so c_r
+        # passes close). Derived on device so the wire never carries it.
+        ops32 = slot_ops.astype(jnp.int32)
+        pend = jnp.sum((ops32.reshape(-1, W) >= 0).astype(jnp.int32),
+                       axis=1)
+        return call(ret_slot.astype(jnp.int32), ops32, pend, P, R0)
 
     return jax.jit(run)
 
@@ -330,10 +354,12 @@ def _keyed_call(B: int, W: int, M: int, S: int, O1: int, N_pad: int,
         interpret=interpret,
     )
 
-    def run(ret_slot, slot_ops, pend, key_id, P):
-        return call(ret_slot.astype(jnp.int32),
-                    slot_ops.astype(jnp.int32),
-                    pend.astype(jnp.int32),
+    def run(ret_slot, slot_ops, key_id, P):
+        # pending counts derived on device (see _lane_call.run)
+        ops32 = slot_ops.astype(jnp.int32)
+        pend = jnp.sum((ops32.reshape(-1, W) >= 0).astype(jnp.int32),
+                       axis=1)
+        return call(ret_slot.astype(jnp.int32), ops32, pend,
                     key_id.astype(jnp.int32), P)
 
     return jax.jit(run)
@@ -362,13 +388,10 @@ def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
                           constant_values=-1)
         key_id = np.pad(key_id, (0, N_pad - N), constant_values=-1)
     run = _keyed_call(B, W, M, S, O1, N_pad, K_pad, W, interpret)
-    idx_dt = np.int16 if O1 <= np.iinfo(np.int16).max else np.int32
-    pend = (slot_ops >= 0).sum(axis=1)
-    pend_dt = np.int8 if W <= 127 else np.int16
+    idx_dt = _idx_dtype(O1)
     args = jax.device_put((
         np.ascontiguousarray(ret_slot, np.int8),
         np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
-        np.ascontiguousarray(pend, pend_dt),
         np.ascontiguousarray(key_id, np.int32),
         np.ascontiguousarray(P, np.float32)))
     (dead,) = run(*args)
@@ -422,14 +445,13 @@ def pack_operands(P: np.ndarray, ret_slot: np.ndarray,
                           constant_values=-1)
         slot_ops = np.pad(slot_ops, ((0, R_pad - R_real), (0, 0)),
                           constant_values=-1)
-    idx_dt = np.int16 if O1 <= np.iinfo(np.int16).max else np.int32
-    # pending count per return: the gate ladder's exact per-return pass
-    # bound (fire chains set distinct pending slots, so c_r passes close)
-    pend = (slot_ops >= 0).sum(axis=1)
-    pend_dt = np.int8 if W <= 127 else np.int16
+    idx_dt = _idx_dtype(O1)
+    # the pending count per return (the gate ladder's exact per-return
+    # pass bound) is NOT shipped: it is derived from slot_ops by a
+    # trivial XLA reduce on device (see _lane_call.run), saving R_pad
+    # wire bytes per check
     host_args = (np.ascontiguousarray(ret_slot, np.int8),
                  np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
-                 np.ascontiguousarray(pend, pend_dt),
                  np.ascontiguousarray(P, np.float32),
                  np.ascontiguousarray(R0_sm.T, np.float32))
     geom = (B, W, M, S, O1, R_pad)
@@ -446,7 +468,7 @@ def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
     import jax
 
     B, W, M, S, O1, R_pad = geom
-    ret_slot, slot_ops_flat, pend, P, R0 = host_args
+    ret_slot, slot_ops_flat, P, R0 = host_args
     dP = jax.device_put(P)
     R_cur = jax.device_put(R0)
     base = 0
@@ -457,7 +479,7 @@ def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
         run = _lane_call(B, W, M, S, O1, seg, n_pass, interpret)
         ckpt, final = run(ret_slot[base:base + seg],
                           slot_ops_flat[base * W:(base + seg) * W],
-                          pend[base:base + seg], dP, R_cur)
+                          dP, R_cur)
         final_np = np.asarray(final)
         if not final_np.any():
             # dead in this segment: locate the first empty checkpoint
@@ -477,6 +499,69 @@ def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
         R_cur = final
         base += seg
     return -1, np.asarray(R_cur)
+
+
+def _pipe_geom(B: int, R_pad: int) -> Tuple[int, int]:
+    """Segment size (returns) and count for the pipelined dispatch.
+    Shared by :func:`_pipe_walk` and the ``bench.py`` kernel probe so
+    the probe times exactly the programs production dispatches. Applies
+    in interpret mode too (differential tests then cover the
+    multi-segment path whenever the history is long enough)."""
+    n_blocks = R_pad // B
+    nseg = _PIPE_NSEG if n_blocks >= 2 * _PIPE_NSEG else 1
+    segb = -(-n_blocks // nseg)          # blocks per segment
+    return segb * B, -(-n_blocks // segb)
+
+
+def _pipe_walk(host_args, geom, n_pass: int, interpret: bool,
+               dsegs: dict):
+    """Put + dispatch the walk in :data:`_PIPE_NSEG` segments with the
+    config set carried on device and NO intermediate fetch: while the
+    device walks segment *i*, segment *i+1*'s operands stream over the
+    otherwise-idle link. ``dsegs`` caches the per-segment device arrays
+    so a rescue walk (different pass count, same operands) re-dispatches
+    without re-uploading. Returns ``(ckpts, final)`` — a list of
+    per-segment device checkpoint arrays (block starts, concatenation
+    equals the single-dispatch checkpoint stream) and the final device
+    config set. Nothing here blocks; the caller fetches."""
+    import jax
+
+    B, W, M, S, O1, R_pad = geom
+    ret_slot, slot_ops_flat, P, R0 = host_args
+    seg, nseg = _pipe_geom(B, R_pad)
+    run = _lane_call(B, W, M, S, O1, seg, n_pass, interpret)
+    fresh = "segs" not in dsegs
+    if fresh:
+        dsegs["dP"] = jax.device_put(P)
+        dsegs["segs"] = []
+    R_cur = jax.device_put(R0) if fresh else dsegs["dR0"]
+    if fresh:
+        dsegs["dR0"] = R_cur
+    ckpts = []
+    for i in range(nseg):
+        if fresh:
+            lo, hi = i * seg, min((i + 1) * seg, R_pad)
+            rs_seg = ret_slot[lo:hi]
+            so_seg = slot_ops_flat[lo * W:hi * W]
+            if hi - lo < seg:            # ragged tail: identity pad rows
+                rs_seg = np.pad(rs_seg, (0, seg - (hi - lo)),
+                                constant_values=-1)
+                so_seg = np.pad(so_seg, (0, (seg - (hi - lo)) * W),
+                                constant_values=-1)
+            dsegs["segs"].append(jax.device_put(
+                (np.ascontiguousarray(rs_seg),
+                 np.ascontiguousarray(so_seg))))
+        a, b = dsegs["segs"][i]
+        ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+        ckpts.append(ck)
+    return ckpts, R_cur
+
+
+def _pipe_ckpt_np(ckpts, n_blocks: int) -> np.ndarray:
+    """Fetch and concatenate the per-segment checkpoint streams,
+    trimmed to the real block count (the ragged tail's pad blocks carry
+    copies of the final set). Only the death path pays these fetches."""
+    return np.concatenate([np.asarray(c) for c in ckpts])[:n_blocks]
 
 
 def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
@@ -521,10 +606,9 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
             _, final_np = _walk_segmented(host_args, geom, W, interpret,
                                           should_abort, R_real)
         return -1, (final_np > 0.5).T if fetch_R else None
-    run = _lane_call(B, W, M, S, O1, R_pad, n_fast, interpret)
-    dargs = jax.device_put(host_args)            # one upload, reused
-    ckpt, final = run(*dargs)
-    final_np = np.asarray(final)                 # one round-trip
+    dsegs: dict = {}                     # device operands, upload once
+    ckpts, final = _pipe_walk(host_args, geom, n_fast, interpret, dsegs)
+    final_np = np.asarray(final)                 # the ONE round-trip
     if final_np.any():
         # sound: fewer-than-W passes only UNDER-approximate the config
         # set, and emptiness is monotone, so a surviving set certifies
@@ -533,23 +617,21 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
             # the surviving set may be an under-approximation when the
             # ladder was capped below W; consumers of R_final (evidence
             # decoding) get the exact set from the W-pass kernel
-            run = _lane_call(B, W, M, S, O1, R_pad, W, interpret)
-            _, final = run(*dargs)
+            _, final = _pipe_walk(host_args, geom, W, interpret, dsegs)
             final_np = np.asarray(final)
         return -1, (final_np > 0.5).T if fetch_R else None
     if n_fast < W:
         # the fast kernel's verdict may be a false death: decide with
         # the exact W-pass kernel (rare — invalid histories and the
         # occasional deep-chain-dependent valid one)
-        run = _lane_call(B, W, M, S, O1, R_pad, W, interpret)
-        ckpt, final = run(*dargs)
+        ckpts, final = _pipe_walk(host_args, geom, W, interpret, dsegs)
         final_np = np.asarray(final)
         if final_np.any():
             return -1, (final_np > 0.5).T if fetch_R else None
     # dead for real: locate the first empty checkpoint (block starts),
     # then re-walk the preceding block exactly for the knossos-style
     # failing return index
-    ckpt_np = np.asarray(ckpt)                   # rare second round-trip
+    ckpt_np = _pipe_ckpt_np(ckpts, R_pad // B)   # rare death-only fetch
     occupied = ckpt_np.reshape(ckpt_np.shape[0], -1).any(axis=1)
     first_empty = int(np.argmin(occupied)) if not occupied.all() \
         else ckpt_np.shape[0]

@@ -236,3 +236,44 @@ def test_keyed_lane_multiblock(monkeypatch):
     for k, p in enumerate(packed):
         ref = reach.check_packed(model, p)
         assert (dead[k] < 0) == bool(ref["valid"]), f"key {k}"
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_lane_pipelined_segments(monkeypatch, corrupt):
+    """The segmented put+dispatch pipeline (``_pipe_walk``): a history
+    long enough that ``_pipe_geom`` splits it into multiple segments
+    with a RAGGED tail (identity pad rows), covering the cross-segment
+    config-set carry, checkpoint concatenation/trim, and — with the
+    fast ladder capped — the rescue walk's reuse of the cached device
+    segments."""
+    monkeypatch.setattr(reach_lane, "_BLOCK", 8)
+    h = fixtures.gen_history("cas", n_ops=220, processes=4, seed=17)
+    if corrupt:
+        h = fixtures.corrupt(h, seed=5)
+    memo, stream, rs, P, R0, W, M, S_pad = _operands(
+        models.cas_register(), h)
+    geom, _, _, _ = reach_lane.pack_operands(
+        P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+    B, _, _, _, _, R_pad = geom
+    seg, nseg = reach_lane._pipe_geom(B, R_pad)
+    assert nseg > 1, "history too short to exercise the pipeline"
+    assert nseg * seg >= R_pad
+    rs_p, ptr, Rf, alive, Rb = _xla_walk(P, rs, R0, W, M)
+    dead, R_out = reach_lane.walk_returns(
+        P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+    assert (dead < 0) == alive
+    if alive:
+        assert np.array_equal(R_out, Rf)
+    else:
+        xc, bm = reach._xor_bitmask(W, M)
+        de_xla = reach._refine_dead(jnp.asarray(P), jnp.asarray(xc),
+                                    jnp.asarray(bm), rs_p, ptr, Rb)
+        assert int(rs.ret_event[dead]) == de_xla
+    # rescue-path reuse: cap the fast ladder below the deepest chain so
+    # the W-pass rescue re-dispatches from the cached device segments
+    monkeypatch.setattr(reach_lane, "_FAST_PASSES", 1)
+    dead2, _ = reach_lane.walk_returns(
+        P, rs.ret_slot, rs.slot_ops, R0, interpret=True)
+    assert (dead2 < 0) == alive
+    if not alive:
+        assert dead2 == dead

@@ -390,14 +390,12 @@ def main():
     R_real = int(rs.ret_slot.shape[0])
     print(f"geometry B={B} W={W} M={M} S={S} O1={O1} R_pad={R_pad} "
           f"returns={R_real}")
-    if len(host_args) == 4:              # round-2 pack_operands: no PJ
-        host_args = (host_args[0], host_args[1], host_args[2],
-                     _proj_table_np(W, M), host_args[3])
-    elif len(host_args) == 5:            # round-3: (..., pend, P, R0) —
-        # the harness kernels recompute pend from slot_ops, so drop it
-        # and insert the projection table the matmul variants expect
-        host_args = (host_args[0], host_args[1], host_args[3],
-                     _proj_table_np(W, M), host_args[4])
+    # pack_operands layout (round 4): (ret_slot, slot_ops, P, R0) —
+    # pend is derived on device. Insert the projection table the
+    # matmul ablation variants expect between slot_ops and P.
+    ret_slot_h, slot_ops_h, P_h, R0_h = host_args
+    host_args = (ret_slot_h, slot_ops_h, P_h,
+                 _proj_table_np(W, M), R0_h)
     dargs = jax.device_put(host_args)
     names = args.variants.split(",")
     runs = {}
