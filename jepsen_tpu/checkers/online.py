@@ -397,6 +397,11 @@ class IncrementalEngine:
         self.R = R_final
         self.settled_returns += dead + 1
         self.walked_events += dead + 1
+        # items[dead+1:] were dequeued but never walked; they are NOT
+        # re-queued because a violation is terminal for this engine
+        # (every later advance() short-circuits on self.violation, and
+        # there is deliberately no reset/continue path — a monitor that
+        # has proven non-linearizability has nothing more to decide)
         self.violation = self._violation_at(items[dead][0], R_final)
         return self.violation
 

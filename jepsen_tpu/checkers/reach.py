@@ -1015,31 +1015,20 @@ def _check_many_keyed(model, rss, preps, live, results, packed_list,
     return results
 
 
-def _check_many_native(model: Model,
-                       packed_list: Sequence[h.PackedHistory],
-                       max_states: int, max_slots: int, max_dense: int,
-                       t0: float) -> Optional[List[Dict[str, Any]]]:
-    """Uniform-workload fast lane for :func:`check_many`: ONE union
-    memo + ONE batched native preprocessing call
-    (``preproc_native.build_keyed``) replace the per-key
-    memo-signature/BFS-projection/event-build/ctypes pipeline that cost
-    ~2 s of host time at 4096 keys. The union alphabet serves every key
-    (per-key memos are only needed for failure witnesses, decoded
-    lazily per failed key). Returns the results list, or None to fall
-    through to the general path (native lib unavailable, union
-    explosion, kernel budgets exceeded, or too few returns to beat the
-    XLA batch). Raises :class:`~jepsen_tpu.checkers.events.ConcurrencyOverflow`
-    exactly where the per-key path would (a key needing > max_slots)."""
-    from jepsen_tpu.checkers import preproc_native, reach_pallas
+def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
+                live: Sequence[int], max_states: int, max_slots: int):
+    """Shared union-alphabet native preprocessing for the batched
+    device engines (keyed kernel and the lockstep batch kernel): ONE
+    memo over the union of every history's op alphabet + ONE native
+    call building every history's slotted return stream. Returns None
+    when the union explodes, ops are unhashable, the native lib is
+    missing, the kernels' dense budgets don't fit, or a history
+    overflows max_slots under the union memo's coarser noop
+    classification (callers fall back to per-history paths, whose
+    per-key noop dropping may still fit — and which raise
+    ConcurrencyOverflow on genuine overflow)."""
+    from jepsen_tpu.checkers import preproc_native
 
-    if not (_use_pallas() and preproc_native.available()):
-        return None
-    live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
-    total_returns = sum(packed_list[i].n_ok for i in live)
-    if not live or total_returns < _PALLAS_MIN_RETURNS:
-        return None
-    # one memo over the union of every key's alphabet (op identities
-    # precomputed at pack time — no hashable() recomputation per key)
     union: Dict[Any, int] = {}
     union_ops: List[Op] = []
     try:
@@ -1079,17 +1068,154 @@ def _check_many_native(model: Model,
         return None
     ret_flat, ops_wide, pend, key_W, key_R, ret_entry_flat, R_tot = built
     if (key_W < 0).any():
-        raise ev.ConcurrencyOverflow(
-            f"history needs >{max_slots} pending-op slots")
+        # slot overflow under the UNION memo's noop classification —
+        # which drops a SUBSET of what per-key memos drop (union-noop
+        # ⊆ per-key-noop), so a key near the max_slots boundary can
+        # overflow here yet fit the general per-key path. Fall through
+        # and let per-key noop dropping get its chance; if the history
+        # genuinely needs more slots, the per-key build raises
+        # ConcurrencyOverflow there.
+        return None
     W = max(int(key_W.max()), 1)
     M = 1 << W
     if not (_fast_ok(S_pad, W, M, memo_u.n_ops)
             and _pallas_fits(S_pad, M, memo_u.n_ops)):
         return None                     # general path may still fit
     ops_flat = np.ascontiguousarray(ops_wide[:, :W])
-    key_flat = np.repeat(np.arange(len(live), dtype=np.int32), key_R)
     offsets = np.concatenate([[0], np.cumsum(key_R)])
     P = _build_P(memo_u, S_pad)
+    return (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
+            offsets, opid_cat, crs_cat, offs, noop_op)
+
+
+# histories per lockstep dispatch: the blocked-diagonal fire operand
+# grows O(H^2) in VMEM (2*HS*W*HS f32 = 160 KB at H=8, W=5, S=8) and
+# the per-return gather does H*W tile writes, so larger requests are
+# chunked into groups of this size
+_BATCH_GROUP = 8
+
+
+def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
+                max_states: int = 100_000, max_slots: int = 20,
+                max_dense: int = 1 << 22,
+                group: int = _BATCH_GROUP) -> List[Dict[str, Any]]:
+    """Check SEVERAL complete histories at once on the lockstep batch
+    kernel (:mod:`jepsen_tpu.checkers.reach_batch`): the config sets of
+    up to ``group`` histories advance together, one return index per
+    step, so the per-issue latency wall of the sequential walk is paid
+    once per step instead of once per history — measured ~3.5-4x the
+    C++ WGL engine's aggregate throughput on 8 x cas-100k (one chip vs
+    one core; BASELINE.md round-4 batch rung).
+
+    The natural fit is a Jepsen run that produced multiple large
+    histories (``test-count > 1``, per-node sub-histories, or repeated
+    soak iterations). Falls back to sequential :func:`check_packed`
+    per history whenever the lockstep gates don't hold (non-uniform
+    workloads whose union memo explodes, Pallas unavailable, > max
+    slots, tiny histories). Verdicts and witnesses are identical to
+    the sequential path (differentially tested). Upstream analogue:
+    none — knossos checks one history per run (SURVEY.md §2.2)."""
+    t0 = _time.monotonic()
+    results: List[Optional[Dict[str, Any]]] = [
+        {"valid": True, "engine": "reach-lockstep", "events": 0,
+         "time-s": 0.0} if (p.n == 0 or p.n_ok == 0) else None
+        for p in packed_list]
+    live = [i for i, r in enumerate(results) if r is None]
+    if not live:
+        return results  # type: ignore[return-value]
+    u = None
+    from jepsen_tpu.checkers import preproc_native
+    if _use_pallas() and preproc_native.available() and len(live) >= 2:
+        u = _union_prep(model, packed_list, live, max_states, max_slots)
+    if u is None:
+        for i in live:
+            results[i] = check_packed(model, packed_list[i],
+                                      max_states=max_states,
+                                      max_slots=max_slots,
+                                      max_dense=max_dense)
+        return results  # type: ignore[return-value]
+    (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
+     offsets, opid_cat, crs_cat, offs, noop_op) = u
+    from jepsen_tpu.checkers import reach_batch
+    dead = np.full(len(live), -1, np.int64)
+    try:
+        for g0 in range(0, len(live), group):
+            gk = list(range(g0, min(g0 + group, len(live))))
+            dead[gk] = reach_batch.walk_returns_batch(
+                P,
+                [ret_flat[offsets[k]:offsets[k + 1]] for k in gk],
+                [ops_flat[offsets[k]:offsets[k + 1]] for k in gk],
+                M)
+    except Exception as e:                              # noqa: BLE001
+        _warn_pallas_failed(repr(e))
+        for i in live:
+            results[i] = check_packed(model, packed_list[i],
+                                      max_states=max_states,
+                                      max_slots=max_slots,
+                                      max_dense=max_dense)
+        return results  # type: ignore[return-value]
+    elapsed = _time.monotonic() - t0
+    drop_cat = (crs_cat & noop_op[opid_cat]).astype(np.int64)
+    drop_per_key = np.add.reduceat(drop_cat, offs[:-1])
+    for k, i in enumerate(live):
+        p = packed_list[i]
+        dropped = int(drop_per_key[k])
+        if int(dead[k]) < 0:
+            results[i] = {
+                "valid": True, "engine": "reach-lockstep",
+                "events": (p.n - dropped) + int(key_R[k]),
+                "slots": int(key_W[k]), "states": memo_u.n_states,
+                "dropped-crashed-noops": dropped, "time-s": elapsed}
+        else:
+            # decode the failure in the history's LOCAL geometry with
+            # the full per-history pipeline (dead[k] is already a
+            # local return index)
+            local = int(dead[k])
+            memo_k, stream_k, _Tk, S_k, M_k = _prep(
+                model, p, max_states=max_states, max_slots=max_slots,
+                max_dense=max_dense)
+            rs_k = ev.returns_view(stream_k)
+            W_k = max(stream_k.W, 1)
+            results[i] = _result_invalid(
+                "reach-lockstep", stream_k, memo_k, p,
+                int(rs_k.ret_event[local]), elapsed)
+            _attach_witness(results[i], memo_k, rs_k,
+                            _build_P(memo_k, S_k), S_k, M_k, W_k,
+                            local, p)
+    return results  # type: ignore[return-value]
+
+
+def _check_many_native(model: Model,
+                       packed_list: Sequence[h.PackedHistory],
+                       max_states: int, max_slots: int, max_dense: int,
+                       t0: float) -> Optional[List[Dict[str, Any]]]:
+    """Uniform-workload fast lane for :func:`check_many`: ONE union
+    memo + ONE batched native preprocessing call
+    (``preproc_native.build_keyed``) replace the per-key
+    memo-signature/BFS-projection/event-build/ctypes pipeline that cost
+    ~2 s of host time at 4096 keys. The union alphabet serves every key
+    (per-key memos are only needed for failure witnesses, decoded
+    lazily per failed key). Returns the results list, or None to fall
+    through to the general path (native lib unavailable, union
+    explosion, kernel budgets exceeded, slot overflow under the union
+    memo's coarser noop classification, or too few returns to beat the
+    XLA batch); genuine > max_slots concurrency then raises
+    :class:`~jepsen_tpu.checkers.events.ConcurrencyOverflow` from the
+    per-key build."""
+    from jepsen_tpu.checkers import preproc_native, reach_pallas
+
+    if not (_use_pallas() and preproc_native.available()):
+        return None
+    live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
+    total_returns = sum(packed_list[i].n_ok for i in live)
+    if not live or total_returns < _PALLAS_MIN_RETURNS:
+        return None
+    u = _union_prep(model, packed_list, live, max_states, max_slots)
+    if u is None:
+        return None
+    (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
+     offsets, opid_cat, crs_cat, offs, noop_op) = u
+    key_flat = np.repeat(np.arange(len(live), dtype=np.int32), key_R)
     try:
         from jepsen_tpu.checkers import reach_lane
         dead = reach_lane.walk_returns_keyed(

@@ -1,0 +1,356 @@
+"""Lockstep-batched lane kernel: H independent histories advance
+through the dense-reachability returns walk TOGETHER, one return index
+per step, with their config sets side by side along the lane axis.
+
+Why: the single-history walk (``reach_lane``) is a sequential chain of
+tiny [M,S]@[S,W*S] matmuls — per-ISSUE latency bound, with the MXU and
+VPU almost idle (MFU ~0.04%). Checking a BATCH of histories one after
+another pays that latency wall H times. Lockstep batching pays it once:
+
+- config sets live as ONE array ``R [M, H*S]`` (history h owns lane
+  block ``h*S:(h+1)*S``);
+- the per-return fire matmul becomes ONE ``[M, H*S] @ [H*S, W*H*S]``
+  issue against a BLOCK-DIAGONAL transition operand (history h's
+  pending ops in rows ``h*S:(h+1)*S``, slot-major column blocks), so
+  the off-diagonal zero blocks guarantee no cross-history terms and
+  the MXU amortizes one issue over H histories;
+- every VPU op (fire blends, projection) operates on ``[M, H*S]``
+  lanes — H× the lane utilization of the single-history kernel;
+- the pending-count gate ladder (see ``reach_lane._ladder_fire``) is
+  gated by ``max_h c_r(h)`` — ≥ each history's own bound, so the walk
+  stays EXACT per history (extra passes past a history's fixpoint are
+  idempotent).
+
+Projection is per-history (different slots return at the same step, or
+none: identity): a pre-expanded per-return lane row ``jv [H*S]``
+(lane block h holds ``ret_slot_h`` as f32) turns the W static
+projections + identity into W+1 batched blend terms with lane-wise
+0/1 indicator multiplies — the same blend trick as the single kernel,
+vectorized across the batch.
+
+Death detection mirrors the lane kernel: per-block checkpoints of the
+whole batched set, host-side per-history localization, and an exact
+single-history block re-walk (``reach_lane._refine_dead``) only for
+histories that died. Histories are independent throughout — verdicts
+and dead indices are bit-identical to running the single-history walk
+H times (differentially tested in ``tests/test_reach_batch.py``).
+
+Upstream analogue: none — knossos checks one history per JVM run; this
+is the TPU-native answer to "a Jepsen run produced several large
+histories" (e.g. ``test-count > 1`` or per-node sub-histories), and
+the engine behind the ``cas-100k x 8`` benchmark rung. Reference
+behavior being reproduced: knossos.wgl per-history semantics
+(SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu.checkers.reach_lane import (_BLOCK, _FAST_PASSES,
+                                            _idx_dtype, _refine_dead)
+
+# segments for the put+dispatch pipeline (one fetch; transfers of
+# segment i+1 stream while the device walks segment i) — the batch
+# operand set is H× the single-history one, so overlap matters more
+_PIPE_NSEG = 4
+
+
+def _one_fire_pass_b(R, G_all, W: int, M: int, HS: int):
+    """One Jacobi fire pass over the batched set: ONE fused
+    ``[M,HS] @ [HS, W*HS]`` matmul (block-diagonal G ⇒ history h's
+    image depends only on history h's set), then the per-slot mask
+    blends on the M axis — identical math to
+    ``reach_pallas._one_fire_pass`` with S widened to H*S lanes."""
+    import jax.numpy as jnp
+
+    F = jnp.dot(R, G_all, preferred_element_type=jnp.float32)
+    for jj in range(W):
+        Fj = F[:, jj * HS:(jj + 1) * HS]
+        half, blk = M >> (jj + 1), 1 << jj
+        Rr = R.reshape(half, 2, blk, HS)
+        Fr = Fj.reshape(half, 2, blk, HS)
+        hi = jnp.maximum(
+            Rr[:, 1], (Fr[:, 0] > 0.5).astype(jnp.float32))
+        R = jnp.stack([Rr[:, 0], hi], axis=1).reshape(M, HS)
+    return R
+
+
+def _ladder_fire_b(R_scr, R, pend_c, G_all, n_pass: int, W: int,
+                   M: int, HS: int):
+    """Gate-ladder closure on the batched set, gated by the batch-max
+    pending count (exact per history: extra passes are idempotent)."""
+    from jax.experimental import pallas as pl
+
+    R = _one_fire_pass_b(R, G_all, W, M, HS)
+    if n_pass <= 1:
+        return R
+    R_scr[:] = R
+    for off in range(1, n_pass):
+        def _deep():
+            Rd = R_scr[:]
+            R_scr[:] = _one_fire_pass_b(Rd, G_all, W, M, HS)
+        pl.when(pend_c > off)(_deep)
+    return R_scr[:]
+
+
+def _gather_G_b(slot_ops_ref, P_ref, k: int, W: int, H: int, S: int,
+                O1: int, G_scr, buf):
+    """Write return ``k``'s H*W pending-op transition tiles onto the
+    diagonal blocks of ``G_scr[buf]`` (slot-major column blocks; the
+    off-diagonal blocks were zeroed once at step 0 and are never
+    written, preserving history independence). Slot -1 → the all-zero
+    sentinel row of P."""
+    import jax.numpy as jnp
+
+    HS = H * S
+    for hh in range(H):
+        for jj in range(W):
+            o = slot_ops_ref[(k * H + hh) * W + jj]
+            o = jnp.where(o < 0, O1 - 1, o)
+            G_scr[buf, hh * S:(hh + 1) * S,
+                  jj * HS + hh * S:jj * HS + (hh + 1) * S] = P_ref[o]
+
+
+def _make_batch_kernel(B: int, W: int, M: int, S: int, H: int,
+                       O1: int, n_blocks: int, n_pass: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    HS = H * S
+
+    def kernel(slot_ops_ref, pendmax_ref, jv_ref, P_ref, R0_ref,
+               ckpt_ref, final_ref, R_scr, G_scr):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            R_scr[:] = R0_ref[:]
+            # zero once: diagonal blocks are overwritten per return,
+            # off-diagonal blocks stay zero forever (the independence
+            # guarantee of the batched fire matmul)
+            G_scr[:] = jnp.zeros_like(G_scr)
+
+        ckpt_ref[0] = R_scr[:]                   # set at block START
+        _gather_G_b(slot_ops_ref, P_ref, 0, W, H, S, O1, G_scr, 0)
+
+        def one(k, R):
+            G_all = G_scr[k % 2]
+            # prefetch the NEXT return's operand while this return's
+            # MXU chain is in flight (G does not depend on R)
+            kn = jnp.minimum(k + 1, B - 1)
+            _gather_G_b(slot_ops_ref, P_ref, kn, W, H, S, O1, G_scr,
+                        (k + 1) % 2)
+            R = _ladder_fire_b(R_scr, R, pendmax_ref[k], G_all, n_pass,
+                               W, M, HS)
+            # per-history projection blend: lane row jv holds each
+            # history's returning slot (-1 = none) replicated over its
+            # S lanes
+            row = jv_ref[k]                      # [HS] f32
+            acc = R * (row < 0).astype(jnp.float32)
+            for jj in range(W):
+                half, blk = M >> (jj + 1), 1 << jj
+                Rr = R.reshape(half, 2, blk, HS)
+                taken = Rr[:, 1]
+                proj = jnp.stack([taken, jnp.zeros_like(taken)],
+                                 axis=1).reshape(M, HS)
+                acc = acc + proj * (row == jj).astype(jnp.float32)
+            return acc
+
+        def do_return(i, _):
+            R_scr[:] = one(i, R_scr[:])
+            return 0
+
+        jax.lax.fori_loop(0, B, do_return, 0)
+
+        @pl.when(step == n_blocks - 1)
+        def _finish():
+            final_ref[:] = R_scr[:]
+
+    return kernel
+
+
+@functools.cache
+def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
+                R_pad: int, n_pass: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HS = H * S
+    n_blocks = R_pad // B
+    kernel = _make_batch_kernel(B, W, M, S, H, O1, n_blocks, n_pass)
+    call = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((B * H * W,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((B, HS), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((O1, S, S), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, HS), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, M, HS), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((M, HS), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_blocks, M, HS), jnp.float32),
+            jax.ShapeDtypeStruct((M, HS), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((M, HS), jnp.float32),
+            pltpu.VMEM((2, HS, W * HS), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+
+    def run(slot_ops, ret_slot_rh, P, R0):
+        # device-side derivations (the wire carries only narrow ints):
+        # batch-max pending count per return gates the ladder; the
+        # projection lane row expands each history's returning slot
+        # over its S lanes
+        ops32 = slot_ops.astype(jnp.int32)
+        pend = jnp.sum((ops32.reshape(-1, H, W) >= 0).astype(jnp.int32),
+                       axis=2)
+        pendmax = jnp.max(pend, axis=1)
+        jv = jnp.repeat(ret_slot_rh.astype(jnp.float32), S, axis=1)
+        return call(ops32, pendmax, jv, P, R0)
+
+    return jax.jit(run)
+
+
+def pack_batch_operands(P: np.ndarray, ret_slots: List[np.ndarray],
+                        slot_ops: List[np.ndarray], M: int, *,
+                        interpret: bool = False):
+    """Marshal H per-history return streams into the lockstep layout:
+    all padded (identity rows: slot -1) to one bucketed ``R_pad``, then
+    interleaved return-major — ``slot_ops_flat[(r*H + h)*W + jj]`` and
+    ``ret_slot_rh[r, h]`` — so one SMEM/VMEM block holds a contiguous
+    run of lockstep steps. Returns ``(geom, host_args, R_lens)``."""
+    from jepsen_tpu.checkers.reach import _bucket
+
+    O1, S, _ = P.shape
+    H = len(ret_slots)
+    W = max(int(so.shape[1]) for so in slot_ops)
+    B = min(32, _BLOCK) if interpret else _BLOCK
+    R_max = max(1, max(int(r.shape[0]) for r in ret_slots))
+    R_pad = max(B, _bucket(-(-R_max // B) * B, B))
+    rs_rh = np.full((R_pad, H), -1, np.int8)
+    ops_rhw = np.full((R_pad, H, W), -1, np.int32)
+    for h in range(H):
+        n = int(ret_slots[h].shape[0])
+        rs_rh[:n, h] = ret_slots[h]
+        ops_rhw[:n, h, :slot_ops[h].shape[1]] = slot_ops[h]
+    idx_dt = _idx_dtype(O1)
+    R0 = np.zeros((M, H * S), np.float32)
+    for h in range(H):
+        R0[0, h * S] = 1.0                   # mask 0, state 0 per block
+    host_args = (np.ascontiguousarray(ops_rhw.reshape(-1), idx_dt),
+                 np.ascontiguousarray(rs_rh),
+                 np.ascontiguousarray(P, np.float32),
+                 R0)
+    geom = (B, W, M, S, H, O1, R_pad)
+    return geom, host_args, [int(r.shape[0]) for r in ret_slots]
+
+
+def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
+                 dsegs: dict):
+    """Segmented put+dispatch pipeline for the batch walk (same shape
+    as ``reach_lane._pipe_walk``): no intermediate fetch, cached device
+    segments for rescue reuse."""
+    import jax
+
+    from jepsen_tpu.checkers.reach_lane import _pipe_geom
+
+    B, W, M, S, H, O1, R_pad = geom
+    ops_flat, rs_rh, P, R0 = host_args
+    seg, nseg = _pipe_geom(B, R_pad)
+    run = _batch_call(B, W, M, S, H, O1, seg, n_pass, interpret)
+    fresh = "segs" not in dsegs
+    if fresh:
+        dsegs["dP"] = jax.device_put(P)
+        dsegs["dR0"] = jax.device_put(R0)
+        dsegs["segs"] = []
+    R_cur = dsegs["dR0"]
+    ckpts = []
+    HW = H * W
+    for i in range(nseg):
+        if fresh:
+            lo, hi = i * seg, min((i + 1) * seg, R_pad)
+            o_seg = ops_flat[lo * HW:hi * HW]
+            r_seg = rs_rh[lo:hi]
+            if hi - lo < seg:                # ragged tail: identity pad
+                o_seg = np.pad(o_seg, (0, (seg - (hi - lo)) * HW),
+                               constant_values=-1)
+                r_seg = np.pad(r_seg, ((0, seg - (hi - lo)), (0, 0)),
+                               constant_values=-1)
+            dsegs["segs"].append(jax.device_put(
+                (np.ascontiguousarray(o_seg),
+                 np.ascontiguousarray(r_seg))))
+        a, b = dsegs["segs"][i]
+        ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+        ckpts.append(ck)
+    return ckpts, R_cur
+
+
+def walk_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
+                       slot_ops: List[np.ndarray], M: int, *,
+                       interpret: bool = False) -> np.ndarray:
+    """Walk H independent return streams in lockstep; returns
+    ``dead[H]`` — per history, the first return index at which its
+    config set emptied, or -1 if linearizable. Exact: capped fast
+    ladder first (sound for "valid"), per-history exact rescue +
+    block-checkpoint refinement on death, identical verdicts and
+    indices to H single-history walks."""
+    geom, host_args, R_lens = pack_batch_operands(
+        P, ret_slots, slot_ops, M, interpret=interpret)
+    B, W, M, S, H, O1, R_pad = geom
+    n_fast = min(W, _FAST_PASSES)
+    dsegs: dict = {}
+    ckpts, final = _pipe_walk_b(host_args, geom, n_fast, interpret,
+                                dsegs)
+    final_np = np.asarray(final)                 # the ONE round-trip
+    HS = H * S
+    alive = np.array([final_np[:, h * S:(h + 1) * S].any()
+                      for h in range(H)])
+    if not alive.all() and n_fast < W:
+        # capped-ladder deaths may be false: decide with the exact
+        # W-pass walk (reuses the uploaded device segments)
+        ckpts, final = _pipe_walk_b(host_args, geom, W, interpret,
+                                    dsegs)
+        final_np = np.asarray(final)
+        alive = np.array([final_np[:, h * S:(h + 1) * S].any()
+                          for h in range(H)])
+    dead = np.full(H, -1, np.int64)
+    if alive.all():
+        return dead
+    # localization: fetch the block checkpoints once, then re-walk the
+    # death block of each dead history in ITS OWN geometry
+    ckpt_np = np.concatenate([np.asarray(c) for c in ckpts])
+    n_blocks = R_pad // B
+    ckpt_np = ckpt_np[:n_blocks]                 # [blocks, M, HS]
+    ops_rhw = np.asarray(host_args[0]).reshape(R_pad, H, W)
+    rs_rh = host_args[1]
+    for h in np.nonzero(~alive)[0]:
+        col = ckpt_np[:, :, h * S:(h + 1) * S]   # [blocks, M, S]
+        occ = col.reshape(n_blocks, -1).any(axis=1)
+        first_empty = int(np.argmin(occ)) if not occ.all() else n_blocks
+        blk = max(0, first_empty - 1)
+        dead[h] = _refine_dead(
+            P, W, M,
+            np.ascontiguousarray(rs_rh[:, h].astype(np.int32)),
+            np.ascontiguousarray(ops_rhw[:, h, :]),
+            col[blk].T > 0.5, blk * B,
+            min(B, max(1, R_lens[h] - blk * B)))
+    return dead

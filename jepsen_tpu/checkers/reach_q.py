@@ -259,7 +259,13 @@ def _run_segments(P_np, xor_cols, bitmask, digit, src, gids, ret_slot,
         G = caps.shape[1]
         seg_caps = np.zeros((L_pad, G), np.int32)
         seg_caps[:n] = caps[base:base + n]
-        seg_caps[n:] = caps[base + n - 1]        # idempotent pad rows
+        # identity-padded tail rows (slot -1, ops -1) still execute
+        # crashed-group fires gated by the LAST REAL return's caps.
+        # This is sound and load-bearing: caps are non-decreasing and
+        # group fires are monotone, so anything a pad-row fire adds is
+        # a subset of the next real return's fixpoint closure — pad
+        # fires can never flip emptiness nor resurrect an empty set.
+        seg_caps[n:] = caps[base + n - 1]
         ptr, R_cur, alive = walk(
             dP, dxc, dbm, ddig, dsrc, dg, jnp.asarray(seg_slot),
             jnp.asarray(seg_ops), jnp.asarray(seg_caps), R_cur)

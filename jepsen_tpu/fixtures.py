@@ -201,8 +201,14 @@ def gen_packed(kind: str = "cas", n_ops: int = 100, processes: int = 5,
     from jepsen_tpu.util import hashable
 
     kinds = {"register": 0, "cas": 1}
+    if seed is None:
+        # match gen_history(seed=None): fresh randomness per call (a
+        # fixed fallback seed would silently return identical
+        # histories from repeated seedless calls)
+        import random as _random
+        seed = _random.SystemRandom().randrange(1 << 31)
     native = (preproc_native.gen_history(
-        seed if seed is not None else 0, n_ops, processes, values,
+        seed, n_ops, processes, values,
         kinds[kind]) if kind in kinds else None)
     if native is None:
         return h.pack(gen_history(kind, n_ops=n_ops, processes=processes,

@@ -1,0 +1,158 @@
+"""Differential tests for the lockstep batch kernel
+(:mod:`jepsen_tpu.checkers.reach_batch`, interpret mode on CPU; on TPU
+it backs :func:`reach.check_batch` and the ``cas-100k x 8`` benchmark
+rung). Histories in a batch are independent — verdicts AND dead
+indices must be bit-identical to running the single-history lane walk
+per history."""
+import functools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from jepsen_tpu import fixtures, models
+from jepsen_tpu.checkers import events as ev
+from jepsen_tpu.checkers import reach, reach_batch, reach_lane
+from jepsen_tpu.history import pack
+
+
+def _batch_operands(model, hists):
+    """Union-alphabet per-history streams via the same `_keyed_operands`
+    route the keyed tests use."""
+    packed = [pack(h) for h in hists]
+    preps = [reach._prep(model, p, max_states=100_000, max_slots=20,
+                         max_dense=1 << 22) for p in packed]
+    live = list(range(len(packed)))
+    W = max(max(p[1].W, 1) for p in preps)
+    M = 1 << W
+    rss = [ev.returns_view(p[1]) for p in preps]
+    P, ret_flat, ops_flat, _key_flat, offsets, _wide = \
+        reach._keyed_operands(model, packed, rss, live, W, 100_000)
+    ret_slots = [ret_flat[offsets[k]:offsets[k + 1]]
+                 for k in range(len(packed))]
+    slot_ops = [ops_flat[offsets[k]:offsets[k + 1]]
+                for k in range(len(packed))]
+    return packed, P, ret_slots, slot_ops, M
+
+
+@pytest.mark.parametrize("kind,model_fn", [
+    ("cas", models.cas_register),
+    ("register", models.register),
+    ("mutex", models.mutex),
+])
+def test_batch_matches_single_walk(kind, model_fn):
+    model = model_fn()
+    hists = []
+    corrupted = 0
+    for seed in range(6):
+        h = fixtures.gen_history(kind, n_ops=90, processes=3, seed=seed)
+        if seed in (1, 4):
+            try:
+                h = fixtures.corrupt(h, seed=seed)
+                corrupted += 1
+            except ValueError:
+                pass                     # e.g. mutex with no ok reads
+        hists.append(h)
+    packed, P, ret_slots, slot_ops, M = _batch_operands(model, hists)
+    dead = reach_batch.walk_returns_batch(P, ret_slots, slot_ops, M,
+                                          interpret=True)
+    invalid = 0
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        assert (dead[k] < 0) == bool(ref["valid"]), f"history {k}"
+        if dead[k] >= 0:
+            invalid += 1
+            R0 = np.zeros((P.shape[1], M), bool)
+            R0[0, 0] = True
+            d1, _ = reach_lane.walk_returns(
+                P, ret_slots[k], slot_ops[k], R0, interpret=True)
+            assert d1 == dead[k], f"history {k}: {d1} != {dead[k]}"
+    if corrupted:
+        assert invalid >= 1              # the corruptor did corrupt
+
+
+def test_batch_multisegment_ragged(monkeypatch):
+    """Long uneven histories: multi-segment pipeline, ragged tail, and
+    per-history death localization across segment boundaries."""
+    monkeypatch.setattr(reach_batch, "_BLOCK", 8, raising=False)
+    model = models.cas_register()
+    hists = [fixtures.gen_history("cas", n_ops=n, processes=4,
+                                  seed=100 + i)
+             for i, n in enumerate([300, 180, 260, 90])]
+    hists[2] = fixtures.corrupt(hists[2], seed=12)
+    packed, P, ret_slots, slot_ops, M = _batch_operands(model, hists)
+    geom, _, _ = reach_batch.pack_batch_operands(
+        P, ret_slots, slot_ops, M, interpret=True)
+    B, _W, _M, _S, _H, _O1, R_pad = geom
+    _seg, nseg = reach_lane._pipe_geom(B, R_pad)
+    assert nseg > 1
+    dead = reach_batch.walk_returns_batch(P, ret_slots, slot_ops, M,
+                                          interpret=True)
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        assert (dead[k] < 0) == bool(ref["valid"]), f"history {k}"
+
+
+def test_batch_rescue_path(monkeypatch):
+    """Capped fast ladder (1 pass) falsely kills deep-chain histories;
+    the exact rescue must restore the right verdict for every batch
+    member."""
+    monkeypatch.setattr(reach_batch, "_FAST_PASSES", 1)
+    model = models.cas_register()
+    hists = [fixtures.gen_history("cas", n_ops=80, processes=4,
+                                  seed=s) for s in range(3)]
+    hists[1] = fixtures.corrupt(hists[1], seed=3)
+    packed, P, ret_slots, slot_ops, M = _batch_operands(model, hists)
+    dead = reach_batch.walk_returns_batch(P, ret_slots, slot_ops, M,
+                                          interpret=True)
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        assert (dead[k] < 0) == bool(ref["valid"]), f"history {k}"
+
+
+def test_check_batch_end_to_end(monkeypatch):
+    """Public API: verdicts, witnesses, and dead events identical to
+    check_packed; groups split at _BATCH_GROUP; empty histories pass."""
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    monkeypatch.setattr(
+        reach_batch, "walk_returns_batch",
+        functools.partial(reach_batch.walk_returns_batch,
+                          interpret=True))
+    model = models.cas_register()
+    hists = []
+    for seed in range(10):
+        h = fixtures.gen_history("cas", n_ops=120, processes=4,
+                                 seed=seed)
+        if seed in (2, 5, 7):
+            h = fixtures.corrupt(h, seed=seed)
+        hists.append(h)
+    packed = [pack(h) for h in hists] + [pack([])]
+    res = reach.check_batch(model, packed)
+    assert res[-1]["valid"] is True      # empty history
+    n_bad = 0
+    for i, p in enumerate(packed[:-1]):
+        ref = reach.check_packed(model, p)
+        assert res[i]["valid"] == ref["valid"], f"history {i}"
+        assert res[i]["engine"] == "reach-lockstep"
+        if not ref["valid"]:
+            n_bad += 1
+            assert res[i].get("dead-event") == ref.get("dead-event")
+            assert "witness" in res[i] or "final-configs" in res[i]
+    assert n_bad >= 2
+
+
+def test_check_batch_fallback_without_native(monkeypatch):
+    """When the lockstep gates fail (pallas off), check_batch must
+    fall back to per-history check_packed with identical verdicts."""
+    monkeypatch.setattr(reach, "_use_pallas", lambda: False)
+    model = models.register()
+    hists = [fixtures.gen_history("register", n_ops=40, processes=3,
+                                  seed=s) for s in range(3)]
+    hists[0] = fixtures.corrupt(hists[0], seed=1)
+    packed = [pack(h) for h in hists]
+    res = reach.check_batch(model, packed)
+    for i, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        assert res[i]["valid"] == ref["valid"]

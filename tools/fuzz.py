@@ -131,6 +131,48 @@ def run_trial(params, seed: int, *, pallas: bool = False):
                 verdicts["reach-lane"] = dead2 < 0
             except Exception as e:                      # noqa: BLE001
                 verdicts["reach-lane"] = f"skipped: {type(e).__name__}"
+        # lockstep batch kernel: walk THIS history alongside a fresh
+        # companion of the same workload (heterogeneous lockstep — the
+        # cross-history-independence property under test). The entry
+        # mirrors the main verdict; a companion whose lockstep verdict
+        # disagrees with its own reference FLIPS it so the mismatch
+        # machinery fires.
+        try:
+            from jepsen_tpu import fixtures as fx
+            from jepsen_tpu.checkers import reach_batch
+            from jepsen_tpu.history import pack as _pack
+            h2 = fx.gen_history(params["kind"],
+                                n_ops=params["n_ops"],
+                                processes=params["processes"],
+                                seed=seed + 7_777_777)
+            if params.get("corrupt") and seed % 2:
+                try:
+                    h2 = fx.corrupt(h2, seed=seed + 1)
+                except ValueError:
+                    pass
+            packed2 = _pack(h2)
+            ref2 = reach.check_packed(model, packed2)["valid"]
+            pair = [packed, packed2]
+            preps = [reach._prep(model, p, max_states=100_000,
+                                 max_slots=20, max_dense=1 << 22)
+                     for p in pair]
+            Wp = max(max(pr[1].W, 1) for pr in preps)
+            Mp = 1 << Wp
+            rss = [ev.returns_view(pr[1]) for pr in preps]
+            Pp, ret_flat, ops_flat, _kf, offsets, _wide = \
+                reach._keyed_operands(model, pair, rss, [0, 1], Wp,
+                                      100_000)
+            deadb = reach_batch.walk_returns_batch(
+                Pp,
+                [ret_flat[offsets[k]:offsets[k + 1]] for k in (0, 1)],
+                [ops_flat[offsets[k]:offsets[k + 1]] for k in (0, 1)],
+                Mp, interpret=True)
+            main_v = bool(deadb[0] < 0)
+            companion_ok = (deadb[1] < 0) == (ref2 is True)
+            verdicts["reach-batch"] = (main_v if companion_ok
+                                       else not main_v)
+        except Exception as e:                          # noqa: BLE001
+            verdicts["reach-batch"] = f"skipped: {type(e).__name__}"
     # the incremental monitor is a third implementation of the dense
     # walk (host NumPy, settled-prefix advance): feed it the raw stream
     try:
