@@ -86,15 +86,39 @@ class TrackingLockClient(LockClient):
 def mutex_test(mode: str = "linearizable", *, time_limit: float = 5.0,
                concurrency: int = 5, seed: Optional[int] = None,
                with_nemesis: bool = True, store: bool = False,
-               nemesis_interval: float = 0.5,
+               nemesis_interval: float = 0.5, lease_ttl: float = 30.0,
                algorithm: str = "auto", nodes: Any = 5) -> Dict[str, Any]:
+    """Modes: ``linearizable`` (safe), ``sloppy`` (split-brain grants,
+    caught via partitions), ``leases`` (lease-based lock — safe under
+    synchronized clocks, broken by clock skew: the nemesis becomes
+    :func:`jepsen_tpu.nemesis.clock_nemesis` bumping one node's clock
+    past the TTL each cycle, the canonical ``bump-time`` fault)."""
+    import random as _random
+
     node_names = util.node_names(nodes)
-    svc = FakeLockService(node_names, mode=mode, seed=seed)
+    svc = FakeLockService(node_names, mode=mode, seed=seed,
+                          lease_ttl=lease_ttl)
     client_gen = g.TimeLimit(time_limit, g.Stagger(0.001, LockWorkload(),
                                                    seed=seed))
     nem: Optional[nemesis.Nemesis] = None
     generator: g.GenLike = client_gen
-    if with_nemesis:
+    if with_nemesis and mode == "leases":
+        # clock-fault nemesis: bump a random node far past the lease
+        # TTL, later reset — while bumped, that node judges every lease
+        # expired and double-grants
+        nem = nemesis.clock_nemesis()
+        rng = _random.Random(seed)
+        bump_ms = int(lease_ttl * 2000)
+
+        def _cycle():
+            node = rng.choice(node_names)
+            return g.Seq([{"f": "bump", "value": {node: bump_ms}},
+                          {"sleep": nemesis_interval},
+                          {"f": "reset"},
+                          {"sleep": nemesis_interval}])
+
+        generator = g.clients_gen(client_gen, g.cycle(_cycle))
+    elif with_nemesis:
         nem = nemesis.partition_random_halves(seed=seed)
         generator = g.clients_gen(client_gen, g.cycle(lambda: g.Seq(
             [{"f": "start"}, {"sleep": nemesis_interval},

@@ -1,0 +1,200 @@
+"""Clock-fault coverage (SURVEY.md §2.1 clock row — upstream
+``jepsen.nemesis.time`` + ``resources/bump-time.c``, ``nemesis/
+clock-scrambler``, ``jepsen.faketime``): the nemeses' command streams
+against :class:`~jepsen_tpu.control.FakeRemote`, the in-process
+``bump_clock`` against the fake cluster, faketime wrappers, and the
+end-to-end lease-lock story — clock skew breaking a lease-based lock
+and the checker catching the violation."""
+import pytest
+
+from jepsen_tpu import control, faketime, nemesis
+from jepsen_tpu.fake.cluster import FakeCluster
+from jepsen_tpu.fake.lock import FakeLockService
+from jepsen_tpu.op import Op, invoke
+
+
+def _test_map(remote, nodes=("n1", "n2", "n3")):
+    return {"nodes": list(nodes), "remote": remote,
+            "username": "root", "_sessions": {}}
+
+
+def _nem_op(f, value=None):
+    return Op(process="nemesis", type="info", f=f, value=value)
+
+
+# -- ClockNemesis (bump-time) ------------------------------------------------
+
+def test_clock_nemesis_install_compiles_helper():
+    remote = control.FakeRemote()
+    test = _test_map(remote)
+    nem = nemesis.clock_nemesis()
+    nem.install(test)
+    # every node got the source uploaded and gcc-compiled
+    up_nodes = {n for n, _l, r in remote.uploads
+                if r == "/opt/jepsen/bump-time.c"}
+    assert up_nodes == set(test["nodes"])
+    for node in test["nodes"]:
+        cmds = [c for n, c in remote.commands if n == node]
+        assert any("mkdir" in c and "/opt/jepsen" in c for c in cmds)
+        assert any("gcc" in c and "bump-time.c" in c for c in cmds)
+
+
+def test_clock_nemesis_bump_strobe_reset_command_stream():
+    remote = control.FakeRemote()
+    test = _test_map(remote)
+    nem = nemesis.clock_nemesis()
+    res = nem.invoke(test, _nem_op("bump", {"n2": 500, "n3": -250}))
+    assert res.type == "info"
+    bumped = [(n, c) for n, c in remote.commands if "bump-time" in c]
+    assert any(n == "n2" and f"{nem.HELPER} bump 500" in c
+               for n, c in bumped)      # sudo-wrapped
+    assert any(n == "n3" and "bump" in c and "-250" in c
+               for n, c in bumped)
+    remote.commands.clear()
+    nem.invoke(test, _nem_op("strobe", {"nodes": ["n1"], "delta-ms": 100,
+                                        "period-ms": 5,
+                                        "duration-ms": 50}))
+    strobes = [(n, c) for n, c in remote.commands if "strobe" in c]
+    assert len(strobes) == 1 and strobes[0][0] == "n1"
+    assert all(tok in strobes[0][1] for tok in ("100", "5", "50"))
+    remote.commands.clear()
+    nem.invoke(test, _nem_op("reset"))
+    resets = [n for n, c in remote.commands if "reset" in c]
+    assert set(resets) == set(test["nodes"])
+
+
+def test_clock_nemesis_bumps_fake_cluster_skew():
+    cluster = FakeCluster(("n1", "n2", "n3"))
+    test = {"nodes": ["n1", "n2", "n3"], "cluster": cluster}
+    nem = nemesis.clock_nemesis()
+    nem.invoke(test, _nem_op("bump", {"n2": 60_000}))
+    assert cluster.nodes["n2"].clock_skew == pytest.approx(60.0)
+    assert cluster.nodes["n1"].clock_skew == 0.0
+    nem.invoke(test, _nem_op("reset"))
+    assert cluster.nodes["n2"].clock_skew == 0.0
+
+
+# -- ClockScrambler ----------------------------------------------------------
+
+def test_clock_scrambler_command_stream():
+    remote = control.FakeRemote()
+    test = _test_map(remote)
+    nem = nemesis.clock_scrambler(dt=60.0, seed=7)
+    res = nem.invoke(test, _nem_op("start"))
+    assert res.type == "info"
+    shifts = res.value["clock-shift-s"]
+    assert set(shifts) == set(test["nodes"])
+    assert all(isinstance(v, int) and v != 0 for v in shifts.values())
+    date_cmds = [(n, c) for n, c in remote.commands if "date -s" in c]
+    assert {n for n, _ in date_cmds} == set(test["nodes"])
+    remote.commands.clear()
+    res = nem.invoke(test, _nem_op("stop"))
+    assert res.value == "clocks reset"
+    resets = [c for _n, c in remote.commands
+              if "ntpdate" in c or "chronyc" in c]
+    assert len(resets) == len(test["nodes"])
+
+
+def test_clock_scrambler_on_cluster_records_skews():
+    cluster = FakeCluster(("n1", "n2"))
+    test = {"nodes": ["n1", "n2"], "cluster": cluster}
+    nem = nemesis.clock_scrambler(dt=10.0, seed=3)
+    res = nem.invoke(test, _nem_op("start"))
+    for node, shift in res.value["clock-shift-s"].items():
+        # the reported shift is rounded to ms; the applied skew is exact
+        assert cluster.nodes[node].clock_skew == pytest.approx(
+            shift, abs=5e-4)
+    nem.invoke(test, _nem_op("stop"))
+    assert all(n.clock_skew == 0.0 for n in cluster.nodes.values())
+
+
+# -- faketime ----------------------------------------------------------------
+
+def test_faketime_env_and_wrap():
+    e = faketime.env("-30s", rate=1.1)
+    assert e["FAKETIME"] == "-30s x1.1"
+    assert e["LD_PRELOAD"].endswith("libfaketime.so.1")
+    assert e["FAKETIME_NO_CACHE"] == "1"
+    assert faketime.env("+2h")["FAKETIME"] == "+2h"
+    cmd = faketime.wrap("etcd --listen :2379", "+5m", rate=2.0)
+    assert cmd.startswith("faketime -f ")
+    assert "+5m x2.0" in cmd and cmd.endswith("etcd --listen :2379")
+
+
+def test_faketime_lib_path_found_and_missing():
+    remote = control.FakeRemote()          # every command succeeds
+    s = control.Session(remote=remote, node="n1")
+    assert faketime.lib_path(s) == faketime._LIBS[0]
+    remote2 = control.FakeRemote(responses={"test -e": (1, ""),
+                                            "find": (0, "")})
+    s2 = control.Session(remote=remote2, node="n1")
+    assert faketime.lib_path(s2) is None
+
+
+# -- lease lock vs clock skew ------------------------------------------------
+
+def test_lease_lock_safe_without_skew():
+    svc = FakeLockService(("n1", "n2", "n3"), mode="leases",
+                          lease_ttl=30.0)
+    assert svc.acquire("n1", "lock", "p0") is True
+    assert svc.acquire("n2", "lock", "p1") is False     # held, unexpired
+    assert svc.release("n2", "lock", "p1") is False     # not the holder
+    assert svc.release("n1", "lock", "p0") is True
+    assert svc.acquire("n2", "lock", "p1") is True
+
+
+def test_lease_lock_double_grants_under_skew():
+    """The canonical violation: bump n2's clock past the TTL and it
+    judges p0's lease expired — two live holders at once."""
+    svc = FakeLockService(("n1", "n2", "n3"), mode="leases",
+                          lease_ttl=30.0)
+    assert svc.acquire("n1", "lock", "p0") is True
+    svc.bump_clock("n2", 120.0)                         # 4x the TTL
+    assert svc.acquire("n1", "lock", "p1") is False     # honest node
+    assert svc.acquire("n2", "lock", "p1") is True      # skewed node!
+    svc.bump_clock("n2", None)
+    assert svc.acquire("n2", "lock", "p2") is False     # back to honest
+
+
+def test_checker_catches_lease_double_grant():
+    """The resulting history is non-linearizable under the mutex model
+    and every engine must say so."""
+    from jepsen_tpu import models
+    from jepsen_tpu.checkers import facade
+    from jepsen_tpu.op import ok
+
+    h = [invoke(0, "acquire"), ok(0, "acquire"),
+         invoke(1, "acquire"), ok(1, "acquire")]
+    res = facade.linearizable(models.mutex()).check(None, h)
+    assert res["valid"] is False
+
+
+def test_mutex_leases_end_to_end_harness():
+    """Full harness: the leases suite with the clock nemesis produces a
+    checker-caught violation (retried across seeds — the bump must land
+    while the lock is held, which the alternating workload makes near
+    certain within a couple of seconds)."""
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import mutex as mx
+
+    caught = False
+    for seed in (11, 12, 13):
+        test = mx.mutex_test("leases", time_limit=2.0, concurrency=4,
+                             seed=seed, store=False,
+                             nemesis_interval=0.3, lease_ttl=30.0)
+        done = core.run(test)
+        if done["results"]["valid"] is False:
+            caught = True
+            break
+    assert caught, "clock-skew double-grant never caught in 3 runs"
+
+
+def test_mutex_leases_valid_without_nemesis():
+    """Control: the lease lock with synchronized clocks is safe."""
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import mutex as mx
+
+    test = mx.mutex_test("leases", time_limit=1.0, concurrency=4,
+                         seed=5, store=False, with_nemesis=False)
+    done = core.run(test)
+    assert done["results"]["valid"] is True
