@@ -334,22 +334,14 @@ class IncrementalEngine:
             return None
         L = len(rows_list)
         W, M = self.W, 1 << self.W
-        S = R0.shape[0]
-        # bit-pack the mask axis: words[s] bit m = R0[s, m]
-        packed8 = np.packbits(R0, axis=1, bitorder="little")
-        n_words = max(1, -(-M // 64))
-        buf = np.zeros((S, n_words * 8), np.uint8)
-        buf[:, :packed8.shape[1]] = packed8
-        R_words = np.ascontiguousarray(buf).view(np.uint64)
+        R_words = _pack_words(R0, M)
         rows_arr = np.asarray(rows_list, np.int32).reshape(L, W)
         dead = preproc_native.walk_dense(
             self.memo.table, R_words, W,
             np.asarray(slots, np.int32), rows_arr)
         if dead is None:
             return None
-        bits = np.unpackbits(R_words.view(np.uint8), axis=1,
-                             bitorder="little")[:, :M].astype(bool)
-        return bits, int(dead)
+        return _unpack_words(R_words, M), int(dead)
 
     def advance(self, run_over: bool = False) -> Optional[Dict[str, Any]]:
         """Walk the settled prefix of queued returns; with ``run_over``
@@ -447,6 +439,269 @@ class IncrementalEngine:
                 "op": op.to_dict(),
                 "settled-returns": self.settled_returns}
 
+    def in_flight(self) -> int:
+        """Returns not yet conclusively walked + live invocations (the
+        monitor's unsettled window)."""
+        return len(self._queue) + len(self._proc)
+
+
+def _pack_words(R: np.ndarray, M: int) -> np.ndarray:
+    """Bit-pack the mask axis of a bool [S, M] set into u64 words."""
+    packed8 = np.packbits(R, axis=1, bitorder="little")
+    n_words = max(1, -(-M // 64))
+    buf = np.zeros((R.shape[0], n_words * 8), np.uint8)
+    buf[:, :packed8.shape[1]] = packed8
+    return np.ascontiguousarray(buf).view(np.uint64)
+
+
+def _unpack_words(words: np.ndarray, M: int) -> np.ndarray:
+    return np.unpackbits(words.view(np.uint8), axis=1,
+                         bitorder="little")[:, :M].astype(bool)
+
+
+_TCODE = {INVOKE: 0, OK: 1, FAIL: 2, INFO: 3}
+_SCALAR_T = (int, str, bool, float)
+
+
+class NativeStreamEngine:
+    """The incremental monitor with its per-op bookkeeping in C++
+    (``native/preproc.cpp jt_mon_*`` via
+    :class:`~jepsen_tpu.checkers.preproc_native.Monitor`): profiling
+    the Python :class:`IncrementalEngine` on a 100k-op stream showed
+    ~95% of its ~1.9 s was host object churn — per-return snapshot
+    lists, per-member interning (428k ``hashable`` calls), per-op dict
+    traffic — and only ~0.1 s the actual bit-packed walk. Here
+    ``feed`` just buffers; ``advance`` drains the buffer into three
+    int arrays, makes ONE native feed call (slot assignment, settle
+    queue, snapshots) and ONE native advance call (settled-returns
+    walk), leaving Python only value interning (model-dependent) and
+    the carried set ``R`` (re-encoded on the rare memo/W growth).
+    Same soundness story and same verdicts as IncrementalEngine
+    (differentially tested in ``tests/test_online.py`` and the
+    cross-engine fuzzer); measured ~6-8x faster end-to-end. The
+    accelerator is deliberately NOT involved: one tunnel round trip
+    costs more than walking an entire flush, and per-flush XLA
+    dispatch lost on every axis measured in round 3 (BASELINE.md)."""
+
+    _TAIL_CAP = 512
+
+    def __init__(self, model: Model, *, max_states: int = 100_000,
+                 max_slots: int = 20, max_dense: int = 1 << 22):
+        from jepsen_tpu.checkers import preproc_native
+        self.model = model
+        self.max_states = max_states
+        self.max_slots = max_slots
+        self.max_dense = max_dense
+        self._mon = preproc_native.Monitor(max_slots)
+        self.alphabet: Dict[Tuple[Any, Any], int] = {}
+        self.alpha_ops: List[Op] = []
+        self.memo = None
+        self.W = 1
+        self.R: Optional[np.ndarray] = None      # bool [S, 2^W]
+        self._buf: List[Op] = []
+        self._live_inv: Dict[Any, Tuple[int, Op]] = {}
+        self._bind_ops: List[Op] = []            # bind id -> invoke op
+        self._bind_val: Dict[int, Any] = {}      # bind id -> final value
+        self._procmap: Dict[Any, int] = {}       # non-int process ids
+        self._memo_dirty = False
+        self.settled_returns = 0
+        self.walked_events = 0
+        self.violation: Optional[Dict[str, Any]] = None
+
+    # -- interning ------------------------------------------------------------
+
+    def _pkey(self, p) -> int:
+        # disjoint encodings: genuine int processes land on evens,
+        # interned non-int processes on odds — a history mixing
+        # process "a" with process -1 can never collide in the native
+        # live map
+        if isinstance(p, int):
+            return p * 2
+        v = self._procmap.get(p)
+        if v is None:
+            v = len(self._procmap) * 2 + 1
+            self._procmap[p] = v
+        return v
+
+    def _oid(self, f: str, v: Any) -> int:
+        # fast path: scalar values (and tuples of scalars — cas pairs)
+        # ARE their hashable form, skipping the recursive converter
+        # that dominated the Python engine
+        tv = type(v)
+        if v is None or tv in _SCALAR_T:
+            k = (f, v)
+        elif tv is tuple and all(
+                x is None or type(x) in _SCALAR_T for x in v):
+            k = (f, v)
+        else:
+            k = (f, hashable(v))
+        o = self.alphabet.get(k)
+        if o is None:
+            from jepsen_tpu.op import invoke as mk_invoke
+            o = len(self.alpha_ops)
+            self.alphabet[k] = o
+            self.alpha_ops.append(mk_invoke(0, f, v))
+            self._memo_dirty = True
+        return o
+
+    # -- memo / geometry growth ----------------------------------------------
+
+    def _rebuild_memo(self) -> None:
+        from jepsen_tpu.models.memo import StateExplosion, memo_ops
+        old_memo, old_R = self.memo, self.R
+        try:
+            self.memo = memo_ops(self.model, tuple(self.alpha_ops),
+                                 max_states=self.max_states)
+        except StateExplosion as e:
+            raise _Overflow(str(e)) from e
+        S = self.memo.n_states
+        if S * (1 << self.W) > self.max_dense:
+            raise _Overflow(f"dense config space {S}x{1 << self.W}")
+        R = np.zeros((S, 1 << self.W), bool)
+        if old_R is None:
+            R[0, 0] = True
+        else:
+            new_id = {st: i for i, st in enumerate(self.memo.states)}
+            for sid in np.nonzero(old_R.any(axis=1))[0]:
+                R[new_id[old_memo.states[sid]]] |= old_R[sid]
+        self.R = R
+        self._memo_dirty = False
+
+    def _grow_W(self, W2: int) -> None:
+        S = self.R.shape[0] if self.R is not None else 2
+        if S * (1 << W2) > self.max_dense:
+            raise _Overflow(f"dense config space {S}x{1 << W2}")
+        if self.R is not None:
+            R2 = np.zeros((self.R.shape[0], 1 << W2), bool)
+            R2[:, :self.R.shape[1]] = self.R
+            self.R = R2
+        self.W = W2
+
+    def _feed_native(self, types, procs, oids) -> None:
+        W_new = self._mon.feed(types, procs, oids)
+        if W_new == -1:
+            raise _Overflow("double invoke")
+        if W_new == -2:
+            raise _Overflow(f"history needs > {self.max_slots} slots")
+        if self.memo is None or self._memo_dirty:
+            self._rebuild_memo()
+        if W_new > self.W:
+            self._grow_W(int(W_new))
+
+    # -- ingestion ------------------------------------------------------------
+
+    def feed(self, op: Op) -> None:
+        self._buf.append(op)
+
+    def feed_many(self, ops: List[Op]) -> None:
+        self._buf.extend(ops)
+
+    def _drain(self) -> None:
+        if not self._buf:
+            return
+        ops, self._buf = self._buf, []
+        n = len(ops)
+        types = np.empty(n, np.int32)
+        procs = np.empty(n, np.int64)
+        oids = np.full(n, -1, np.int32)
+        m = 0
+        for op in ops:
+            p = op.process
+            if p == "nemesis":
+                continue
+            t = _TCODE.get(op.type)
+            if t is None:
+                continue
+            if t == 0:
+                # wildcard id: this op's crashed-at-invoke identity,
+                # used only by the unsettled-tail alarm
+                oids[m] = self._oid(op.f, op.value)
+                self._live_inv[p] = (len(self._bind_ops), op)
+                self._bind_ops.append(op)
+            else:
+                entry = self._live_inv.pop(p, None)
+                if entry is None:
+                    continue            # completion without invoke
+                bid, inv = entry
+                if t == 1:              # ok: completion value wins
+                    val = op.value if op.value is not None else inv.value
+                    oids[m] = self._oid(inv.f, val)
+                    self._bind_val[bid] = val
+                elif t == 3:            # crashed: invoke value stands
+                    oids[m] = self._oid(inv.f, inv.value)
+                    self._bind_val[bid] = inv.value
+            types[m] = t
+            procs[m] = self._pkey(p)
+            m += 1
+        if m:
+            self._feed_native(types[:m], procs[:m], oids[:m])
+
+    # -- the walk -------------------------------------------------------------
+
+    def advance(self, run_over: bool = False) -> Optional[Dict[str, Any]]:
+        if self.violation is not None:
+            return self.violation
+        self._drain()
+        if run_over and self._live_inv:
+            # the run is over: every straggler resolves as crashed,
+            # making the final verdict the exact full-history one
+            items = list(self._live_inv.items())
+            self._live_inv.clear()
+            k = len(items)
+            types = np.full(k, 3, np.int32)
+            procs = np.empty(k, np.int64)
+            oids = np.empty(k, np.int32)
+            for i, (p, (bid, inv)) in enumerate(items):
+                procs[i] = self._pkey(p)
+                oids[i] = self._oid(inv.f, inv.value)
+                self._bind_val[bid] = inv.value
+            self._feed_native(types, procs, oids)
+        if self.memo is None:
+            return None
+        # one long-pending op blocks the whole settle queue; skip the
+        # R pack/unpack round trip when advance would walk nothing
+        _s, queued, _l, _w, front_ok = self._mon.stats()
+        if queued == 0 or not front_ok:
+            return None
+        M = 1 << self.W
+        words = _pack_words(self.R, M)
+        walked, dead_bind = self._mon.advance(self.memo.table, words)
+        self.R = _unpack_words(words, M)
+        self.settled_returns += walked
+        self.walked_events += walked
+        if dead_bind >= 0:
+            self.violation = self._violation_at(dead_bind)
+        return self.violation
+
+    def tail_alarm(self) -> Optional[Dict[str, Any]]:
+        """Bounded unsettled-tail check from a COPY of the carried set,
+        unresolved ops as crashed-at-invoke wildcards (sound
+        over-approximation — an alarm is a real violation)."""
+        if self.violation is not None or self.memo is None:
+            return None
+        self._drain()
+        rows, slots, binds = self._mon.tail(self._TAIL_CAP, self.W)
+        if len(slots) == 0:
+            return None
+        from jepsen_tpu.checkers import preproc_native
+        words = _pack_words(self.R, 1 << self.W)   # a copy by packing
+        dead = preproc_native.walk_dense(self.memo.table, words, self.W,
+                                         slots, rows)
+        if dead is not None and dead >= 0:
+            self.violation = self._violation_at(int(binds[dead]))
+        return self.violation
+
+    def _violation_at(self, bid: int) -> Dict[str, Any]:
+        inv = self._bind_ops[bid]
+        op = inv.with_(type=OK, value=self._bind_val.get(bid, inv.value))
+        return {"valid": False, "engine": "online-native",
+                "op": op.to_dict(),
+                "settled-returns": self.settled_returns}
+
+    def in_flight(self) -> int:
+        _settled, queued, live, _w, _f = self._mon.stats()
+        return queued + live + len(self._buf)
+
 
 class OnlineLinearizable:
     """Background prefix re-checker. Wire :meth:`observe` as the history
@@ -478,13 +733,19 @@ class OnlineLinearizable:
         self._flushes = 0
         self._run_over = False
         self.violation: Optional[Dict[str, Any]] = None
-        self._engine: Optional[IncrementalEngine] = None
+        self._engine = None
         self._engine_cursor = 0
         if mode == "incremental":
             eng_kw = {k: checker_kw[k] for k in
                       ("max_states", "max_slots", "max_dense")
                       if k in checker_kw}
-            self._engine = IncrementalEngine(model, **eng_kw)
+            # prefer the C++ streaming core (~6-8x the Python engine);
+            # same semantics, differentially tested
+            from jepsen_tpu.checkers import preproc_native
+            if preproc_native.available():
+                self._engine = NativeStreamEngine(model, **eng_kw)
+            else:
+                self._engine = IncrementalEngine(model, **eng_kw)
 
     # -- producer side (worker threads, via History observer) ---------------
 
@@ -571,13 +832,16 @@ class OnlineLinearizable:
         with self._lock:
             new = self._ops[self._engine_cursor:]
             self._engine_cursor = len(self._ops)
-        for op in new:
-            eng.feed(op)
+        if hasattr(eng, "feed_many"):
+            eng.feed_many(new)
+        else:
+            for op in new:
+                eng.feed(op)
         self._flushes += 1
         v = eng.advance(run_over=self._run_over)
         if v is None and not self._run_over:
             v = eng.tail_alarm()
-        unsettled = len(eng._queue) + len(eng._proc)
+        unsettled = eng.in_flight()
         self._checked_upto = max(0, self._engine_cursor - 2 * unsettled)
         if v is not None:
             v = dict(v)
@@ -639,8 +903,7 @@ class OnlineLinearizable:
                    "settled-returns": self._engine.settled_returns,
                    "flushes": self._flushes}
             if not self._run_over:
-                unsettled = (len(self._engine._queue)
-                             + len(self._engine._proc))
+                unsettled = self._engine.in_flight()
                 if unsettled:
                     out["in-flight-ops"] = unsettled
             return out

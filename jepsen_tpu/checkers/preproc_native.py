@@ -45,6 +45,25 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.jt_gen_history.argtypes = [
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, _I32P, _I32P, _I32P, _I32P]
+    lib.jt_mon_new.restype = ctypes.c_void_p
+    lib.jt_mon_new.argtypes = [ctypes.c_int32]
+    lib.jt_mon_free.restype = None
+    lib.jt_mon_free.argtypes = [ctypes.c_void_p]
+    lib.jt_mon_feed.restype = ctypes.c_int64
+    lib.jt_mon_feed.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, _I32P, _I64P, _I32P]
+    lib.jt_mon_advance.restype = ctypes.c_int64
+    lib.jt_mon_advance.argtypes = [
+        ctypes.c_void_p, _I32P, ctypes.c_int32, ctypes.c_int32,
+        _U64P, ctypes.c_int64, _I32P]
+    lib.jt_mon_tail.restype = ctypes.c_int64
+    lib.jt_mon_tail.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, _I32P, _I32P, _I32P]
+    lib.jt_mon_stats.restype = ctypes.c_int64
+    lib.jt_mon_stats.argtypes = [ctypes.c_void_p, _I64P]
+    lib.jt_mon_live.restype = ctypes.c_int64
+    lib.jt_mon_live.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, _I64P, _I32P]
 
 
 _NATIVE = NativeLib("preproc.cpp", "libjepsen_preproc.so", _declare)
@@ -154,6 +173,77 @@ def gen_history(seed: int, n_ops: int, processes: int, values: int,
         _p(inv_ev), _p(ret_ev), _p(opid), _p(proc)))
     return (inv_ev[:count], ret_ev[:count], opid[:count], proc[:count],
             count)
+
+
+class Monitor:
+    """Handle to the C++ streaming-monitor core (``jt_mon_*``): the
+    per-op bookkeeping of the incremental linearizability monitor —
+    slot assignment, settle-queue snapshots, settled-returns walking —
+    fed in per-flush batches. Owned by
+    :class:`jepsen_tpu.checkers.online.NativeStreamEngine`."""
+
+    def __init__(self, max_slots: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native lib unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.jt_mon_new(int(max_slots)))
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._lib.jt_mon_free(h)
+
+    def feed(self, types: np.ndarray, procs: np.ndarray,
+             oids: np.ndarray) -> int:
+        """Returns the (possibly grown) W; negative = overflow (the
+        caller falls back permanently)."""
+        types = np.ascontiguousarray(types, np.int32)
+        procs = np.ascontiguousarray(procs, np.int64)
+        oids = np.ascontiguousarray(oids, np.int32)
+        return int(self._lib.jt_mon_feed(
+            self._h, len(types), _p(types),
+            procs.ctypes.data_as(_I64P), _p(oids)))
+
+    def advance(self, T: np.ndarray, R_words: np.ndarray
+                ) -> Tuple[int, int]:
+        """Walk every settleable queued return; ``R_words`` u64
+        [S, n_words] mutated in place. Returns ``(walked, dead_bind)``
+        with ``dead_bind = -1`` when the set survived."""
+        S, n_ops = T.shape
+        T = np.ascontiguousarray(T, np.int32)
+        assert R_words.dtype == np.uint64 and R_words.flags.c_contiguous
+        dead = np.full(1, -1, np.int32)
+        walked = int(self._lib.jt_mon_advance(
+            self._h, _p(T), S, n_ops,
+            R_words.ctypes.data_as(_U64P), R_words.shape[1], _p(dead)))
+        return walked, int(dead[0])
+
+    def tail(self, K: int, W: int):
+        """First ≤K unsettled items as ``(rows[K, W], slots, binds)``
+        with unresolved members as crashed-at-invoke wildcards."""
+        rows = np.empty((K, max(W, 1)), np.int32)
+        slots = np.empty(K, np.int32)
+        binds = np.empty(K, np.int32)
+        n = int(self._lib.jt_mon_tail(self._h, K, _p(rows), _p(slots),
+                                      _p(binds)))
+        return rows[:n], slots[:n], binds[:n]
+
+    def stats(self) -> Tuple[int, int, int, int, int]:
+        """(settled_returns, queued_returns, live_invocations, W,
+        front_settleable)."""
+        out = np.zeros(5, np.int64)
+        self._lib.jt_mon_stats(self._h, out.ctypes.data_as(_I64P))
+        return (int(out[0]), int(out[1]), int(out[2]), int(out[3]),
+                int(out[4]))
+
+    def live(self, cap: int):
+        """(procs, bind_indices) of still-pending invocations."""
+        procs = np.empty(cap, np.int64)
+        binds = np.empty(cap, np.int32)
+        n = int(self._lib.jt_mon_live(
+            self._h, cap, procs.ctypes.data_as(_I64P), _p(binds)))
+        return procs[:n], binds[:n]
 
 
 def walk_dense(T: np.ndarray, R_words: np.ndarray, W: int,

@@ -185,6 +185,19 @@ def run_trial(params, seed: int, *, pallas: bool = False):
         verdicts["online-inc"] = v is None
     except _Overflow as e:
         verdicts["online-inc"] = f"skipped: {type(e).__name__}"
+    # the C++ streaming monitor core is a fourth independent
+    # implementation of the dense walk's bookkeeping
+    try:
+        from jepsen_tpu.checkers import preproc_native
+        from jepsen_tpu.checkers.online import (NativeStreamEngine,
+                                                _Overflow)
+        if preproc_native.available():
+            eng2 = NativeStreamEngine(model)
+            eng2.feed_many(list(h))
+            v2 = eng2.advance(run_over=True)
+            verdicts["online-native"] = v2 is None
+    except _Overflow as e:
+        verdicts["online-native"] = f"skipped: {type(e).__name__}"
     if packed.n <= 7:
         verdicts["brute"] = brute.check(model, h)["valid"]
 
