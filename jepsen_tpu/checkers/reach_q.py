@@ -36,9 +36,22 @@ count) is a bisimulation on the dense engine's config graph — fires and
 projections commute with it — so emptiness at each return is preserved
 exactly. No fingerprint hashing anywhere.
 
-Budget-gated: ``S·2^L·Π(k_g+1) <= max_dense`` and ``G <= _MAX_GROUPS``
-(the fire pass unrolls groups); histories beyond it stay on the sparse
-frontier rows.
+Two walks share the quotient (round-4 widening):
+
+- **dense** — the full ``2^L`` mask axis in one tensor; gated by
+  ``L <= 16`` and ``S·2^L·Π(k_g+1) <= max_dense``;
+- **sparse-live** — rows keyed by live mask (uint32, ``L <= 31``),
+  each carrying a dense ``[S, C]`` count payload, so group fires never
+  create rows and the crashed-count product stays folded. Capacity
+  escalates through ``_SQ_CAPS``; the envelope is the reachable-MASK
+  count (bursts of ~14 distinct concurrent live ops fit; sustained
+  20+-wide concurrency reaches ~2^20 masks and overflows honestly —
+  collapsing same-op-id live bursts would need the frontier's rank
+  canonicalization, a future lever).
+
+``G <= _MAX_GROUPS`` (16) bounds the unrolled group fires; histories
+beyond every budget stay on the sparse frontier rows
+(:class:`QuotientOverflow`).
 """
 from __future__ import annotations
 
@@ -51,10 +64,32 @@ from jepsen_tpu import history as h
 from jepsen_tpu.checkers import events as ev
 from jepsen_tpu.models.memo import Memo
 
-_MAX_GROUPS = 8
+_MAX_GROUPS = 16
+# live-slot caps: the DENSE product walk holds the full 2^L mask axis
+# in one tensor (budget-gated), while the SPARSE-LIVE walk below keys
+# rows by mask (uint32) and so admits up to 31 un-crashed concurrent
+# ops — the round-4 widening that moves the former ~1 s
+# sparse-frontier-fallback family onto a quotient path
+_MAX_LIVE_DENSE = 16
+_MAX_LIVE_SPARSE = 31
 # returns per device dispatch: bounded programs, shape-stable compiles
 # (the tail segment bucket-pads), and host abort points between
 _SEG = 32768
+# sparse-live row capacities (distinct live masks per frontier;
+# escalates through the ladder before overflowing to the sparse
+# frontier engine). The reachable-mask count is the real boundary:
+# c_r concurrently-pending DISTINCT live ops reach up to 2^c_r masks,
+# so bursts up to ~14 distinct concurrent ops fit the top rung while
+# sustained 20+-wide concurrency overflows honestly (collapsing
+# same-op-id live bursts needs rank canonicalization — a future
+# lever; crashed bursts are already count-quotiented).
+_SQ_CAPS = (256, 1024, 4096, 16384)
+# absolute resource budgets for the sparse-live walk (independent of
+# the caller's dense-product budget, which gates a DIFFERENT tensor):
+# payload bools per frontier and entries of the per-pass candidate
+# einsum intermediate [F, W, S, C] (f32)
+_SQ_PAYLOAD_MAX = 1 << 25
+_SQ_EINSUM_MAX = 1 << 26
 
 
 class QuotientOverflow(RuntimeError):
@@ -86,7 +121,8 @@ def _mixed_radix(sizes: List[int]) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _prep_quotient(memo: Memo, stream: ev.EventStream,
-                   packed: h.PackedHistory, max_live: int = 16):
+                   packed: h.PackedHistory,
+                   max_live: int = _MAX_LIVE_DENSE):
     """Split the event stream into live events (slotted over returning
     ops only) and crashed groups, and build the walk's operands."""
     crashed = np.asarray(packed.crashed, bool)
@@ -275,44 +311,278 @@ def _run_segments(P_np, xor_cols, bitmask, digit, src, gids, ret_slot,
     return R_n, R_cur, True
 
 
+# -- sparse-live walk: rows keyed by live mask, dense count payload ----------
+#
+# The dense product walk above holds the full 2^L mask axis in one
+# tensor, capping live concurrency at _MAX_LIVE_DENSE. For higher
+# concurrency the REACHABLE masks are few even when 2^L is astronomical,
+# so this walk keeps a sparse row per distinct live mask (uint32 key,
+# L <= 31) and folds the whole crashed-count product into a dense
+# [S, C] payload per row. Group fires then never create rows (counts
+# live inside the payload; the mask is untouched) — only live fires
+# spawn candidates — which is exactly why this beats the sparse
+# frontier on crash-heavy shapes: the frontier's row count multiplies
+# by count combinations, while here F counts distinct masks only.
+# Exactness: same bisimulation argument as the dense walk; rows merge
+# by OR-ing payloads (set union), no hashing. Capacity overflow
+# escalates through _SQ_CAPS and finally falls back to the sparse
+# frontier engine (QuotientOverflow) — an overflow run's results are
+# discarded entirely (clipped dedup would over-approximate).
+
+_SQ_SENT = np.uint32(0xFFFFFFFF)
+
+
+def _sq_fire_groups(payload, P, gop_ids, digit, src, cap_row):
+    """Group fires on the [F, S, C] payloads (same math as
+    :func:`_q_fire_once`'s group part with the mask axis replaced by
+    the sparse row axis): step the model through the group op and
+    advance the count digit, gated on the invoked-availability cap."""
+    import jax.numpy as jnp
+
+    for g in range(gop_ids.shape[0]):
+        fired = jnp.einsum("fsc,st->ftc",
+                           payload.astype(jnp.float32),
+                           P[gop_ids[g]]) > 0.5
+        shifted = jnp.where((src[g] >= 0)[None, None, :],
+                            fired[:, :, jnp.clip(src[g], 0)], False)
+        gate = (digit[g] <= cap_row[g])[None, None, :]
+        payload = payload | (shifted & gate)
+    return payload
+
+
+def _sq_dedup(masks, payload, Fcap: int):
+    """Sort rows by mask, OR payloads of equal masks, compact to the
+    first ``Fcap`` slots. Returns ``(masks, payload, n_unique)`` —
+    ``n_unique > Fcap`` means rows were clipped (caller must discard
+    the walk and escalate; the clipped state over-approximates)."""
+    import jax.numpy as jnp
+
+    order = jnp.argsort(masks)
+    masks_s = masks[order]
+    payload_s = payload[order]
+    valid = masks_s != _SQ_SENT
+    newseg = jnp.concatenate(
+        [valid[:1], (masks_s[1:] != masks_s[:-1]) & valid[1:]])
+    seg = jnp.cumsum(newseg.astype(jnp.int32)) - 1
+    segc = jnp.clip(seg, 0, Fcap - 1)
+    m_out = jnp.full((Fcap,), _SQ_SENT, jnp.uint32).at[segc].min(
+        jnp.where(valid, masks_s, _SQ_SENT))
+    p_out = jnp.zeros((Fcap,) + payload.shape[1:], jnp.bool_)
+    p_out = p_out.at[segc].max(payload_s & valid[:, None, None])
+    return m_out, p_out, jnp.sum(newseg)
+
+
+def _sq_step(P, digit, src, gop_ids, masks, payload, j, ops_row,
+             cap_row, Fcap: int, W: int):
+    """One return event on the sparse rows: fire to the monotone
+    fixpoint (groups in place, live fires spawning candidate rows),
+    then project on live slot ``j``. Returns
+    ``(masks, payload, over)``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_ops_pad = P.shape[0] - 1
+    Gl = P[jnp.where(ops_row < 0, n_ops_pad, ops_row)]     # [W, S, S]
+    bits = (jnp.uint32(1) << jnp.arange(W, dtype=jnp.uint32))
+
+    def one(c):
+        masks, payload, over = c
+        payload = _sq_fire_groups(payload, P, gop_ids, digit, src,
+                                  cap_row)
+        valid_row = (masks != _SQ_SENT)[:, None]
+        bitclear = (masks[:, None] & bits[None, :]) == 0
+        cand_ok = valid_row & bitclear & (ops_row >= 0)[None, :]
+        stepped = jnp.einsum("fsc,wst->fwtc",
+                             payload.astype(jnp.float32), Gl) > 0.5
+        cand_masks = jnp.where(cand_ok, masks[:, None] | bits[None, :],
+                               _SQ_SENT)
+        S, C = payload.shape[1], payload.shape[2]
+        cand_payload = (stepped.reshape(-1, S, C)
+                        & cand_ok.reshape(-1)[:, None, None])
+        all_masks = jnp.concatenate([masks, cand_masks.reshape(-1)])
+        all_payload = jnp.concatenate([payload, cand_payload])
+        masks, payload, n = _sq_dedup(all_masks, all_payload, Fcap)
+        return masks, payload, over | (n > Fcap)
+
+    def cond(c):
+        prev_bits, cur = c
+        _m, p, over = cur
+        return (jnp.sum(p) != prev_bits) & ~over
+
+    def body(c):
+        _prev, cur = c
+        return jnp.sum(cur[1]), one(cur)
+
+    state = (masks, payload, jnp.bool_(False))
+    _, (masks, payload, over) = lax.while_loop(
+        cond, body, (jnp.int32(-1), state))
+    # projection on the returning live slot (j = -1: identity pad)
+    bit = jnp.uint32(1) << jnp.uint32(jnp.maximum(j, 0))
+    has = (masks != _SQ_SENT) & ((masks & bit) != 0)
+    masks_p = jnp.where(has, masks & ~bit, _SQ_SENT)
+    payload_p = payload & has[:, None, None]
+    masks_p, payload_p, n = _sq_dedup(masks_p, payload_p, Fcap)
+    over = over | (n > Fcap)
+    keep = j >= 0
+    masks = jnp.where(keep, masks_p, masks)
+    payload = jnp.where(keep, payload_p, payload)
+    return masks, payload, over
+
+
+def _sq_walk(P, digit, src, gop_ids, ret_slot, slot_ops, caps, masks0,
+             payload0, Fcap: int, W: int):
+    """Drive all return events; returns
+    ``(ptr, masks, payload, alive, over)``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    Rn = ret_slot.shape[0]
+
+    def cond(c):
+        i, _m, _p, alive, over = c
+        return (i < Rn) & alive & ~over
+
+    def body(c):
+        i, masks, payload, _a, over = c
+        masks, payload, o2 = _sq_step(
+            P, digit, src, gop_ids, masks, payload, ret_slot[i],
+            slot_ops[i], caps[i], Fcap, W)
+        return i + 1, masks, payload, payload.any(), over | o2
+
+    return lax.while_loop(
+        cond, body,
+        (jnp.int32(0), masks0, payload0, payload0.any(),
+         jnp.bool_(False)))
+
+
+@functools.cache
+def _jitted_sq_walk(Fcap: int, W: int):
+    import functools as _ft
+
+    import jax
+    return jax.jit(_ft.partial(_sq_walk, Fcap=Fcap, W=W))
+
+
+class _SqOverflow(RuntimeError):
+    """Row capacity exceeded at the current Fcap rung."""
+
+
+def _sq_run_segments(P_np, digit, src, gids, ret_slot, slot_ops, caps,
+                     S_pad: int, C: int, L: int, R_n: int, Fcap: int,
+                     should_abort):
+    """Segmented drive of the sparse-live walk at one capacity rung;
+    raises :class:`_SqOverflow` (caller escalates and restarts — an
+    overflowed walk's rows are over-approximate and unusable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers import reach
+
+    walk = _jitted_sq_walk(Fcap, L)
+    dP = jax.device_put(np.asarray(P_np))
+    ddig, dsrc = jax.device_put(digit), jax.device_put(src)
+    dg = jax.device_put(np.ascontiguousarray(gids, np.int32))
+    masks0 = np.full(Fcap, _SQ_SENT, np.uint32)
+    masks0[0] = 0
+    payload0 = np.zeros((Fcap, S_pad, C), bool)
+    payload0[0, 0, 0] = True
+    m_cur = jnp.asarray(masks0)
+    p_cur = jnp.asarray(payload0)
+    base = 0
+    while base < R_n:
+        if should_abort is not None and should_abort():
+            raise Aborted()
+        n = min(_SEG, R_n - base)
+        L_pad = max(64, reach._bucket(n, 8))
+        seg_slot = np.full(L_pad, -1, np.int32)
+        seg_slot[:n] = ret_slot[base:base + n]
+        seg_ops = np.full((L_pad, L), -1, np.int32)
+        seg_ops[:n] = slot_ops[base:base + n]
+        G = caps.shape[1]
+        seg_caps = np.zeros((L_pad, G), np.int32)
+        seg_caps[:n] = caps[base:base + n]
+        seg_caps[n:] = caps[base + n - 1]    # idempotent pads (above)
+        ptr, m_cur, p_cur, alive, over = walk(
+            dP, ddig, dsrc, dg, jnp.asarray(seg_slot),
+            jnp.asarray(seg_ops), jnp.asarray(seg_caps), m_cur, p_cur)
+        if bool(over):
+            raise _SqOverflow(f"> {Fcap} live-mask rows")
+        if not bool(alive):
+            return base + int(ptr), m_cur, p_cur, False
+        base += n
+    return R_n, m_cur, p_cur, True
+
+
 def check_quotient(memo: Memo, stream: ev.EventStream,
                    packed: h.PackedHistory, *,
                    max_dense: int = 1 << 22,
                    should_abort=None) -> Dict[str, Any]:
-    """Run the product-space walk. Raises :class:`QuotientOverflow`
-    when the history does not fit (callers fall back to the sparse
-    rows) or :class:`Aborted` when ``should_abort`` fires between
-    segments. Returns the same verdict dict shape as the other engines
-    (the caller brands the engine name)."""
+    """Run the product-space walk — dense when ``2^L`` fits the budget,
+    else the sparse-live walk (rows per reachable mask, L ≤ 31).
+    Raises :class:`QuotientOverflow` when neither fits (callers fall
+    back to the sparse frontier rows) or :class:`Aborted` when
+    ``should_abort`` fires between segments. Returns the same verdict
+    dict shape as the other engines (the caller brands the engine
+    name)."""
     from jepsen_tpu.checkers import reach
 
     (L, ret_slot, slot_ops, ret_event, ret_entry, R_n, gids, sizes, C,
-     caps, digit, src) = _prep_quotient(memo, stream, packed)
+     caps, digit, src) = _prep_quotient(memo, stream, packed,
+                                        max_live=_MAX_LIVE_SPARSE)
     S = memo.n_states
     S_pad = max(2, reach._next_pow2(S))
-    M = 1 << L
-    if S_pad * M * C > max_dense:
+    dense_ok = (L <= _MAX_LIVE_DENSE
+                and S_pad * (1 << L) * C <= max_dense)
+    sparse_ok = (S_pad * C * _SQ_CAPS[0] <= _SQ_PAYLOAD_MAX
+                 and _SQ_CAPS[0] * L * S_pad * C <= _SQ_EINSUM_MAX)
+    if not dense_ok and not sparse_ok:
         raise QuotientOverflow(
-            f"product space {S_pad}x{M}x{C} exceeds {max_dense}")
+            f"product space {S_pad}x2^{L}x{C} exceeds budgets")
     if R_n == 0:
         return {"valid": True, "product-space": [S_pad, 1 << L, C],
                 "live-slots": L, "crash-groups": len(sizes)}
     P_np = reach._build_P(memo, S_pad)
-    xor_cols, bitmask = reach._xor_bitmask(L, M)
-    R0 = np.zeros((S_pad, M, C), bool)
-    R0[0, 0, 0] = True
-    ptr, R_fin, alive = _run_segments(
-        P_np, xor_cols, bitmask, digit, src, gids,
-        np.ascontiguousarray(ret_slot, np.int32),
-        np.ascontiguousarray(slot_ops, np.int32),
-        np.ascontiguousarray(caps[:R_n], np.int32), R0, R_n,
-        should_abort)
+    rsl = np.ascontiguousarray(ret_slot, np.int32)
+    ops = np.ascontiguousarray(slot_ops, np.int32)
+    cps = np.ascontiguousarray(caps[:R_n], np.int32)
+    if dense_ok:
+        M = 1 << L
+        xor_cols, bitmask = reach._xor_bitmask(L, M)
+        R0 = np.zeros((S_pad, M, C), bool)
+        R0[0, 0, 0] = True
+
+        def drive(rs, so, cp, rn):
+            return _run_segments(P_np, xor_cols, bitmask, digit, src,
+                                 gids, rs, so, cp, R0, rn, should_abort)
+
+        ptr, R_fin, alive = drive(rsl, ops, cps, R_n)
+        walk_kind = "dense"
+    else:
+        def drive(rs, so, cp, rn):
+            last = None
+            for Fcap in _SQ_CAPS:
+                if (S_pad * C * Fcap > _SQ_PAYLOAD_MAX
+                        or Fcap * L * S_pad * C > _SQ_EINSUM_MAX):
+                    break
+                try:
+                    ptr, m, p, alive = _sq_run_segments(
+                        P_np, digit, src, gids, rs, so, cp, S_pad, C,
+                        L, rn, Fcap, should_abort)
+                    return ptr, (m, p), alive
+                except _SqOverflow as e:
+                    last = e
+            raise QuotientOverflow(str(last or "sparse-live overflow"))
+
+        ptr, R_fin, alive = drive(rsl, ops, cps, R_n)
+        walk_kind = "sparse-live"
     if bool(alive):
-        return {"valid": True, "product-space": [S_pad, M, C],
-                "live-slots": L, "crash-groups": len(sizes)}
+        return {"valid": True, "product-space": [S_pad, 1 << L, C],
+                "live-slots": L, "crash-groups": len(sizes),
+                "walk": walk_kind}
     dead_ret = int(ptr) - 1
-    out = {"valid": False, "product-space": [S_pad, M, C],
+    out = {"valid": False, "product-space": [S_pad, 1 << L, C],
            "live-slots": L, "crash-groups": len(sizes),
+           "walk": walk_kind,
            "op": packed.entries[int(ret_entry[dead_ret])].op.to_dict(),
            "dead-event": int(ret_event[dead_ret]),
            "max-linearized": dead_ret}
@@ -321,17 +591,41 @@ def check_quotient(memo: Memo, stream: ev.EventStream,
             int(ret_entry[dead_ret - 1])].op.to_dict()
     # witness: re-walk the prefix for the surviving configs
     try:
-        _ptr2, R_prev, _ = _run_segments(
-            P_np, xor_cols, bitmask, digit, src, gids,
-            np.ascontiguousarray(ret_slot[:dead_ret], np.int32),
-            np.ascontiguousarray(slot_ops[:dead_ret], np.int32),
-            np.ascontiguousarray(caps[:max(dead_ret, 1)], np.int32),
-            R0, dead_ret, should_abort)
-        out["final-configs"] = _decode(memo, np.asarray(R_prev),
-                                       slot_ops[dead_ret], gids, sizes,
-                                       digit)
+        _p2, R_prev, _ = drive(rsl[:dead_ret], ops[:dead_ret],
+                               cps[:max(dead_ret, 1)], dead_ret)
+        if walk_kind == "dense":
+            out["final-configs"] = _decode(
+                memo, np.asarray(R_prev), slot_ops[dead_ret], gids,
+                sizes, digit)
+        else:
+            m_prev, p_prev = R_prev
+            out["final-configs"] = _decode_sparse(
+                memo, np.asarray(m_prev), np.asarray(p_prev),
+                slot_ops[dead_ret], gids, sizes, digit)
     except Exception:                                   # noqa: BLE001
         pass                            # evidence is best-effort garnish
+    return out
+
+
+def _decode_sparse(memo: Memo, masks: np.ndarray, payload: np.ndarray,
+                   pending_row, gids, sizes, digit,
+                   limit: int = 16) -> List[Dict[str, Any]]:
+    out = []
+    for f in np.nonzero(masks != _SQ_SENT)[0]:
+        m = int(masks[f])
+        for s, c in np.argwhere(payload[f]):
+            if len(out) >= limit:
+                return out
+            lin = [str(memo.distinct_ops[pending_row[j]])
+                   for j in range(len(pending_row))
+                   if (m >> j) & 1 and pending_row[j] >= 0]
+            for g in range(len(sizes)):
+                cnt = int(digit[g, c])
+                if cnt:
+                    lin.append(f"{cnt}x crashed "
+                               f"{memo.distinct_ops[int(gids[g])]}")
+            out.append({"model": str(memo.states[s]),
+                        "linearized-pending": lin})
     return out
 
 
