@@ -1088,11 +1088,14 @@ def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
             offsets, opid_cat, crs_cat, offs, noop_op)
 
 
-# histories per lockstep dispatch: the blocked-diagonal fire operand
-# grows O(H^2) in VMEM (2*HS*W*HS f32 = 160 KB at H=8, W=5, S=8) and
-# the per-return gather does H*W tile writes, so larger requests are
-# chunked into groups of this size
-_BATCH_GROUP = 8
+# histories per lockstep dispatch. The hard ceiling is SMEM: the
+# slot_ops window is B*H*W i32 double-buffered, and the chip holds
+# 1 MB of SMEM — H=32 at W=5 needs 1.31 MB and fails to compile, H=16
+# fits (655 KB). Measured per-history-return cost keeps HALVING with H
+# (740 ns single, 150 ns at H=8, 73 ns at H=16 — the lockstep step
+# cost is flat in H), so the default is the largest H that compiles at
+# the headline geometry; wider batches are chunked into groups.
+_BATCH_GROUP = 16
 
 
 def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
