@@ -1088,14 +1088,18 @@ def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
             offsets, opid_cat, crs_cat, offs, noop_op)
 
 
-# histories per lockstep dispatch. The hard ceiling is SMEM: the
-# slot_ops window is B*H*W i32 double-buffered, and the chip holds
-# 1 MB of SMEM — H=32 at W=5 needs 1.31 MB and fails to compile, H=16
-# fits (655 KB). Measured per-history-return cost keeps HALVING with H
-# (740 ns single, 150 ns at H=8, 73 ns at H=16 — the lockstep step
-# cost is flat in H), so the default is the largest H that compiles at
-# the headline geometry; wider batches are chunked into groups.
-_BATCH_GROUP = 16
+# histories per lockstep dispatch. Two measured hardware ceilings
+# bound the width (both from compile failures at the headline
+# geometry, W=5 S=8): SMEM holds 1 MB — the B*H*W i32 double-buffered
+# slot_ops window is kept under it by shrinking the block size as H
+# grows (reach_batch._adaptive_block: B=1024 to H=16, 512 at H=32) —
+# and VMEM holds 16 MB scoped, which the H=64 geometry exceeds by
+# 212 KB (the 2×[HS, W·HS] f32 transition scratch is 10.5 MB alone).
+# H=32 is the widest that compiles; it is also the e2e winner (one
+# dispatch group + one fetch over 32 histories: 3.2M agg ops/s vs
+# 2.3M at H=16 on 32×cas-100k) while per-history-return kernel cost
+# is ~flat from H=16 (43-48 ns). Wider batches chunk into groups.
+_BATCH_GROUP = 32
 
 
 def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,

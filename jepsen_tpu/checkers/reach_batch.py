@@ -57,6 +57,23 @@ from jepsen_tpu.checkers.reach_lane import (_BLOCK, _FAST_PASSES,
 # operand set is H× the single-history one, so overlap matters more
 _PIPE_NSEG = 4
 
+# SMEM byte budget for the double-buffered slot_ops window
+# (B*H*W i32 ×2 buffers). The chip holds 1 MB of SMEM: the H=32,
+# B=1024 geometry needed 1.31 MB and failed to compile while 0.655 MB
+# fit (BASELINE.md round-4 batch rung) — so the block size shrinks as
+# the lockstep width grows instead of capping H at 16.
+_SMEM_BUDGET = 840_000
+
+
+def _adaptive_block(H: int, W: int) -> int:
+    """Largest power-of-two block ≤ ``_BLOCK`` whose double-buffered
+    slot_ops SMEM window fits the measured budget. B=1024 up to H=16
+    at W=5 (the round-4 default geometry), B=512 at H=32, B=256 at
+    H=64 — the window stays ~655 KB at every width."""
+    cap = max(32, _SMEM_BUDGET // (H * W * 8))
+    b = 1 << (cap.bit_length() - 1)
+    return min(_BLOCK, b)
+
 
 def _one_fire_pass_b(R, G_all, W: int, M: int, HS: int):
     """One Jacobi fire pass over the batched set: ONE fused
@@ -183,14 +200,26 @@ def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
 
     HS = H * S
     n_blocks = R_pad // B
+    # 1-D SMEM windows must tile to 1024 (Mosaic layout verification
+    # fails on a 512-wide window when the adaptive block shrinks below
+    # 1024 at H≥32) — BOTH scalar operands pad each per-grid-step
+    # block up to a 1024 multiple on device: pendmax's B-block to PB,
+    # and slot_ops' B*H*W-block to SOW_P (B=1024 makes B*H*W a 1024
+    # multiple for any H*W, but the adaptive block at H≥32 does not —
+    # e.g. a tail group of H=21 at W=5, B=512 is 52.5 tiles). The
+    # kernel indexes only the first B*H*W (resp. B) entries of each
+    # block, so the tail pad is never read.
+    PB = max(B, 1024)
+    SOW = B * H * W
+    SOW_P = -(-SOW // 1024) * 1024
     kernel = _make_batch_kernel(B, W, M, S, H, O1, n_blocks, n_pass)
     call = pl.pallas_call(
         kernel,
         grid=(n_blocks,),
         in_specs=[
-            pl.BlockSpec((B * H * W,), lambda i: (i,),
+            pl.BlockSpec((SOW_P,), lambda i: (i,),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((B,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((PB,), lambda i: (i,), memory_space=pltpu.SMEM),
             pl.BlockSpec((B, HS), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((O1, S, S), lambda i: (0, 0, 0),
@@ -224,6 +253,13 @@ def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
         pend = jnp.sum((ops32.reshape(-1, H, W) >= 0).astype(jnp.int32),
                        axis=2)
         pendmax = jnp.max(pend, axis=1)
+        if PB != B:                     # pad each B-block to the SMEM tile
+            pendmax = jnp.pad(pendmax.reshape(-1, B),
+                              ((0, 0), (0, PB - B))).reshape(-1)
+        if SOW_P != SOW:                # pad each B*H*W-block likewise
+            ops32 = jnp.pad(ops32.reshape(-1, SOW),
+                            ((0, 0), (0, SOW_P - SOW)),
+                            constant_values=-1).reshape(-1)
         jv = jnp.repeat(ret_slot_rh.astype(jnp.float32), S, axis=1)
         return call(ops32, pendmax, jv, P, R0)
 
@@ -243,7 +279,7 @@ def pack_batch_operands(P: np.ndarray, ret_slots: List[np.ndarray],
     O1, S, _ = P.shape
     H = len(ret_slots)
     W = max(int(so.shape[1]) for so in slot_ops)
-    B = min(32, _BLOCK) if interpret else _BLOCK
+    B = min(32, _BLOCK) if interpret else _adaptive_block(H, W)
     R_max = max(1, max(int(r.shape[0]) for r in ret_slots))
     R_pad = max(B, _bucket(-(-R_max // B) * B, B))
     rs_rh = np.full((R_pad, H), -1, np.int8)

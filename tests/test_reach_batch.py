@@ -156,3 +156,19 @@ def test_check_batch_fallback_without_native(monkeypatch):
     for i, p in enumerate(packed):
         ref = reach.check_packed(model, p)
         assert res[i]["valid"] == ref["valid"]
+
+
+def test_adaptive_block_smem_budget():
+    """The double-buffered slot_ops SMEM window (B*H*W i32 x2) must fit
+    the measured ~1 MB chip budget at every lockstep width; at the
+    round-4 default geometry (H=16, W=5) the block must stay 1024 so
+    recorded numbers keep their meaning."""
+    assert reach_batch._adaptive_block(16, 5) == 1024
+    assert reach_batch._adaptive_block(32, 5) == 512
+    assert reach_batch._adaptive_block(64, 5) == 256
+    for H in (1, 2, 4, 8, 16, 32, 64, 128):
+        for W in (1, 3, 5, 8, 20):
+            B = reach_batch._adaptive_block(H, W)
+            assert B & (B - 1) == 0 and B >= 32
+            assert (B * H * W * 8 <= reach_batch._SMEM_BUDGET
+                    or B == 32)

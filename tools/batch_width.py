@@ -1,0 +1,98 @@
+"""Lockstep batch-width sweep: measure the per-history-return cost of
+``reach.check_batch`` as the lockstep width H grows (8/16/32/...), now
+that the block size adapts to keep the slot_ops SMEM window under the
+chip's 1 MB (``reach_batch._adaptive_block``). Reports e2e time plus a
+dispatch-slope kernel figure per width so the "step cost is flat in H"
+claim (BASELINE.md round-4 batch rung) can be extended or refuted at
+H=32 without guessing.
+
+Usage: python tools/batch_width.py [--ops 100000] [--widths 8,16,32]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=100_000)
+    ap.add_argument("--widths", default="8,16,32")
+    ap.add_argument("--repeat", type=int, default=3)
+    args = ap.parse_args()
+    widths = [int(w) for w in args.widths.split(",")]
+    H_max = max(widths)
+
+    import numpy as np
+
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import reach, reach_batch
+
+    model = models.cas_register()
+    packeds = [fixtures.gen_packed("cas", n_ops=args.ops, seed=100 + i)
+               for i in range(H_max)]
+    out = []
+    for H in widths:
+        sub = packeds[:H]
+        live = list(range(H))
+        u = reach._union_prep(model, sub, live, 100_000, 20)
+        if u is None:
+            print(json.dumps({"H": H, "error": "union prep failed"}))
+            continue
+        (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
+         offsets, *_rest) = u
+        rets = [ret_flat[offsets[k]:offsets[k + 1]] for k in live]
+        ops = [ops_flat[offsets[k]:offsets[k + 1]] for k in live]
+        geom, host_args, R_lens = reach_batch.pack_batch_operands(
+            P, rets, ops, M)
+        B = geom[0]
+        n_pass = min(geom[1], reach_batch._FAST_PASSES)
+        # e2e (best of repeat), through the public entry
+        times = []
+        for _ in range(max(1, args.repeat)):
+            t0 = time.monotonic()
+            res = reach.check_batch(model, sub, group=H)
+            times.append(time.monotonic() - t0)
+        assert all(r["valid"] for r in res), res
+        e2e = min(times)
+        # kernel dispatch slope on cached device segments
+        dsegs: dict = {}
+        _, final = reach_batch._pipe_walk_b(host_args, geom, n_pass,
+                                            False, dsegs)
+        _ = np.asarray(final)
+        t0 = time.monotonic()
+        _, final = reach_batch._pipe_walk_b(host_args, geom, n_pass,
+                                            False, dsegs)
+        _ = np.asarray(final)
+        one = time.monotonic() - t0
+        K = 4
+        t0 = time.monotonic()
+        for _ in range(K):
+            _, final = reach_batch._pipe_walk_b(host_args, geom, n_pass,
+                                                False, dsegs)
+        _ = np.asarray(final)
+        many = time.monotonic() - t0
+        kernel_s = max(0.0, (many - one) / (K - 1))
+        hist_returns = int(sum(R_lens))
+        steps = geom[6]                       # R_pad lockstep steps
+        row = {
+            "H": H, "B": B, "W": geom[1], "M": M, "S": geom[3],
+            "e2e_s": round(e2e, 3),
+            "agg_ops_s": round(args.ops * H / e2e),
+            "kernel_s": round(kernel_s, 4),
+            "ns_per_step": round(kernel_s / max(steps, 1) * 1e9),
+            "ns_per_history_return": round(
+                kernel_s / max(hist_returns, 1) * 1e9, 1),
+        }
+        out.append(row)
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
