@@ -1105,6 +1105,7 @@ _BATCH_GROUP = 32
 def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
                 max_states: int = 100_000, max_slots: int = 20,
                 max_dense: int = 1 << 22,
+                devices: Optional[Sequence] = None,
                 group: int = _BATCH_GROUP) -> List[Dict[str, Any]]:
     """Check SEVERAL complete histories at once on the lockstep batch
     kernel (:mod:`jepsen_tpu.checkers.reach_batch`): the config sets of
@@ -1121,7 +1122,28 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
     workloads whose union memo explodes, Pallas unavailable, > max
     slots, tiny histories). Verdicts and witnesses are identical to
     the sequential path (differentially tested). Upstream analogue:
-    none — knossos checks one history per run (SURVEY.md §2.2)."""
+    none — knossos checks one history per run (SURVEY.md §2.2).
+
+    With ``devices`` (>1) the HISTORY axis shards over a
+    ``jax.sharding.Mesh`` instead: whole histories are as independent
+    as ``independent`` keys, so the batch rides the same data-parallel
+    path as :func:`check_many` (each device walks its share of the
+    vmapped batch; the lockstep kernel is the single-chip form). The
+    graceful-fallback guarantee survives the mesh: if the sharded
+    batch cannot run (e.g. padding every history to the common shape
+    overflows ``max_dense`` even though each fits alone), the call
+    falls through to the single-device route below and its per-history
+    fallbacks, rather than raising where ``devices=None`` would have
+    succeeded."""
+    if devices is not None and len(devices) > 1:
+        try:
+            return check_many(model, packed_list, max_states=max_states,
+                              max_slots=max_slots, max_dense=max_dense,
+                              devices=devices)
+        except Exception as e:                          # noqa: BLE001
+            logging.getLogger("jepsen.reach").warning(
+                "sharded history batch failed (%r); falling back to "
+                "the single-device path", e)
     t0 = _time.monotonic()
     results: List[Optional[Dict[str, Any]]] = [
         {"valid": True, "engine": "reach-lockstep", "events": 0,

@@ -125,24 +125,67 @@ def _cmd_serve(args) -> int:
     return 0
 
 
-def _cmd_recheck(args) -> int:
-    """Re-analyze a stored history offline — the TPU solver's entry point
-    for existing Jepsen runs (reads our store dirs, bare history.jsonl
-    paths, or upstream EDN histories)."""
+def _load_history(path: str):
     import os
 
     from jepsen_tpu import history as h
+
+    if os.path.isdir(path):
+        from jepsen_tpu import store
+        return store.load_history(path)
+    if path.endswith(".edn"):
+        return h.load_edn(path)
+    return h.load_jsonl(path)
+
+
+def _cmd_recheck(args) -> int:
+    """Re-analyze stored histories offline — the TPU solver's entry point
+    for existing Jepsen runs (reads our store dirs, bare history.jsonl
+    paths, or upstream EDN histories). Several paths at once go through
+    the lockstep batch engine (``reach.check_batch``): all histories
+    advance together in one device walk — the batch axis is where the
+    accelerator earns its keep (BASELINE.md round-4 batch rung)."""
+    from jepsen_tpu import history as h
     from jepsen_tpu import models
+
+    model = getattr(models, args.model.replace("-", "_"))()
+    if len(args.path) > 1:
+        from jepsen_tpu.checkers import facade, reach
+
+        # containment mirrors the single-path route's check_safe: an
+        # unreadable path or a history the batch engines reject yields
+        # its own {"valid": "unknown", "error": ...} line instead of a
+        # traceback that swallows the good runs' verdicts
+        loaded: list = []               # (path, history|None, error|None)
+        for p in args.path:
+            try:
+                loaded.append((p, _load_history(p), None))
+            except Exception as e:                      # noqa: BLE001
+                loaded.append((p, None, f"{type(e).__name__}: {e}"))
+        live = [(p, hist) for p, hist, err in loaded if err is None]
+        try:
+            batch = reach.check_batch(model,
+                                      [h.pack(hist) for _, hist in live])
+            res_by_path = {p: r for (p, _), r in zip(live, batch)}
+        except Exception as e:                          # noqa: BLE001
+            # batch path rejected (overflow, unhashable values, ...):
+            # per-history auto chain with full error containment
+            logging.getLogger("jepsen.cli").warning(
+                "batch recheck failed (%r); per-history fallback", e)
+            res_by_path = {
+                p: facade.check_safe(facade.linearizable(model),
+                                     {"model": model}, hist)
+                for p, hist in live}
+        ok = True
+        for p, _hist, err in loaded:
+            res = (res_by_path[p] if err is None
+                   else {"valid": "unknown", "error": err})
+            ok = ok and res.get("valid") is True
+            print(json.dumps({"path": p, **res}, default=str))
+        return 0 if ok else 1
     from jepsen_tpu.checkers import facade
 
-    if os.path.isdir(args.path):
-        from jepsen_tpu import store
-        history = store.load_history(args.path)
-    elif args.path.endswith(".edn"):
-        history = h.load_edn(args.path)
-    else:
-        history = h.load_jsonl(args.path)
-    model = getattr(models, args.model.replace("-", "_"))()
+    history = _load_history(args.path[0])
     checker = facade.linearizable(model, algorithm=args.algorithm)
     res = facade.check_safe(checker, {"model": model}, history)
     print(json.dumps(res, indent=2, default=str))
@@ -174,10 +217,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     servep.set_defaults(fn=_cmd_serve)
 
     rp = sub.add_parser("recheck",
-                        help="re-analyze a stored history offline")
-    rp.add_argument("path", help="run dir, history.jsonl, or history.edn")
+                        help="re-analyze stored histories offline "
+                             "(several paths = one lockstep batch)")
+    rp.add_argument("path", nargs="+",
+                    help="run dir(s), history.jsonl, or history.edn; "
+                         "more than one path checks them all in one "
+                         "lockstep batch on the device")
     rp.add_argument("--model", default="cas-register")
-    rp.add_argument("--algorithm", default="auto")
+    rp.add_argument("--algorithm", default="auto",
+                    help="engine for single-path rechecks (several "
+                         "paths always use the batch engine)")
     rp.set_defaults(fn=_cmd_recheck)
 
     args = ap.parse_args(argv)
