@@ -129,6 +129,42 @@ def kernel_probe(model, packed) -> dict:
     }
 
 
+def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
+    """Lockstep batch rung (BASELINE.md round-4): H independent
+    histories through ONE ``reach.check_batch`` call — the batch axis
+    is where the device wins end-to-end, so the official bench
+    artifact carries its aggregate throughput alongside the
+    single-history headline. Warm best-of-2 e2e (includes union prep
+    and marshaling — the honest user cost)."""
+    from jepsen_tpu import fixtures
+    from jepsen_tpu.checkers import reach
+
+    H = reach._BATCH_GROUP
+    packeds = [fixtures.gen_packed("cas", n_ops=n_ops,
+                                   processes=processes,
+                                   seed=seed + 1000 + i)
+               for i in range(H)]
+    res = reach.check_batch(model, packeds)       # warm/compile
+    if not all(r["valid"] is True for r in res):
+        return {"error": "bad batch verdicts"}
+    engines = {r["engine"] for r in res}
+    if engines != {"reach-lockstep"}:
+        # the lockstep gates did not hold (CPU-only run, no native
+        # lib, ...) and check_batch fell back to sequential
+        # per-history checks — timing that as "the batch rung" would
+        # mislabel sequential throughput, so skip like kernel_probe
+        return {"skipped": f"no lockstep path ({sorted(engines)})"}
+    times = []
+    for _ in range(2):
+        t1 = time.monotonic()
+        reach.check_batch(model, packeds)
+        times.append(time.monotonic() - t1)
+    best = min(times)
+    return {"H": H, "e2e_s": round(best, 3),
+            "agg_ops_s": round(H * n_ops / best),
+            "engine": sorted(engines)}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", type=int, default=100_000)
@@ -137,6 +173,8 @@ def main() -> int:
     ap.add_argument("--engine", default="reach",
                     choices=["reach", "chunked", "wgl-cpu", "wgl-native"])
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--no-batch", action="store_true",
+                    help="skip the lockstep batch probe")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="write a jax.profiler trace of one steady-state "
                          "check to DIR")
@@ -209,6 +247,12 @@ def main() -> int:
             # probe is diagnostics, not the metric: histories the lane
             # kernel does not admit (or CPU-only runs) skip it
             out["kernel"] = {"error": f"{type(e).__name__}: {e}"}
+        if not args.no_batch and args.ops <= 200_000:
+            try:
+                out["batch"] = batch_probe(model, args.ops, args.seed,
+                                           args.processes)
+            except Exception as e:                      # noqa: BLE001
+                out["batch"] = {"error": f"{type(e).__name__}: {e}"}
     print(json.dumps(out))
     return 0
 
