@@ -74,29 +74,34 @@ def main() -> int:
                                else f"{res['valid']}")
         print(json.dumps(row), flush=True)
 
-    # round-4 batch rung: H independent cas-100k histories, the
-    # lockstep batch kernel (ONE device walk) vs the C++ engine
-    # looping them on one core — the aggregate-throughput comparison
-    # (BASELINE.md round-4 batch section)
-    H = 8
+    # round-4 batch rungs: H independent cas-100k histories, the
+    # lockstep batch kernel (ONE device walk per dispatch group) vs
+    # the C++ engine looping them on one core — the aggregate-
+    # throughput comparison (BASELINE.md round-4 batch section). H=8
+    # is the original recorded rung; H=32 is one full-width dispatch
+    # group at the adaptive-block default.
     n_ops = 100_000 // scale
-    packeds = [fixtures.gen_packed("cas", n_ops=n_ops, processes=5,
-                                   seed=100 + s) for s in range(H)]
     model = fixtures.model_for("cas")
-    row = {"rung": f"cas-{n_ops // 1000}k-x{H}", "ops": n_ops * H}
-    res, dt = time_engine(lambda: reach.check_batch(model, packeds))
-    assert all(r["valid"] is True for r in res), "batch rung"
-    row["reach_batch_s"] = round(dt, 4)
-    row["reach_batch_ops_s"] = round(n_ops * H / dt)
-    if wgl_native.available():
-        def _cpp_all():
-            out = [wgl_native.check_packed(model, p) for p in packeds]
-            assert all(r["valid"] is True for r in out)
-            return out
-        res, dt = time_engine(_cpp_all)
-        row["native_s"] = round(dt, 4)
-        row["native_ops_s"] = round(n_ops * H / dt)
-    print(json.dumps(row), flush=True)
+    widths = (8,) if args.quick else (8, 32)    # one rung is enough for CI
+    all_packed = [fixtures.gen_packed("cas", n_ops=n_ops, processes=5,
+                                      seed=100 + s)
+                  for s in range(max(widths))]
+    for H in widths:
+        packeds = all_packed[:H]
+        row = {"rung": f"cas-{n_ops // 1000}k-x{H}", "ops": n_ops * H}
+        res, dt = time_engine(lambda: reach.check_batch(model, packeds))
+        assert all(r["valid"] is True for r in res), (row["rung"], res)
+        row["reach_batch_s"] = round(dt, 4)
+        row["reach_batch_ops_s"] = round(n_ops * H / dt)
+        if wgl_native.available():
+            def _cpp_all():
+                out = [wgl_native.check_packed(model, p) for p in packeds]
+                assert all(r["valid"] is True for r in out), row["rung"]
+                return out
+            res, dt = time_engine(_cpp_all)
+            row["native_s"] = round(dt, 4)
+            row["native_ops_s"] = round(n_ops * H / dt)
+        print(json.dumps(row), flush=True)
     return 0
 
 
