@@ -501,14 +501,23 @@ def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
     return -1, np.asarray(R_cur)
 
 
-def _pipe_geom(B: int, R_pad: int) -> Tuple[int, int]:
+def _pipe_geom(B: int, R_pad: int,
+               nseg: Optional[int] = None) -> Tuple[int, int]:
     """Segment size (returns) and count for the pipelined dispatch.
     Shared by :func:`_pipe_walk` and the ``bench.py`` kernel probe so
     the probe times exactly the programs production dispatches. Applies
     in interpret mode too (differential tests then cover the
-    multi-segment path whenever the history is long enough)."""
+    multi-segment path whenever the history is long enough).
+    ``nseg`` overrides the target segment count (the batch walk's
+    operand set is H× larger, so it pipelines finer). Degrades
+    gracefully: a walk too short for the target halves the segment
+    count until ≥2 blocks per segment remain (instead of dropping
+    straight to a single unpipelined put)."""
+    want = _PIPE_NSEG if nseg is None else nseg
     n_blocks = R_pad // B
-    nseg = _PIPE_NSEG if n_blocks >= 2 * _PIPE_NSEG else 1
+    nseg = want
+    while nseg > 1 and n_blocks < 2 * nseg:
+        nseg //= 2
     segb = -(-n_blocks // nseg)          # blocks per segment
     return segb * B, -(-n_blocks // segb)
 

@@ -85,7 +85,7 @@ def test_batch_multisegment_ragged(monkeypatch):
     geom, _, _ = reach_batch.pack_batch_operands(
         P, ret_slots, slot_ops, M, interpret=True)
     B, _W, _M, _S, _H, _O1, R_pad = geom
-    _seg, nseg = reach_lane._pipe_geom(B, R_pad)
+    _seg, nseg = reach_lane._pipe_geom(B, R_pad, reach_batch._PIPE_NSEG)
     assert nseg > 1
     dead = reach_batch.walk_returns_batch(P, ret_slots, slot_ops, M,
                                           interpret=True)

@@ -277,3 +277,22 @@ def test_lane_pipelined_segments(monkeypatch, corrupt):
     assert (dead2 < 0) == alive
     if not alive:
         assert dead2 == dead
+
+
+def test_pipe_geom_graceful_degradation():
+    """Mid-size walks keep SOME pipelining when too short for the
+    target segment count (halve, don't drop to one unpipelined put),
+    and every geometry covers R_pad exactly."""
+    B = 1024
+    # target 8: n_blocks 12 halves to 4 segments, not 1
+    seg, nseg = reach_lane._pipe_geom(B, 12 * B, 8)
+    assert nseg == 4 and seg == 3 * B
+    # default target 4: long walk keeps 4, short walk halves then 1
+    assert reach_lane._pipe_geom(B, 72 * B)[1] == 4
+    assert reach_lane._pipe_geom(B, 6 * B)[1] == 2
+    assert reach_lane._pipe_geom(B, B)[1] == 1
+    for n_blocks in (1, 2, 3, 5, 8, 12, 16, 31, 72):
+        for want in (None, 8):
+            seg, nseg = reach_lane._pipe_geom(B, n_blocks * B, want)
+            assert seg % B == 0
+            assert (nseg - 1) * seg < n_blocks * B <= nseg * seg
