@@ -96,6 +96,18 @@ def hybrid_mesh(axis_names: Tuple[str, str] = ("dcn", "ici"),
             return Mesh(arr.reshape(n_proc, per_host), axis_names)
         except Exception:                               # noqa: BLE001
             pass                        # topology discovery unavailable
+        # no physical topology (e.g. the CPU backend in multi-process
+        # tests): group rows by owning process — that IS the host
+        # boundary the outer axis models, so collectives along the
+        # inner axis stay process-local wherever the runtime allows
+        by_proc = sorted(devs, key=lambda d: (d.process_index, d.id))
+        if (len({d.process_index for d in devs}) == n_proc
+                and all(d.process_index
+                        == by_proc[(i // per_host) * per_host]
+                        .process_index
+                        for i, d in enumerate(by_proc))):
+            return Mesh(np.array(by_proc).reshape(n_proc, per_host),
+                        axis_names)
     return Mesh(np.array(devs).reshape(1, len(devs)), axis_names)
 
 

@@ -1,0 +1,88 @@
+"""REAL multi-process ``jax.distributed`` execution (SURVEY.md §2.4
+distributed-comms row): two local processes with 4 virtual CPU devices
+each bootstrap a localhost coordinator, form the 2×4 ``hybrid_mesh``
+(DCN × ICI axes), and run the key-sharded ``check_many`` over the
+GLOBAL mesh — XLA/Gloo collectives carry the liveness reduction across
+process boundaries and ``process_allgather`` fetches the results, so
+every byte of the multi-host path executes (only real DCN/ICI links
+are elided). Upstream analogue: none — the reference's analysis is
+single-JVM (SURVEY.md §2.4); this is the TPU-native scale-out story.
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytest.importorskip("jax")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jepsen_tpu.parallel import distributed
+    ok = distributed.initialize(
+        coordinator_address="localhost:" + port,
+        num_processes=2, process_id=pid)
+    assert ok, "distributed.initialize returned False"
+    assert distributed.process_info() == (pid, 2)
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+    mesh = distributed.hybrid_mesh()
+    assert mesh.devices.shape == (2, 4), mesh.devices.shape
+    assert mesh.axis_names == ("dcn", "ici")
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import reach
+    from jepsen_tpu.history import pack
+    model = models.cas_register()
+    packs = []
+    for s in range(17):                 # odd count: pad-key path
+        h = fixtures.gen_history("cas", n_ops=16, processes=3, seed=s)
+        if s == 3:
+            h = fixtures.corrupt(h, seed=s)
+        packs.append(pack(h))
+    res = reach.check_many(model, packs,
+                           devices=list(mesh.devices.ravel()))
+    n_valid = sum(1 for r in res if r["valid"] is True)
+    assert n_valid == 16, n_valid
+    assert res[3]["valid"] is False and "op" in res[3]
+    print("WORKER-OK", pid)
+""").format(repo=_REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_check(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker), str(pid), port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env) for pid in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("distributed workers timed out:\n"
+                    + "\n".join(outs))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER-OK {pid}" in out
