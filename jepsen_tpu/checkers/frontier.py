@@ -956,8 +956,11 @@ def _run_walk_sharded(memo: Memo, rs: ev.ReturnStream,
         status = int(status)
         if status == _STATUS_OVERFLOW:
             # re-embed: collect live rows, re-hash onto bigger shards
-            # (keep growing until the most-loaded shard fits too)
-            rows = np.asarray(C)
+            # (keep growing until the most-loaded shard fits too). The
+            # fetch must go through _fetch: in a multi-process run C
+            # spans non-addressable devices (process_allgather there)
+            from jepsen_tpu.checkers.reach import _fetch
+            rows = _fetch(C)
             rows = rows[rows[:, K] != np.uint32(0xFFFFFFFF)]
             owners = _hash_rows_np(rows, n_dev)
             load = np.bincount(owners, minlength=n_dev).max() if len(rows) \

@@ -84,5 +84,7 @@ def chunked_transfer(args, devs: Sequence):
     R = jax.jit(sm)(P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c,
                     basis_c)
     # [n_chunks, B, S, M] -> [n_chunks, B, D]; B is the (possibly
-    # reachability-restricted) basis row count, D = S·M
-    return np.asarray(R).reshape(R.shape[0], R.shape[1], -1)
+    # reachability-restricted) basis row count, D = S·M. The fetch
+    # goes through reach._fetch: in a multi-process run the sharded
+    # result spans non-addressable devices and needs process_allgather
+    return reach._fetch(R).reshape(R.shape[0], R.shape[1], -1)

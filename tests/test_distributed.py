@@ -1,12 +1,15 @@
 """REAL multi-process ``jax.distributed`` execution (SURVEY.md §2.4
 distributed-comms row): two local processes with 4 virtual CPU devices
 each bootstrap a localhost coordinator, form the 2×4 ``hybrid_mesh``
-(DCN × ICI axes), and run the key-sharded ``check_many`` over the
-GLOBAL mesh — XLA/Gloo collectives carry the liveness reduction across
-process boundaries and ``process_allgather`` fetches the results, so
-every byte of the multi-host path executes (only real DCN/ICI links
-are elided). Upstream analogue: none — the reference's analysis is
-single-JVM (SURVEY.md §2.4); this is the TPU-native scale-out story.
+(DCN × ICI axes), and run ALL THREE sharded engines over the GLOBAL
+mesh — key-sharded ``check_many`` (liveness psum across the process
+boundary), chunk-sharded ``check_chunked`` (shard_map transfer
+matrices, allgathered), and the sparse ``frontier`` (config rows
+hash-routed via all_to_all) — with ``process_allgather`` fetching
+every result, so every byte of the multi-host path executes (only
+real DCN/ICI links are elided). Upstream analogue: none — the
+reference's analysis is single-JVM (SURVEY.md §2.4); this is the
+TPU-native scale-out story.
 """
 import os
 import socket
@@ -48,11 +51,41 @@ _WORKER = textwrap.dedent("""
         if s == 3:
             h = fixtures.corrupt(h, seed=s)
         packs.append(pack(h))
-    res = reach.check_many(model, packs,
-                           devices=list(mesh.devices.ravel()))
+    devs = list(mesh.devices.ravel())
+    res = reach.check_many(model, packs, devices=devs)
     n_valid = sum(1 for r in res if r["valid"] is True)
     assert n_valid == 16, n_valid
     assert res[3]["valid"] is False and "op" in res[3]
+    # chunk axis sharded across the process boundary (shard_map +
+    # allgathered transfer matrices)
+    hist = fixtures.gen_history("cas", n_ops=64, processes=3, seed=7)
+    resc = reach.check_chunked(model, hist, n_chunks=8, devices=devs)
+    assert resc["valid"] is True, resc
+    # sparse frontier: config rows hash-routed cross-process
+    from jepsen_tpu.checkers import frontier
+    hist3 = fixtures.gen_history("register", n_ops=24, processes=3,
+                                 crash_p=0.2, seed=11)
+    res3 = frontier.check(models.register(), hist3, frontier0=256,
+                          devices=devs)
+    assert res3["valid"] is True, res3
+    # frontier overflow escalation fetches the globally-sharded
+    # frontier (process_allgather, not np.asarray) before deciding the
+    # cap is exceeded — drive that line cross-process via the
+    # capped-overflow case (one walk geometry, no recompile ladder)
+    from jepsen_tpu.history import index
+    from jepsen_tpu.op import info, invoke, ok
+    hh = [invoke(0, "write", 0), ok(0, "write", 0)]
+    for c in range(10):
+        hh += [invoke(100 + c, "cas", (c % 5, (c + 1) % 5)),
+               info(100 + c, "cas", (c % 5, (c + 1) % 5))]
+    for i in range(6):
+        hh += [invoke(0, "write", i % 5), ok(0, "write", i % 5)]
+    try:
+        frontier.check(models.cas_register(), index(hh), frontier0=64,
+                       max_frontier=512, devices=devs)
+        raise SystemExit("expected FrontierOverflow")
+    except frontier.FrontierOverflow:
+        pass
     print("WORKER-OK", pid)
 """).format(repo=_REPO)
 
