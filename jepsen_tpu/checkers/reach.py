@@ -1016,7 +1016,8 @@ def _check_many_keyed(model, rss, preps, live, results, packed_list,
 
 
 def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
-                live: Sequence[int], max_states: int, max_slots: int):
+                live: Sequence[int], max_states: int, max_slots: int,
+                need_pallas: bool = True):
     """Shared union-alphabet native preprocessing for the batched
     device engines (keyed kernel and the lockstep batch kernel): ONE
     memo over the union of every history's op alphabet + ONE native
@@ -1026,7 +1027,9 @@ def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
     overflows max_slots under the union memo's coarser noop
     classification (callers fall back to per-history paths, whose
     per-key noop dropping may still fit — and which raise
-    ConcurrencyOverflow on genuine overflow)."""
+    ConcurrencyOverflow on genuine overflow). ``need_pallas=False``
+    skips the Pallas VMEM gate for consumers that only run the XLA
+    walk (the mesh lane)."""
     from jepsen_tpu.checkers import preproc_native
 
     union: Dict[Any, int] = {}
@@ -1079,7 +1082,8 @@ def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
     W = max(int(key_W.max()), 1)
     M = 1 << W
     if not (_fast_ok(S_pad, W, M, memo_u.n_ops)
-            and _pallas_fits(S_pad, M, memo_u.n_ops)):
+            and (not need_pallas
+                 or _pallas_fits(S_pad, M, memo_u.n_ops))):
         return None                     # general path may still fit
     ops_flat = np.ascontiguousarray(ops_wide[:, :W])
     offsets = np.concatenate([[0], np.cumsum(key_R)])
@@ -1190,11 +1194,9 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
         p = packed_list[i]
         dropped = int(drop_per_key[k])
         if int(dead[k]) < 0:
-            results[i] = {
-                "valid": True, "engine": "reach-lockstep",
-                "events": (p.n - dropped) + int(key_R[k]),
-                "slots": int(key_W[k]), "states": memo_u.n_states,
-                "dropped-crashed-noops": dropped, "time-s": elapsed}
+            results[i] = _union_valid_result(
+                "reach-lockstep", p, dropped, int(key_R[k]),
+                int(key_W[k]), memo_u.n_states, elapsed)
         else:
             # decode the failure in the history's LOCAL geometry with
             # the full per-history pipeline (dead[k] is already a
@@ -1271,11 +1273,9 @@ def _check_many_native(model: Model,
         p = packed_list[i]
         dropped = int(drop_per_key[k])
         if int(dead[k]) < 0:
-            results[i] = {
-                "valid": True, "engine": "reach-keyed",
-                "events": (p.n - dropped) + int(key_R[k]),
-                "slots": int(key_W[k]), "states": memo_u.n_states,
-                "dropped-crashed-noops": dropped, "time-s": elapsed}
+            results[i] = _union_valid_result(
+                "reach-keyed", p, dropped, int(key_R[k]),
+                int(key_W[k]), memo_u.n_states, elapsed)
         else:
             # rare: decode the failure in the key's LOCAL geometry with
             # the full per-key pipeline (same return ordering — drops
@@ -1292,6 +1292,113 @@ def _check_many_native(model: Model,
             _attach_witness(results[i], memo_k, rs_k,
                             _build_P(memo_k, S_k), S_k, M_k, W_k,
                             local, p)
+    return results  # type: ignore[return-value]
+
+
+def _union_valid_result(engine: str, p: h.PackedHistory, dropped: int,
+                        key_R_k: int, key_W_k: int, n_states: int,
+                        elapsed: float) -> Dict[str, Any]:
+    """Valid verdict from the union geometry — shared by the keyed,
+    lockstep, and mesh union lanes (one source for the events/slots
+    accounting)."""
+    return {"valid": True, "engine": engine,
+            "events": (p.n - dropped) + key_R_k,
+            "slots": key_W_k, "states": n_states,
+            "dropped-crashed-noops": dropped, "time-s": elapsed}
+
+
+def _key_axis_shardings(devices: Sequence, n_keys: int):
+    """Mesh + (sharded, replicated) NamedShardings for a leading key
+    axis, and the pad count making ``n_keys`` device-divisible —
+    shared by both mesh branches of :func:`check_many`."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from jepsen_tpu import parallel as par
+
+    m = par.mesh("keys", list(devices))
+    n_dev = len(devices)
+    pad = -(-n_keys // n_dev) * n_dev - n_keys
+    return (NamedSharding(m, PartitionSpec("keys")),
+            NamedSharding(m, PartitionSpec()), pad)
+
+
+def _check_many_mesh_native(model: Model,
+                            packed_list: Sequence[h.PackedHistory],
+                            max_states: int, max_slots: int,
+                            max_dense: int, devices: Sequence,
+                            t0: float) -> Optional[List[Dict[str, Any]]]:
+    """Union-native fast lane for the MESH path of :func:`check_many`:
+    the same ONE-memo + ONE-native-build prep as
+    :func:`_check_many_native`, marshaled into the key-padded arrays
+    the sharded vmapped XLA walk consumes — replacing the per-key
+    memo/BFS/event-build pipeline (~2 s of serial host time at 4096
+    keys, paid by EVERY process in a multi-host run). Valid keys are
+    answered from the union geometry; the rare failed key decodes
+    exactly via :func:`check_packed`. Returns None to fall through to
+    the general mesh path (no native lib, union explosion, budget
+    overflow)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers import preproc_native
+
+    if not preproc_native.available():
+        return None
+    live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
+    if len(live) < 2:
+        return None
+    u = _union_prep(model, packed_list, live, max_states, max_slots,
+                    need_pallas=False)
+    if u is None:
+        return None
+    (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
+     offsets, opid_cat, crs_cat, offs, noop_op) = u
+    if S_pad * M > max_dense:
+        return None
+    K_live = len(live)
+    R_pad = max(64, _bucket(int(key_R.max()), _UNROLL))
+    slot_np = np.full((K_live, R_pad), -1, np.int32)
+    ops_np = np.full((K_live, R_pad, W), -1, np.int32)
+    for k in range(K_live):
+        lo, hi = int(offsets[k]), int(offsets[k + 1])
+        slot_np[k, :hi - lo] = ret_flat[lo:hi]
+        ops_np[k, :hi - lo] = ops_flat[lo:hi]
+    R0 = np.zeros((S_pad, M), bool)
+    R0[0, 0] = True
+    xor_cols, bitmask = _xor_bitmask(W, M)
+    skey, srep, pad = _key_axis_shardings(devices, K_live)
+
+    def padk(a):
+        return np.concatenate(
+            [a, np.repeat(a[:1], pad, axis=0)]) if pad else a
+
+    slot_b = jax.device_put(padk(slot_np), skey)
+    ops_b = jax.device_put(padk(ops_np), skey)
+    P_dev = jax.device_put(P, srep)
+    R0_b = jax.device_put(R0, srep)
+    xc, bm = jnp.asarray(xor_cols), jnp.asarray(bitmask)
+    _ptrs, _, alives, _R_blocks = _jitted_walk_returns_batch_shared()(
+        P_dev, xc, bm, slot_b, ops_b, R0_b)
+    elapsed = _time.monotonic() - t0
+    alives = _fetch(alives)[:K_live]
+    drop_cat = (crs_cat & noop_op[opid_cat]).astype(np.int64)
+    drop_per_key = np.add.reduceat(drop_cat, offs[:-1])
+    results: List[Optional[Dict[str, Any]]] = [
+        {"valid": True, "engine": "reach-batch", "events": 0,
+         "time-s": 0.0} if (packed_list[i].n == 0
+                            or packed_list[i].n_ok == 0) else None
+        for i in range(len(packed_list))]
+    for k, i in enumerate(live):
+        p = packed_list[i]
+        if bool(alives[k]):
+            results[i] = _union_valid_result(
+                "reach-batch", p, int(drop_per_key[k]), int(key_R[k]),
+                int(key_W[k]), memo_u.n_states, elapsed)
+        else:
+            # rare: exact single-history decode with full witness
+            results[i] = check_packed(model, p, max_states=max_states,
+                                      max_slots=max_slots,
+                                      max_dense=max_dense)
     return results  # type: ignore[return-value]
 
 
@@ -1323,6 +1430,11 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                                  max_states=max_states,
                                  max_slots=max_slots,
                                  max_dense=max_dense, t0=t0)
+        if out is not None:
+            return out
+    else:
+        out = _check_many_mesh_native(model, packed_list, max_states,
+                                      max_slots, max_dense, devices, t0)
         if out is not None:
             return out
     _seed_union_memo(model, [p for p in packed_list
@@ -1385,18 +1497,12 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                 # whose verdict is discarded), shard the leading axis,
                 # replicate the shared operands
                 import jax
-                from jax.sharding import NamedSharding, PartitionSpec
-                from jepsen_tpu import parallel as par
-                K_pad = -(-K_live // n_dev) * n_dev
-                pad = K_pad - K_live
+                skey, srep, pad = _key_axis_shardings(devices, K_live)
 
                 def padk(a):
                     return np.concatenate(
                         [a, np.repeat(a[:1], pad, axis=0)]) if pad else a
 
-                m = par.mesh("keys", devices)
-                skey = NamedSharding(m, PartitionSpec("keys"))
-                srep = NamedSharding(m, PartitionSpec())
                 slot_b = jax.device_put(padk(slot_np), skey)
                 ops_b = jax.device_put(padk(ops_np), skey)
                 if shared:
