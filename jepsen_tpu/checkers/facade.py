@@ -266,6 +266,8 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
 # keyword subsets understood by each engine; user opts are filtered so one
 # checker config can carry opts for every algorithm it may route to.
 _REACH_KW = ("max_states", "max_slots", "max_dense", "should_abort")
+# check_many additionally shards the key axis over a mesh
+_REACH_MANY_KW = _REACH_KW + ("devices",)
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
 _FRONTIER_KW = ("max_states", "frontier0", "max_frontier", "time_limit",
                 "should_abort", "devices")

@@ -81,13 +81,15 @@ class IndependentChecker(Checker):
         from jepsen_tpu.checkers.events import ConcurrencyOverflow
         from jepsen_tpu.models.memo import StateExplosion
 
-        from jepsen_tpu.checkers.facade import (_REACH_KW, _engine_kw,
-                                                _model_from)
+        from jepsen_tpu.checkers.facade import (_REACH_MANY_KW,
+                                                _engine_kw, _model_from)
         model = _model_from(self.inner.model, test)
         kw = dict(self.inner.opts)
         if opts:
             kw.update(opts)
-        kw = _engine_kw(kw, _REACH_KW)
+        # _REACH_MANY_KW includes "devices": the key axis IS the
+        # sharded axis, so a user-supplied mesh must reach check_many
+        kw = _engine_kw(kw, _REACH_MANY_KW)
         packs, fits, results = {}, [], {}
         for k in keys:
             try:

@@ -244,3 +244,33 @@ class TestReporting:
         )
         pts = perf.latency_points(h)
         assert pts["ok"] == [(0.0, 5.0)]
+
+
+class TestIndependentMesh:
+    def test_devices_opt_reaches_check_many(self, monkeypatch):
+        """A user-supplied mesh in the checker opts must reach the
+        batched key-sharded path (it was previously filtered out),
+        with verdicts identical to the single-device route."""
+        import jax
+
+        from jepsen_tpu.checkers import reach
+        seen = {}
+        orig = reach.check_many
+
+        def spy(model, packs, **kw):
+            seen.update(kw)
+            return orig(model, packs, **kw)
+
+        monkeypatch.setattr(reach, "check_many", spy)
+        t = TestIndependent()
+        h = t._multi_key_history(n_keys=5, corrupt_key=2)
+        c = independent.checker(
+            linearizable(m.cas_register(), devices=jax.devices()))
+        res = c.check(None, h)
+        assert list(seen.get("devices", [])) == jax.devices()
+        assert res["valid"] is False
+        assert res["failures"] == [2]
+        ref = independent.checker(
+            linearizable(m.cas_register())).check(None, h)
+        assert {k: r["valid"] for k, r in res["results"].items()} == \
+               {k: r["valid"] for k, r in ref["results"].items()}
