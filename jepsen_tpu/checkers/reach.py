@@ -1097,12 +1097,14 @@ def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
 # geometry, W=5 S=8): SMEM holds 1 MB — the B*H*W i32 double-buffered
 # slot_ops window is kept under it by shrinking the block size as H
 # grows (reach_batch._adaptive_block: B=1024 to H=16, 512 at H=32) —
-# and VMEM holds 16 MB scoped, which the H=64 geometry exceeds by
-# 212 KB (the 2×[HS, W·HS] f32 transition scratch is 10.5 MB alone).
-# H=32 is the widest that compiles; it is also the e2e winner (one
-# dispatch group + one fetch over 32 histories: 3.2M agg ops/s vs
-# 2.3M at H=16 on 32×cas-100k) while per-history-return kernel cost
-# is ~flat from H=16 (43-48 ns). Wider batches chunk into groups.
+# and VMEM holds 16 MB scoped, which the H=64 f32 geometry exceeded
+# by 212 KB (the 2×[HS, W·HS] transition scratch is 10.5 MB alone in
+# f32; the bf16 compute dtype halves it, so H=64 now COMPILES — but
+# loses per-history to H=32 on step cost, so it stays non-default).
+# H=32 is the e2e winner (one dispatch group + one fetch over 32
+# histories: 3.2M agg ops/s vs 2.3M at H=16 on 32×cas-100k) while
+# per-history-return kernel cost is ~flat from H=16 (43-60 ns across
+# sessions). Wider batches chunk into groups.
 _BATCH_GROUP = 32
 
 

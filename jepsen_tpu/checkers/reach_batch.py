@@ -85,7 +85,11 @@ def _one_fire_pass_b(R, G_all, W: int, M: int, HS: int):
     ``[M,HS] @ [HS, W*HS]`` matmul (block-diagonal G ⇒ history h's
     image depends only on history h's set), then the per-slot mask
     blends on the M axis — identical math to
-    ``reach_pallas._one_fire_pass`` with S widened to H*S lanes."""
+    ``reach_pallas._one_fire_pass`` with S widened to H*S lanes.
+    Exact in bf16 too: operands are 0/1 (exactly representable), the
+    dot accumulates in f32 (``preferred_element_type`` — sums can
+    reach P's per-column fan-in, so this is load-bearing), and the
+    blend compares > 0.5 on the f32 image before any rounding back."""
     import jax.numpy as jnp
 
     F = jnp.dot(R, G_all, preferred_element_type=jnp.float32)
@@ -95,7 +99,7 @@ def _one_fire_pass_b(R, G_all, W: int, M: int, HS: int):
         Rr = R.reshape(half, 2, blk, HS)
         Fr = Fj.reshape(half, 2, blk, HS)
         hi = jnp.maximum(
-            Rr[:, 1], (Fr[:, 0] > 0.5).astype(jnp.float32))
+            Rr[:, 1], (Fr[:, 0] > 0.5).astype(R.dtype))
         R = jnp.stack([Rr[:, 0], hi], axis=1).reshape(M, HS)
     return R
 
@@ -156,7 +160,9 @@ def _make_batch_kernel(B: int, W: int, M: int, S: int, H: int,
             # guarantee of the batched fire matmul)
             G_scr[:] = jnp.zeros_like(G_scr)
 
-        ckpt_ref[0] = R_scr[:]                   # set at block START
+        # checkpoints/final stay f32 regardless of the compute dtype
+        # (host-side localization reads them with > 0.5 unchanged)
+        ckpt_ref[0] = R_scr[:].astype(jnp.float32)  # set at block START
         _gather_G_b(slot_ops_ref, P_ref, 0, W, H, S, O1, G_scr, 0)
 
         def one(k, R):
@@ -172,14 +178,14 @@ def _make_batch_kernel(B: int, W: int, M: int, S: int, H: int,
             # history's returning slot (-1 = none) replicated over its
             # S lanes
             row = jv_ref[k]                      # [HS] f32
-            acc = R * (row < 0).astype(jnp.float32)
+            acc = R * (row < 0).astype(R.dtype)
             for jj in range(W):
                 half, blk = M >> (jj + 1), 1 << jj
                 Rr = R.reshape(half, 2, blk, HS)
                 taken = Rr[:, 1]
                 proj = jnp.stack([taken, jnp.zeros_like(taken)],
                                  axis=1).reshape(M, HS)
-                acc = acc + proj * (row == jj).astype(jnp.float32)
+                acc = acc + proj * (row == jj).astype(R.dtype)
             return acc
 
         def do_return(i, _):
@@ -190,19 +196,35 @@ def _make_batch_kernel(B: int, W: int, M: int, S: int, H: int,
 
         @pl.when(step == n_blocks - 1)
         def _finish():
-            final_ref[:] = R_scr[:]
+            final_ref[:] = R_scr[:].astype(jnp.float32)
 
     return kernel
 
 
+# compute dtype for the config sets and transition operand. bf16 is
+# EXACT here because every stored value is 0 or 1 (exactly
+# representable) and the fire dot ACCUMULATES IN F32 via
+# preferred_element_type — column sums can reach the per-column
+# fan-in of P (up to S), so the f32 accumulation is the load-bearing
+# half of the argument, with the > 0.5 compare reading the f32 image
+# before anything is rounded back to bf16. Halves the VMEM footprint
+# and traffic of the G operand scratch — the resource that pinned the
+# lockstep width at 32 (H=64's f32 geometry exceeded the 16 MB
+# scoped-VMEM limit by 212 KB). Checkpoint/final outputs stay f32 so
+# host-side localization is unchanged.
+_COMPUTE_DTYPE = "bfloat16"
+
+
 @functools.cache
 def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
-                R_pad: int, n_pass: int, interpret: bool):
+                R_pad: int, n_pass: int, interpret: bool,
+                dtype: str = _COMPUTE_DTYPE):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    cdt = jnp.dtype(dtype)
     HS = H * S
     n_blocks = R_pad // B
     # 1-D SMEM windows must tile to 1024 (Mosaic layout verification
@@ -243,8 +265,8 @@ def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
             jax.ShapeDtypeStruct((M, HS), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((M, HS), jnp.float32),
-            pltpu.VMEM((2, HS, W * HS), jnp.float32),
+            pltpu.VMEM((M, HS), cdt),
+            pltpu.VMEM((2, HS, W * HS), cdt),
         ],
         interpret=interpret,
     )
@@ -254,6 +276,8 @@ def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
         # batch-max pending count per return gates the ladder; the
         # projection lane row expands each history's returning slot
         # over its S lanes
+        P = P.astype(cdt)
+        R0 = R0.astype(cdt)
         ops32 = slot_ops.astype(jnp.int32)
         pend = jnp.sum((ops32.reshape(-1, H, W) >= 0).astype(jnp.int32),
                        axis=2)
@@ -317,11 +341,17 @@ def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
     B, W, M, S, H, O1, R_pad = geom
     ops_flat, rs_rh, P, R0 = host_args
     seg, nseg = _pipe_geom(B, R_pad, _PIPE_NSEG)
-    run = _batch_call(B, W, M, S, H, O1, seg, n_pass, interpret)
+    run = _batch_call(B, W, M, S, H, O1, seg, n_pass, interpret,
+                      _COMPUTE_DTYPE)
     fresh = "segs" not in dsegs
     if fresh:
-        dsegs["dP"] = jax.device_put(P)
-        dsegs["dR0"] = jax.device_put(R0)
+        # cast to the compute dtype BEFORE the wire: bf16 halves the
+        # transfer and the in-jit astype then no-ops (leaving it f32
+        # here would re-materialize a converted copy on every segment
+        # dispatch)
+        import jax.numpy as jnp
+        dsegs["dP"] = jnp.asarray(P, dtype=_COMPUTE_DTYPE)
+        dsegs["dR0"] = jnp.asarray(R0, dtype=_COMPUTE_DTYPE)
         dsegs["segs"] = []
     R_cur = dsegs["dR0"]
     ckpts = []
