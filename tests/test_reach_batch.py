@@ -172,3 +172,40 @@ def test_adaptive_block_smem_budget():
             assert B & (B - 1) == 0 and B >= 32
             assert (B * H * W * 8 <= reach_batch._SMEM_BUDGET
                     or B == 32)
+
+
+def test_batch_width_one_tail_group(monkeypatch):
+    """check_batch chunks wide inputs into dispatch groups; a tail
+    group of ONE history must run the lockstep kernel at H=1 (HS=S
+    geometry) with verdicts identical to the single walk — driven
+    through the PUBLIC grouping loop (group=2 over 3 histories) and
+    cross-checked at the kernel level including the dead index."""
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    monkeypatch.setattr(
+        reach_batch, "walk_returns_batch",
+        functools.partial(reach_batch.walk_returns_batch,
+                          interpret=True))
+    model = models.cas_register()
+    hists = [fixtures.gen_history("cas", n_ops=60, processes=3, seed=s)
+             for s in range(3)]
+    hists[2] = fixtures.corrupt(hists[2], seed=9)
+    packed = [pack(h) for h in hists]
+    # public path: groups of 2 + 1, the tail dispatch is H=1
+    res = reach.check_batch(model, packed, group=2)
+    refs = [reach.check_packed(model, p) for p in packed]
+    for k in range(3):
+        assert res[k]["valid"] == refs[k]["valid"], f"history {k}"
+        assert res[k]["engine"] == "reach-lockstep"
+    assert res[2]["valid"] is False
+    assert res[2].get("dead-event") == refs[2].get("dead-event")
+    # kernel level: the H=1 walk's dead INDEX matches the single walk
+    _packed, P, ret_slots, slot_ops, M = _batch_operands(model, hists)
+    dead1 = reach_batch.walk_returns_batch(P, ret_slots[2:],
+                                           slot_ops[2:], M,
+                                           interpret=True)
+    R0 = np.zeros((P.shape[1], M), bool)
+    R0[0, 0] = True
+    d_ref, _ = reach_lane.walk_returns(P, ret_slots[2], slot_ops[2],
+                                       R0, interpret=True)
+    assert dead1[0] == d_ref and dead1[0] >= 0
