@@ -217,8 +217,7 @@ _COMPUTE_DTYPE = "bfloat16"
 
 @functools.cache
 def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
-                R_pad: int, n_pass: int, interpret: bool,
-                dtype: str = _COMPUTE_DTYPE):
+                R_pad: int, n_pass: int, interpret: bool, dtype: str):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl

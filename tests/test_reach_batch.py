@@ -209,3 +209,35 @@ def test_batch_width_one_tail_group(monkeypatch):
     d_ref, _ = reach_lane.walk_returns(P, ret_slots[2], slot_ops[2],
                                        R0, interpret=True)
     assert dead1[0] == d_ref and dead1[0] >= 0
+
+
+def test_batch_bf16_geometry_matches_single_walk():
+    """16 histories x S=8 reaches HS=128 — the full-lane geometry
+    where the batch kernel computes in bf16 (narrower tests run the
+    f32 branch since the lane-width gate): verdicts AND dead indices
+    must still match the single f32 walk exactly."""
+    model = models.cas_register()
+    hists = []
+    for seed in range(16):
+        h = fixtures.gen_history("cas", n_ops=40, processes=3,
+                                 seed=300 + seed)
+        if seed in (4, 11):
+            h = fixtures.corrupt(h, seed=seed)
+        hists.append(h)
+    packed, P, ret_slots, slot_ops, M = _batch_operands(model, hists)
+    S = P.shape[1]
+    assert len(hists) * S >= 128        # bf16 branch actually taken
+    dead = reach_batch.walk_returns_batch(P, ret_slots, slot_ops, M,
+                                          interpret=True)
+    n_bad = 0
+    for k, p in enumerate(packed):
+        ref = reach.check_packed(model, p)
+        assert (dead[k] < 0) == bool(ref["valid"]), f"history {k}"
+        if dead[k] >= 0:
+            n_bad += 1
+            R0 = np.zeros((S, M), bool)
+            R0[0, 0] = True
+            d1, _ = reach_lane.walk_returns(
+                P, ret_slots[k], slot_ops[k], R0, interpret=True)
+            assert d1 == dead[k], f"history {k}: {d1} != {dead[k]}"
+    assert n_bad >= 1
