@@ -129,6 +129,36 @@ def kernel_probe(model, packed) -> dict:
     }
 
 
+def chunklock_probe(model, packed) -> dict:
+    """Steady-state timing of the chunk-lockstep walk — the production
+    single-history engine at the headline rung (round-5): warm best-of
+    e2e of the full phase-A/glue/phase-B/fold dispatch chain, plus its
+    geometry diagnostics."""
+    import time as _t
+
+    from jepsen_tpu.checkers import events as ev
+    from jepsen_tpu.checkers import reach
+    from jepsen_tpu.checkers import reach_chunklock as rcl
+
+    memo, stream, _T, S, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    rs = ev.returns_view(stream)
+    if not rcl.admits(S, M, max(stream.W, 1), rs.n_returns):
+        return {"skipped": "outside chunklock envelope"}
+    P = reach._build_P(memo, S)
+    dead, diag = rcl.walk_chunklock(P, rs.ret_slot, rs.slot_ops, M)
+    times = []
+    for _ in range(4):
+        t0 = _t.monotonic()
+        dead, diag = rcl.walk_chunklock(P, rs.ret_slot, rs.slot_ops, M)
+        times.append(_t.monotonic() - t0)
+    best = min(times)
+    return {"walk_s": round(best, 4),
+            "ns_per_return": round(best / max(rs.n_returns, 1) * 1e9),
+            "returns": int(rs.n_returns), "dead": int(dead), **diag}
+
+
 def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
     """Lockstep batch rung (BASELINE.md round-4): H independent
     histories through ONE ``reach.check_batch`` call — the batch axis
@@ -247,6 +277,10 @@ def main() -> int:
             # probe is diagnostics, not the metric: histories the lane
             # kernel does not admit (or CPU-only runs) skip it
             out["kernel"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["chunklock"] = chunklock_probe(model, packed)
+        except Exception as e:                          # noqa: BLE001
+            out["chunklock"] = {"error": f"{type(e).__name__}: {e}"}
         if not args.no_batch and args.ops <= 200_000:
             try:
                 out["batch"] = batch_probe(model, args.ops, args.seed,

@@ -414,6 +414,22 @@ def _next_pow2(x: int) -> int:
     return 1 << max(0, (x - 1)).bit_length()
 
 
+def _raised_from_jax(e: BaseException) -> bool:
+    """True when the exception is jax/jaxlib's — either by class (e.g.
+    XlaRuntimeError) or by raise site (jax raises builtin ValueError/
+    RuntimeError for mesh-shape and OOM failures, which must keep the
+    graceful fallback while our own programming errors surface)."""
+    if (type(e).__module__ or "").startswith(("jax", "jaxlib")):
+        return True
+    tb = e.__traceback__
+    while tb is not None:
+        mod = tb.tb_frame.f_globals.get("__name__", "")
+        if mod.startswith(("jax", "jaxlib")):
+            return True
+        tb = tb.tb_next
+    return False
+
+
 def _bucket(x: int, grain: int = 8) -> int:
     """Round up to ``m·2^e`` with 8 mantissa steps per octave (≤12.5%
     padding), then to a multiple of ``grain``. Compared to next-pow-2
@@ -637,11 +653,15 @@ def _pad_table(memo: Memo, S_pad: int, O_pad: int) -> np.ndarray:
 
 def _prep(model: Model, packed: h.PackedHistory, *,
           max_states: int, max_slots: int, max_dense: int,
-          e_bucket: int = 64):
+          e_bucket: int = 64, memo: Optional[Memo] = None):
     """Shared host-side pipeline: memo table + slotted event stream, with
     the event axis padded to :func:`_bucket` sizes (8 per octave) so jit
-    compilations are reused across histories of similar size."""
-    memo = _cached_memo(model, packed, max_states)
+    compilations are reused across histories of similar size. A caller
+    may inject a prebuilt ``memo`` (the restricted-product transactional
+    checker builds one over only the jointly-reachable product states —
+    :mod:`jepsen_tpu.checkers.decompose`)."""
+    if memo is None:
+        memo = _cached_memo(model, packed, max_states)
     stream = ev.build(packed, memo, max_slots=max_slots)
     S = memo.n_states
     S_pad = max(2, _next_pow2(S))
@@ -805,7 +825,8 @@ _ABORTED = {"valid": "unknown", "cause": "aborted", "engine": "reach"}
 def check_packed(model: Model, packed: h.PackedHistory, *,
                  max_states: int = 100_000, max_slots: int = 20,
                  max_dense: int = 1 << 22,
-                 should_abort=None) -> Dict[str, Any]:
+                 should_abort=None,
+                 memo: Optional[Memo] = None) -> Dict[str, Any]:
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
@@ -814,11 +835,37 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                 "time-s": 0.0}
     memo, stream, T, S_pad, M = _prep(
         model, packed, max_states=max_states, max_slots=max_slots,
-        max_dense=max_dense)
+        max_dense=max_dense, memo=memo)
     W = max(stream.W, 1)
     if _fast_ok(S_pad, W, M, memo.n_ops):
         rs = ev.returns_view(stream)
         P_np = _build_P(memo, S_pad)
+        if (_use_pallas() and _pallas_fits(S_pad, M, memo.n_ops)
+                and should_abort is None):
+            # chunk-lockstep first: the batch kernel's per-return
+            # amortization applied to this one history (phases chain
+            # as async dispatches; ONE round trip on the happy path).
+            # Any failure falls through to the sequential lane walk.
+            from jepsen_tpu.checkers import reach_chunklock as rcl
+            if rcl.enabled() and rcl.admits(S_pad, M, W, rs.n_returns):
+                try:
+                    dead, diag = rcl.walk_chunklock(
+                        P_np, rs.ret_slot, rs.slot_ops, M)
+                    elapsed = _time.monotonic() - t0
+                    if dead < 0:
+                        out = _result_valid("reach-chunklock", stream,
+                                            memo, elapsed)
+                        out.update(diag)
+                        return out
+                    out = _result_invalid(
+                        "reach-chunklock", stream, memo, packed,
+                        int(rs.ret_event[dead]), elapsed)
+                    out.update(diag)
+                    _attach_witness(out, memo, rs, P_np, S_pad, M,
+                                    W, int(dead), packed)
+                    return out
+                except Exception as e:                  # noqa: BLE001
+                    _warn_pallas_failed(f"chunklock: {e!r}")
         if (_use_pallas() and _pallas_fits(S_pad, M, memo.n_ops)
                 and rs.n_returns >= _PALLAS_MIN_RETURNS):
             R0_np = np.zeros((S_pad, M), bool)
@@ -1146,7 +1193,18 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
             return check_many(model, packed_list, max_states=max_states,
                               max_slots=max_slots, max_dense=max_dense,
                               devices=devices)
+        except (DenseOverflow, ev.ConcurrencyOverflow,
+                StateExplosion) as e:
+            logging.getLogger("jepsen.reach").warning(
+                "sharded history batch failed (%r); falling back to "
+                "the single-device path", e)
         except Exception as e:                          # noqa: BLE001
+            # jax/XLA runtime failures (mesh shape, compile, OOM) keep
+            # the graceful fallback; genuine programming errors
+            # (NameError, shape bugs in our code) must surface, not
+            # silently degrade every sharded batch
+            if not _raised_from_jax(e):
+                raise
             logging.getLogger("jepsen.reach").warning(
                 "sharded history batch failed (%r); falling back to "
                 "the single-device path", e)
