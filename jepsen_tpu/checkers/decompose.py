@@ -25,12 +25,16 @@ forever-pending semantics exactly.
 """
 from __future__ import annotations
 
+import itertools
 import time as _time
 from dataclasses import replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from jepsen_tpu import history as h
 from jepsen_tpu import models
+from jepsen_tpu.models.memo import Memo, StateExplosion
 from jepsen_tpu.op import Op
 
 
@@ -187,6 +191,216 @@ def check_transactional(model: models.Model, packed: h.PackedHistory, *,
             "multi-key transactions: every per-key projection is "
             "linearizable, but projections cannot certify cross-key "
             "atomicity (locality does not apply to transactions)")
+    return out
+
+
+class _KeyWalk:
+    """Per-key projection walk with exact config sets ⟨value,
+    fired-pending-subset⟩ — the per-key face of Lowe's JIT
+    linearization, kept on host because its job is not the verdict but
+    the per-window VALUE CLOSURE: the set of values this key can hold
+    at any moment of the current window, under any linearization of
+    its pending projected ops. Sound per-component bound for the joint
+    walk: a linearization of the full transactional history projects
+    to a per-key linearization (each transaction applies atomically),
+    so every joint state's k-component lies in key k's closure."""
+
+    def __init__(self, init: Any, max_configs: int):
+        self.configs = {(init, frozenset())}
+        self.pending: Dict[int, Tuple[str, Any]] = {}   # eid -> (f, v)
+        self.max_configs = max_configs
+        self._avals: Optional[set] = {init}
+        self._clo: Optional[set] = None     # cached window closure
+
+    def invoke(self, eid: int, f: str, v: Any) -> None:
+        self.pending[eid] = (f, v)
+        self._avals = None
+        self._clo = None
+
+    def _closure(self) -> set:
+        if self._clo is not None:
+            return self._clo
+        seen = set(self.configs)
+        frontier = list(seen)
+        while frontier:
+            val, fired = frontier.pop()
+            for eid, (f, pv) in self.pending.items():
+                if eid in fired:
+                    continue
+                if f == "read":
+                    if pv is not None and pv != val:
+                        continue
+                    nxt = (val, fired | {eid})
+                else:
+                    nxt = (pv, fired | {eid})
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+            if len(seen) > self.max_configs:
+                raise StateExplosion(
+                    f"per-key closure beyond {self.max_configs}")
+        self._clo = seen
+        return seen
+
+    def values(self) -> set:
+        """Value closure of the current window (cached between events
+        touching this key)."""
+        if self._avals is None:
+            self._avals = {v for v, _ in self._closure()}
+        return self._avals
+
+    def project(self, eid: int) -> None:
+        """Return of entry ``eid``'s component on this key: closure,
+        keep configs that fired it, retire the pending slot."""
+        clo = self._closure()
+        self.configs = {(v, fired - {eid}) for v, fired in clo
+                        if eid in fired}
+        del self.pending[eid]
+        self._avals = None
+        self._clo = None
+        if not self.configs:
+            # the PROJECTION is already invalid — the joint walk will
+            # agree; keep a non-empty set so memo construction can
+            # finish (the dense engine produces the exact witness)
+            self.configs = {(v, fired - {eid}) for v, fired in clo}
+            if not self.configs:
+                self.configs = {(None, frozenset())}
+
+
+def _regs_model(keys: Sequence[Any], combo: Sequence[Any]
+                ) -> models.MultiRegister:
+    return models.MultiRegister(
+        tuple(sorted(zip(keys, combo), key=repr)))
+
+
+def check_restricted_product(model: models.Model,
+                             packed: h.PackedHistory, *,
+                             max_states: int = 100_000,
+                             max_slots: int = 20,
+                             max_dense: int = 1 << 22,
+                             max_product: int = 4096,
+                             max_key_configs: int = 65536,
+                             should_abort=None
+                             ) -> Optional[Dict[str, Any]]:
+    """EXACT verdict for multi-key transactional histories whose full
+    product space explodes the memo BFS (VERDICT round-4 item 2):
+    restrict the product to the states jointly reachable at some
+    window. Per-key projection walks (:class:`_KeyWalk`) yield each
+    key's exact per-window value closure; any live joint config's
+    k-component lies in that closure (locality of the projection), so
+    the union over windows of the per-key closure PRODUCTS contains
+    every product state the dense walk can ever occupy — typically
+    O(history) states where the alphabet BFS needs ``values**keys``.
+    The restricted transition table is then just stepped over those
+    states (transitions leaving the set are provably never taken by a
+    live config and map to -1), and the standard dense device engine
+    runs unchanged via memo injection.
+
+    Returns the dense engine's verdict dict (engine
+    ``decompose-product``) or ``None`` when the history is not
+    multi-register transactional shaped; raises
+    :class:`~jepsen_tpu.models.memo.StateExplosion` when even the
+    restricted space exceeds the budget — the caller's projection
+    screen then provides the sound unknown. Upstream analogue: none
+    (knossos only offers the monolithic product search; SURVEY.md
+    §2.2 model row)."""
+    from jepsen_tpu.checkers import reach
+
+    if not isinstance(model, models.MultiRegister):
+        return None
+    t0 = _time.monotonic()
+    per_op_items = []
+    for e in packed.entries:
+        items = _op_items(e.op)
+        if items is None:
+            return None
+        per_op_items.append(items)
+    init = dict(model.registers)
+    keys = sorted({k for items in per_op_items for k, _ in items},
+                  key=repr)
+    if not keys:
+        return None
+    try:
+        for k in keys:
+            hash(k)
+    except TypeError:
+        return None
+    walks = {k: _KeyWalk(init.get(k), max_key_configs) for k in keys}
+    evs = []
+    for e, items in zip(packed.entries, per_op_items):
+        evs.append((e.inv_ev, 0, e, items))
+        if not e.crashed:
+            evs.append((e.ret_ev, 1, e, items))
+    evs.sort(key=lambda t: (t[0], t[1]))
+    state_ids: Dict[Tuple[Any, ...], int] = {}
+    last_sig: List[Any] = [None]
+
+    def intern_window() -> None:
+        vals = [sorted(walks[k].values(), key=repr) for k in keys]
+        sig = tuple(map(tuple, vals))
+        if sig == last_sig[0]:          # unchanged closures: same combos
+            return
+        last_sig[0] = sig
+        size = 1
+        for v in vals:
+            size *= len(v)
+        if size > max_product:
+            raise StateExplosion(
+                f"window product {size} beyond {max_product}")
+        for combo in itertools.product(*vals):
+            if combo not in state_ids:
+                state_ids[combo] = len(state_ids)
+                if len(state_ids) > max_states:
+                    raise StateExplosion(
+                        f"restricted product beyond {max_states}")
+
+    intern_window()                     # the initial window
+    for _rank, kind, e, items in evs:
+        if should_abort is not None and should_abort():
+            return {"valid": "unknown", "cause": "aborted",
+                    "engine": "decompose-product"}
+        if kind == 0:
+            for k, v in items:
+                walks[k].invoke(e.eid, e.op.f, v)
+        else:
+            intern_window()             # fires happen at returns
+            # unique keys: a pair-list value may name a key twice
+            # (last-write-wins in the model; one projection per key)
+            for k in {k for k, _v in items}:
+                walks[k].project(e.eid)
+    # restricted transition table over the interned product states
+    combos = sorted(state_ids, key=lambda c: state_ids[c])
+    init_combo = tuple(init.get(k) for k in keys)
+    if init_combo not in state_ids:     # defensive; interned above
+        state_ids[init_combo] = len(state_ids)
+        combos.append(init_combo)
+    states = tuple(_regs_model(keys, c) for c in combos)
+    op_parsed = [(op.f, _op_items(op), dict(_op_items(op) or ()))
+                 for op in packed.distinct_ops]
+    table = np.full((len(combos), len(packed.distinct_ops)), -1,
+                    np.int32)
+    for si, combo in enumerate(combos):
+        regs = dict(zip(keys, combo))
+        for oi, (f, items, as_dict) in enumerate(op_parsed):
+            if f == "read":
+                if all(v is None or regs.get(k) == v for k, v in items):
+                    table[si, oi] = si
+            else:
+                nxt = dict(regs)
+                nxt.update(as_dict)
+                tid = state_ids.get(tuple(nxt.get(k) for k in keys))
+                if tid is not None:
+                    table[si, oi] = tid
+    memo = Memo(table=table, states=states,
+                distinct_ops=packed.distinct_ops,
+                initial=state_ids[init_combo])
+    out = reach.check_packed(model, packed, max_states=max_states,
+                             max_slots=max_slots, max_dense=max_dense,
+                             should_abort=should_abort, memo=memo)
+    out["engine"] = "decompose-product"
+    out["product-states"] = len(combos)
+    out["key-count"] = len(keys)
+    out["time-s"] = _time.monotonic() - t0
     return out
 
 

@@ -123,6 +123,11 @@ class Linearizable(Checker):
         if algorithm == "reach-chunked":
             return reach.check_chunked(model, history,
                                        **_engine_kw(kw, _CHUNKED_KW))
+        if algorithm == "chunklock":
+            from jepsen_tpu.checkers import reach_chunklock
+            return reach_chunklock.check_packed(
+                model, h.pack(history),
+                **_engine_kw(kw, _CHUNKLOCK_KW))
         if algorithm == "frontier":
             return frontier.check(model, history,
                                   **_engine_kw(kw, _FRONTIER_KW))
@@ -198,17 +203,22 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
             ekw["time_limit"] = max(1e-3, deadline - _time.monotonic())
         return ekw
 
-    exploded = False                # product-space memo blow-ups seen
-    try:
-        ekw = _engine_kw(kw, _REACH_KW)
+    def _with_deadline_abort(ekw: Dict[str, Any]) -> Dict[str, Any]:
+        """Compose the chain deadline into an engine's should_abort
+        hook (for stages budgeted by abort polling, not time_limit)."""
         if deadline is not None:
-            # the dense stage also honors the chain budget: its walk
-            # dispatches in bounded segments and turns "unknown" when
-            # the deadline passes (round-2 advisor finding)
             user_abort = ekw.get("should_abort")
             ekw["should_abort"] = (
                 (lambda: user_abort() or _spent())
                 if user_abort is not None else _spent)
+        return ekw
+
+    exploded = False                # product-space memo blow-ups seen
+    try:
+        # the dense stage also honors the chain budget: its walk
+        # dispatches in bounded segments and turns "unknown" when
+        # the deadline passes (round-2 advisor finding)
+        ekw = _with_deadline_abort(_engine_kw(kw, _REACH_KW))
         res = reach.check_packed(model, packed, **ekw)
         if res.get("valid") in (True, False):
             return res
@@ -239,12 +249,27 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
     from jepsen_tpu import models as _models
     if isinstance(model, _models.MultiRegister):
         # multi-key TRANSACTIONAL histories on an exploding product
-        # space: the sound per-key projection screen — an invalid
+        # space: first the RESTRICTED product engine — per-key value
+        # closures bound the jointly-reachable product states, so the
+        # dense device walk runs over O(history) states where the
+        # alphabet BFS needed values**keys — an EXACT True/False
+        # (VERDICT round-4 item 2)
+        from jepsen_tpu.checkers import decompose
+        if not _spent():
+            try:
+                rp = decompose.check_restricted_product(
+                    model, packed,
+                    **_with_deadline_abort(_engine_kw(kw, _REACH_KW)))
+                if rp is not None and rp.get("valid") in (True, False):
+                    return rp
+            except (StateExplosion, reach.DenseOverflow,
+                    ConcurrencyOverflow):
+                pass        # restricted space exploded too: screen next
+        # then the sound per-key projection screen — an invalid
         # projection proves non-linearizability outright; all-valid
         # projections yield an explicit "unknown + reason" instead of
         # an unbounded lazy search over a space the memoized engines
         # already refused (VERDICT round-3 item 9)
-        from jepsen_tpu.checkers import decompose
         try:
             tx = decompose.check_transactional(
                 model, packed,
@@ -269,6 +294,8 @@ _REACH_KW = ("max_states", "max_slots", "max_dense", "should_abort")
 # check_many additionally shards the key axis over a mesh
 _REACH_MANY_KW = _REACH_KW + ("devices",)
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
+_CHUNKLOCK_KW = ("max_states", "max_slots", "max_dense", "n_chunks",
+                 "e_pad", "suffix", "interpret")
 _FRONTIER_KW = ("max_states", "frontier0", "max_frontier", "time_limit",
                 "should_abort", "devices")
 _DECOMPOSE_KW = _REACH_KW + ("devices", "time_limit", "should_abort",
