@@ -152,6 +152,12 @@ def _prep_quotient(memo: Memo, stream: ev.EventStream,
     ret_slot, slot_ops, ret_event_l, ret_entry, R = rv
     # ret_event_l indexes the FILTERED stream; map back to stream events
     ret_event = live_pos[ret_event_l]
+
+    def epochs() -> Tuple[np.ndarray, np.ndarray]:
+        # lazy: only the sparse-live walk consumes the epoch tables,
+        # and building them eagerly cost O(E) host time plus O(R*L*L)
+        # temporaries on every dense-path check
+        return _live_epochs(lkind, lslot, lentry, lopid, packed, L, R)
     # crashed groups by op id (noop-crashed were already dropped by
     # events.build before this stream was built)
     crash_pos = np.nonzero(is_crash_ev)[0]
@@ -169,7 +175,73 @@ def _prep_quotient(memo: Memo, stream: ev.EventStream,
         caps[:R, g] = np.searchsorted(inv_ranks, ret_event[:R])
     digit, src = _mixed_radix(sizes)
     return (L, ret_slot, slot_ops, ret_event, ret_entry, R,
-            gids.astype(np.int32), sizes, C, caps, digit, src)
+            gids.astype(np.int32), sizes, C, caps, digit, src,
+            epochs)
+
+
+def _live_epochs(lkind: np.ndarray, lslot: np.ndarray,
+                 lentry: np.ndarray, lopid: np.ndarray,
+                 packed: h.PackedHistory, L: int, R: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Live EPOCH groups for the sparse walk's rank canonicalization
+    (round-5): two live pending ops are exactly interchangeable when
+    they share an op id AND were invoked within the same inter-return
+    window (no closure point falls between their invokes, so every
+    fire opportunity postdates both invokes and any fired-subset
+    designation among them is legal — a bisimulation; ops straddling a
+    return are NOT collapsed, which keeps this sound where a naive
+    same-op-id quotient would not be). Returns per-return tables over
+    live slots: ``ep_gid[R, L]`` int8 — the min-slot representative of
+    the slot's epoch group (equal = same group, -1 empty slot; small
+    ints on purpose — jax without x64 silently truncates wider
+    codes) — and ``ep_rank[R, L]`` int8 — the slot's rank within its
+    group by RETURN order, so the returning slot is always rank 0 and
+    canonical masks survive its projection."""
+    E = len(lkind)
+    occ_entry = np.full(L, -1, np.int64)
+    inv_code = np.zeros(L, np.int64)        # epoch code of occupant
+    n_rets_seen = 0
+    code = np.full((max(R, 1), L), -1, np.int64)
+    occ_ret = np.full((max(R, 1), L), 0, np.int64)
+    r = 0
+    ret_ev_arr = np.asarray(packed.ret_ev, np.int64)
+    for e in range(E):
+        s = lslot[e]
+        if lkind[e] == ev.KIND_INVOKE:
+            occ_entry[s] = lentry[e]
+            inv_code[s] = (np.int64(lopid[e]) << np.int64(32)
+                           | np.int64(n_rets_seen))
+        else:                               # return
+            n_rets_seen += 1
+            if r < R:
+                live = occ_entry >= 0
+                code[r, live] = inv_code[live]
+                occ_ret[r, live] = ret_ev_arr[occ_entry[live]]
+                r += 1
+            occ_entry[s] = -1
+    # rank within equal-code groups by (occupant return event, slot),
+    # and per-row min-slot group representatives (int8 — wide codes
+    # would be silently truncated by jax without x64). Chunked over R:
+    # the [chunk, L, L] pairwise broadcasts stay a few MB where the
+    # full [R, L, L] form allocated gigabytes on long histories.
+    Rr = max(R, 1)
+    rank = np.zeros((Rr, L), np.int8)
+    gid = np.full((Rr, L), -1, np.int8)
+    slots = np.arange(L)
+    chunk = max(1, (1 << 22) // max(L * L, 1))
+    for lo in range(0, Rr, chunk):
+        hi = min(lo + chunk, Rr)
+        c = code[lo:hi]
+        o = occ_ret[lo:hi]
+        same = (c[:, :, None] == c[:, None, :]) & (c[:, :, None] >= 0)
+        earlier = (o[:, :, None] > o[:, None, :]) | (
+            (o[:, :, None] == o[:, None, :])
+            & (slots[None, :, None] > slots[None, None, :]))
+        rank[lo:hi] = (same & earlier).sum(axis=2).astype(np.int8)
+        gid[lo:hi] = np.where(c >= 0,
+                              np.argmax(same, axis=2).astype(np.int8),
+                              np.int8(-1))
+    return gid, rank
 
 
 # -- device walk -------------------------------------------------------------
@@ -372,12 +444,39 @@ def _sq_dedup(masks, payload, Fcap: int):
     return m_out, p_out, jnp.sum(newseg)
 
 
+def _sq_canon(masks, gid_row, rank_row, W: int):
+    """Live epoch-rank canonicalization (round-5): repack each epoch
+    group's fired bits into its earliest-RETURNING members. Two live
+    pending ops sharing an op id and an invocation window (equal
+    ``code_row`` entries) are exactly interchangeable — every fire
+    opportunity postdates both invokes — so masks differing only in
+    WHICH epoch members fired collapse to one canonical row (the
+    2^burst blowup of same-op concurrent bursts becomes burst+1 rows),
+    and the returning slot is rank 0 of its group, so projection sees
+    canonical masks unchanged. Sentinel rows pass through."""
+    import jax.numpy as jnp
+
+    valid = masks != _SQ_SENT
+    bits = ((masks[:, None] >> jnp.arange(W, dtype=jnp.uint32)[None, :])
+            & jnp.uint32(1)).astype(jnp.int32)           # [F, W]
+    grouped = gid_row >= 0
+    same = ((gid_row[:, None] == gid_row[None, :])
+            & grouped[:, None] & grouped[None, :])       # [W, W]
+    cnt = bits @ same.astype(jnp.int32)                  # [F, W]
+    newbit = jnp.where(grouped[None, :],
+                       (rank_row[None, :] < cnt).astype(jnp.int32),
+                       bits)
+    m2 = jnp.sum(newbit.astype(jnp.uint32)
+                 << jnp.arange(W, dtype=jnp.uint32)[None, :], axis=1)
+    return jnp.where(valid, m2, masks)
+
+
 def _sq_step(P, digit, src, gop_ids, masks, payload, j, ops_row,
-             cap_row, Fcap: int, W: int):
+             cap_row, code_row, rank_row, Fcap: int, W: int):
     """One return event on the sparse rows: fire to the monotone
-    fixpoint (groups in place, live fires spawning candidate rows),
-    then project on live slot ``j``. Returns
-    ``(masks, payload, over)``."""
+    fixpoint (groups in place, live fires spawning candidate rows,
+    epoch-rank canonicalization folding symmetric rows), then project
+    on live slot ``j``. Returns ``(masks, payload, over)``."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -400,6 +499,7 @@ def _sq_step(P, digit, src, gop_ids, masks, payload, j, ops_row,
         cand_payload = (stepped.reshape(-1, S, C)
                         & cand_ok.reshape(-1)[:, None, None])
         all_masks = jnp.concatenate([masks, cand_masks.reshape(-1)])
+        all_masks = _sq_canon(all_masks, code_row, rank_row, W)
         all_payload = jnp.concatenate([payload, cand_payload])
         masks, payload, n = _sq_dedup(all_masks, all_payload, Fcap)
         return masks, payload, over | (n > Fcap)
@@ -429,8 +529,8 @@ def _sq_step(P, digit, src, gop_ids, masks, payload, j, ops_row,
     return masks, payload, over
 
 
-def _sq_walk(P, digit, src, gop_ids, ret_slot, slot_ops, caps, masks0,
-             payload0, Fcap: int, W: int):
+def _sq_walk(P, digit, src, gop_ids, ret_slot, slot_ops, caps,
+             ep_code, ep_rank, masks0, payload0, Fcap: int, W: int):
     """Drive all return events; returns
     ``(ptr, masks, payload, alive, over)``."""
     import jax.numpy as jnp
@@ -446,7 +546,7 @@ def _sq_walk(P, digit, src, gop_ids, ret_slot, slot_ops, caps, masks0,
         i, masks, payload, _a, over = c
         masks, payload, o2 = _sq_step(
             P, digit, src, gop_ids, masks, payload, ret_slot[i],
-            slot_ops[i], caps[i], Fcap, W)
+            slot_ops[i], caps[i], ep_code[i], ep_rank[i], Fcap, W)
         return i + 1, masks, payload, payload.any(), over | o2
 
     return lax.while_loop(
@@ -468,8 +568,8 @@ class _SqOverflow(RuntimeError):
 
 
 def _sq_run_segments(P_np, digit, src, gids, ret_slot, slot_ops, caps,
-                     S_pad: int, C: int, L: int, R_n: int, Fcap: int,
-                     should_abort):
+                     ep_code, ep_rank, S_pad: int, C: int, L: int,
+                     R_n: int, Fcap: int, should_abort):
     """Segmented drive of the sparse-live walk at one capacity rung;
     raises :class:`_SqOverflow` (caller escalates and restarts — an
     overflowed walk's rows are over-approximate and unusable)."""
@@ -502,9 +602,16 @@ def _sq_run_segments(P_np, digit, src, gids, ret_slot, slot_ops, caps,
         seg_caps = np.zeros((L_pad, G), np.int32)
         seg_caps[:n] = caps[base:base + n]
         seg_caps[n:] = caps[base + n - 1]    # idempotent pads (above)
+        # pad rows carry empty epoch tables (gid -1 = no grouping);
+        # canonicalization is the identity there
+        seg_code = np.full((L_pad, L), -1, np.int8)
+        seg_code[:n] = ep_code[base:base + n]
+        seg_rank = np.zeros((L_pad, L), np.int8)
+        seg_rank[:n] = ep_rank[base:base + n]
         ptr, m_cur, p_cur, alive, over = walk(
             dP, ddig, dsrc, dg, jnp.asarray(seg_slot),
-            jnp.asarray(seg_ops), jnp.asarray(seg_caps), m_cur, p_cur)
+            jnp.asarray(seg_ops), jnp.asarray(seg_caps),
+            jnp.asarray(seg_code), jnp.asarray(seg_rank), m_cur, p_cur)
         if bool(over):
             raise _SqOverflow(f"> {Fcap} live-mask rows")
         if not bool(alive):
@@ -527,8 +634,8 @@ def check_quotient(memo: Memo, stream: ev.EventStream,
     from jepsen_tpu.checkers import reach
 
     (L, ret_slot, slot_ops, ret_event, ret_entry, R_n, gids, sizes, C,
-     caps, digit, src) = _prep_quotient(memo, stream, packed,
-                                        max_live=_MAX_LIVE_SPARSE)
+     caps, digit, src, epochs) = _prep_quotient(
+         memo, stream, packed, max_live=_MAX_LIVE_SPARSE)
     S = memo.n_states
     S_pad = max(2, reach._next_pow2(S))
     dense_ok = (L <= _MAX_LIVE_DENSE
@@ -558,7 +665,11 @@ def check_quotient(memo: Memo, stream: ev.EventStream,
         ptr, R_fin, alive = drive(rsl, ops, cps, R_n)
         walk_kind = "dense"
     else:
-        def drive(rs, so, cp, rn):
+        ep_gid, ep_rank = epochs()      # lazy: sparse-live path only
+        ecs = np.ascontiguousarray(ep_gid[:max(R_n, 1)])
+        ers = np.ascontiguousarray(ep_rank[:max(R_n, 1)])
+
+        def drive(rs, so, cp, rn, ec=ecs, er=ers):
             last = None
             for Fcap in _SQ_CAPS:
                 if (S_pad * C * Fcap > _SQ_PAYLOAD_MAX
@@ -566,8 +677,8 @@ def check_quotient(memo: Memo, stream: ev.EventStream,
                     break
                 try:
                     ptr, m, p, alive = _sq_run_segments(
-                        P_np, digit, src, gids, rs, so, cp, S_pad, C,
-                        L, rn, Fcap, should_abort)
+                        P_np, digit, src, gids, rs, so, cp, ec, er,
+                        S_pad, C, L, rn, Fcap, should_abort)
                     return ptr, (m, p), alive
                 except _SqOverflow as e:
                     last = e

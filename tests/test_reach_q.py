@@ -201,3 +201,111 @@ def test_sparse_live_overflow_falls_back_cleanly():
     h += [invoke(0, "read"), ok(0, "read", 29)]
     with pytest.raises(reach_q.QuotientOverflow):
         _run_quotient(h, m.register(0), max_dense=1 << 10)
+
+
+def _same_op_burst(peak=24, rounds=1, corrupt=False, crash_k=0,
+                   seed=9):
+    """``peak`` concurrent SAME-value live writes per round (one
+    invocation window — the epoch-interchangeable shape), optional
+    crashed writes on top, returns trickling before the next round."""
+    import random
+
+    from jepsen_tpu.op import info, invoke, ok
+    rng = random.Random(seed)
+    h = []
+    for k in range(crash_k):
+        h.append(invoke(2000 + k, "write", 7))
+        h.append(info(2000 + k, "write", 7))
+    for r in range(rounds):
+        procs = [3000 + 100 * r + p for p in range(peak)]
+        for p in procs:
+            h.append(invoke(p, "write", 5))
+        rng.shuffle(procs)
+        for p in procs:
+            h.append(ok(p, "write", 5))
+        h += [invoke(0, "read"), ok(0, "read", 5)]
+    h += [invoke(1, "read"),
+          ok(1, "read", 9999 if corrupt else 5)]
+    return h
+
+
+@pytest.mark.parametrize("corrupt", [False, True])
+def test_epoch_canon_collapses_same_op_bursts(corrupt):
+    """Round-5 live-rank (epoch) canonicalization: a 24-wide same-op
+    live burst has 2^24 raw masks but only 25 canonical rows — the
+    sparse-live walk must verify it at the FIRST capacity rung where
+    it previously overflowed every rung."""
+    model = m.register(0)
+    h = _same_op_burst(peak=24, corrupt=corrupt)
+    rq, packed = _run_quotient(h, model, max_dense=1 << 10)
+    assert rq["walk"] == "sparse-live"
+    assert rq["live-slots"] >= 24
+    # known-by-construction verdicts (the oracle explodes at 2^24 —
+    # that is the point of the quotient)
+    assert rq["valid"] is (not corrupt)
+    if corrupt:
+        # the violation is the final read of a never-written value
+        assert rq["op"]["value"] == 9999
+
+
+def test_epoch_canon_sustained_wide_concurrency():
+    """Sustained 24+ live concurrency across repeated same-op bursts
+    (the round-4 verdict's named regime) verifies in the quotient
+    path — no QuotientOverflow, no frontier fallback."""
+    model = m.register(0)
+    h = _same_op_burst(peak=26, rounds=3, crash_k=6)
+    rq, packed = _run_quotient(h, model, max_dense=1 << 10)
+    assert rq["walk"] == "sparse-live"
+    assert rq["valid"] is True
+    assert rq["crash-groups"] >= 1      # counts + epochs compose
+
+
+def test_epoch_canon_differential_high_crash_high_concurrency():
+    """Fuzz the epoch canonicalization against the oracle on mixes of
+    same-op live bursts, distinct-op concurrency, and crashed groups —
+    verdicts and dead events must match exactly."""
+    import random
+
+    from jepsen_tpu.op import info, invoke, ok
+    for seed in range(10):
+        rng = random.Random(seed)
+        h, state = [], 0
+        nxt = 100
+        for _ in range(rng.randrange(2, 5)):
+            r = rng.random()
+            if r < 0.4:                 # same-op live burst
+                k = rng.randrange(3, 7)
+                v = rng.randrange(3)
+                procs = list(range(nxt, nxt + k))
+                nxt += k
+                for p in procs:
+                    h.append(invoke(p, "write", v))
+                rng.shuffle(procs)
+                for p in procs:
+                    h.append(ok(p, "write", v))
+                state = v
+            elif r < 0.7:               # crashed same-op group
+                k = rng.randrange(2, 5)
+                for p in range(nxt, nxt + k):
+                    h.append(invoke(p, "write", 8))
+                    h.append(info(p, "write", 8))
+                nxt += k
+            else:                       # sequential traffic
+                for _i in range(rng.randrange(2, 6)):
+                    v = rng.randrange(3)
+                    h += [invoke(0, "write", v), ok(0, "write", v)]
+                    state = v
+                h += [invoke(1, "read"), ok(1, "read", state)]
+        if seed % 3 == 1:               # plant a violation
+            h += [invoke(2, "read"), ok(2, "read", 777)]
+        model = m.register(0)
+        rq, packed = _run_quotient(h, model, max_dense=1 << 8)
+        ref = wgl_ref.check_packed(model, packed, time_limit=120)
+        if ref["valid"] in (True, False):
+            assert rq["valid"] == ref["valid"], seed
+        # exact dead-event reference: the (un-quotiented-live) dense
+        # product walk on the same operands
+        rd, _ = _run_quotient(h, model)
+        assert rq["valid"] == rd["valid"], seed
+        if rq["valid"] is False:
+            assert rq["dead-event"] == rd["dead-event"], seed
