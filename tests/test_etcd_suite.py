@@ -118,3 +118,71 @@ def test_etcd_run_sloppy_finds_violation():
                        concurrency=5)
     done = core.run(t)
     assert done["results"]["results"]["linear"]["valid"] is False
+
+
+# -- env-gated real-server tier (round-5) ------------------------------------
+#
+# The client claims drop-in etcd-v2 wire compatibility; with
+# JEPSEN_ETCD_URL set (e.g. http://n1:2379 from the docker rig — see
+# docker/README.md) the SAME client runs against the real server:
+# dialect round-trip, then a concurrent burst whose collected history
+# must check linearizable. Clean skip otherwise.
+
+_REAL_ETCD = __import__("os").environ.get("JEPSEN_ETCD_URL")
+
+
+@pytest.mark.skipif(not _REAL_ETCD,
+                    reason="JEPSEN_ETCD_URL not set (real-server tier; "
+                           "see docker/README.md)")
+def test_real_etcd_client_dialect_and_history():
+    import threading
+    import time
+
+    from jepsen_tpu import models
+    from jepsen_tpu.checkers import facade
+    from jepsen_tpu.op import Op, invoke as inv
+
+    key = f"jepsen-tpu-tier-{__import__('os').getpid()}"
+    test = {"endpoints": {"real": _REAL_ETCD}}
+    c = etcd.EtcdHttpClient(key, timeout_s=3.0).open(test, "real")
+    # dialect round-trip: write/read/cas-hit/cas-miss
+    assert c.invoke(test, inv(0, "write", 1)).type == "ok"
+    r = c.invoke(test, inv(0, "read"))
+    assert r.type == "ok" and r.value == 1
+    assert c.invoke(test, inv(0, "cas", [1, 2])).type == "ok"
+    assert c.invoke(test, inv(0, "cas", [9, 3])).type == "fail"
+    r = c.invoke(test, inv(0, "read"))
+    assert r.type == "ok" and r.value == 2
+    # concurrent burst -> linearizable history against the real server
+    # (FRESH key: the dialect phase left `key` at 2, which the
+    # cas_register model's None initial would falsely flag)
+    burst_key = key + "-burst"
+    history, lock = [], threading.Lock()
+
+    def worker(p):
+        wc = etcd.EtcdHttpClient(burst_key, timeout_s=3.0).open(
+            test, "real")
+        rng = __import__("random").Random(p)
+        for i in range(15):
+            f = rng.choice(["read", "write", "cas"])
+            v = (rng.randrange(5) if f == "write" else
+                 [rng.randrange(5), rng.randrange(5)]
+                 if f == "cas" else None)
+            op = Op(process=p, type="invoke", f=f, value=v,
+                    time=time.monotonic_ns())
+            with lock:
+                history.append(op)
+            done = wc.invoke(test, op)
+            with lock:
+                history.append(done)
+
+    threads = [threading.Thread(target=worker, args=(p,))
+               for p in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    from jepsen_tpu.history import index
+    res = facade.linearizable(models.cas_register()).check(
+        None, index(history))
+    assert res["valid"] is True, res

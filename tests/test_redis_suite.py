@@ -106,3 +106,33 @@ def test_redis_run_sloppy_finds_violation():
                          concurrency=5)
     done = core.run(t)
     assert done["results"]["results"]["linear"]["valid"] is False
+
+
+# -- env-gated real-server tier (round-5) ------------------------------------
+#
+# With JEPSEN_REDIS_URL=host:port (a live redis; see docker/README.md)
+# the RESP2 client runs its dialect against the real server. Clean
+# skip otherwise.
+
+_REAL_REDIS = __import__("os").environ.get("JEPSEN_REDIS_URL")
+
+
+@pytest.mark.skipif(not _REAL_REDIS,
+                    reason="JEPSEN_REDIS_URL not set (real-server tier; "
+                           "see docker/README.md)")
+def test_real_redis_client_dialect():
+    from jepsen_tpu.op import invoke as inv
+    from jepsen_tpu.suites import redis as rsuite
+
+    host, _, port = _REAL_REDIS.rpartition(":")
+    test = {"endpoints": {"real": (host, int(port))}}
+    key = f"jepsen-tpu-tier-{__import__('os').getpid()}"
+    c = rsuite.RespClient(key, timeout_s=3.0).open(test, "real")
+    assert c.invoke(test, inv(0, "write", 1)).type == "ok"
+    r = c.invoke(test, inv(0, "read"))
+    assert r.type == "ok" and r.value == 1
+    # CAS via the EVAL script: hit then miss
+    assert c.invoke(test, inv(0, "cas", [1, 2])).type == "ok"
+    assert c.invoke(test, inv(0, "cas", [9, 3])).type == "fail"
+    r = c.invoke(test, inv(0, "read"))
+    assert r.type == "ok" and r.value == 2

@@ -22,9 +22,12 @@ Tier selection, in order:
    elided. The test report records which tier ran (``_TIER``).
 3. Neither → clean skip.
 
-Network-mutating calls are further gated behind ``JEPSEN_SSH_TEST_NET=1``
-plus root on the target, because ``IptablesNet.heal`` flushes iptables
-chains — safe in the throwaway docker nodes, rude on a dev box.
+Network mutation: with ``JEPSEN_SSH_TEST_NET=1`` plus root on the
+target (the throwaway docker nodes), ``IptablesNet.heal`` flushes the
+REAL iptables chains. Without it, the shim tier runs the same calls
+against recording ``iptables``/``tc`` stand-ins placed first on PATH —
+the full command-assembly + transport path executes and the argv lines
+are asserted exactly, with no firewall touched.
 """
 import os
 import shutil
@@ -70,6 +73,20 @@ case "$dst" in *:*) dst="${dst#*:}" ;; esac
 exec cp -r "$src" "$dst"
 """
 
+_SUDO_SHIM = r"""#!/bin/sh
+# sudo stand-in (the container has no sudo binary): strip flags and the
+# target user, then exec the payload — Session.su's command assembly
+# executes for real, only the privilege change is elided.
+while [ $# -gt 0 ]; do
+  case "$1" in
+    -u) shift 2 ;;
+    -S|-n|-E|-H|--) shift ;;
+    *) break ;;
+  esac
+done
+exec "$@"
+"""
+
 
 def _ssh_available() -> bool:
     if shutil.which("ssh") is None:
@@ -85,9 +102,17 @@ def _ssh_available() -> bool:
         return False
 
 
+_SUDO_SHIMMED = False
+
+
 def _install_shim() -> str:
+    global _SUDO_SHIMMED
     d = tempfile.mkdtemp(prefix="jepsen-ssh-shim-")
-    for name, body in (("ssh", _SSH_SHIM), ("scp", _SCP_SHIM)):
+    shims = [("ssh", _SSH_SHIM), ("scp", _SCP_SHIM)]
+    if shutil.which("sudo") is None:
+        shims.append(("sudo", _SUDO_SHIM))
+        _SUDO_SHIMMED = True
+    for name, body in shims:
         path = os.path.join(d, name)
         with open(path, "w") as f:
             f.write(body)
@@ -172,14 +197,53 @@ def test_start_stop_daemon(session):
         session.exec_raw("pkill -f '/bin/sleep 300' || true")
 
 
-@pytest.mark.skipif(not os.environ.get("JEPSEN_SSH_TEST_NET"),
-                    reason="network mutation gated by JEPSEN_SSH_TEST_NET=1")
-def test_iptables_heal(session):
-    """`IptablesNet.heal` flushes partition rules on every node — run it
-    against the real binary (docker nodes run as root)."""
-    if session.su().exec_raw("iptables -L -n").exit_code != 0:
-        pytest.skip("no iptables privilege on target")
-    n = net.IptablesNet()
+def test_iptables_heal(session, tmp_path, monkeypatch):
+    """``IptablesNet`` command assembly end-to-end through the real
+    control stack. With ``JEPSEN_SSH_TEST_NET=1`` and privilege (the
+    docker rig), the REAL ``iptables`` is flushed. Otherwise, recording
+    ``iptables``/``tc`` stand-ins go first on PATH: every byte of the
+    ``su``-wrapped remote invocation — Session assembly, (shim) ssh
+    transport, shell splitting — executes, and the recorded argv lines
+    are asserted against the exact upstream recipes
+    (``[U] jepsen/src/jepsen/net.clj``)."""
     test = {"remote": session.remote, "ssh": {}, "nodes": [HOST]}
+    n = net.IptablesNet()
+    if os.environ.get("JEPSEN_SSH_TEST_NET"):
+        if session.su().exec_raw("iptables -L -n").exit_code != 0:
+            pytest.skip("no iptables privilege on target")
+        n.heal(test)
+        assert session.su().exec_raw(
+            "iptables -L INPUT -n").exit_code == 0
+        return
+    if _TIER != "shim":
+        pytest.skip("real remote without JEPSEN_SSH_TEST_NET=1 — "
+                    "not mutating a live box's firewall")
+    if not _SUDO_SHIMMED:
+        # a REAL sudo would env_reset PATH (dropping the recording
+        # stand-ins) and run the genuine privileged iptables — exactly
+        # the hazard the old gate guarded; only the sudo shim makes
+        # this safe
+        pytest.skip("real sudo present — recording stand-ins cannot "
+                    "intercept; use JEPSEN_SSH_TEST_NET=1 on a "
+                    "throwaway node instead")
+    log = tmp_path / "net-cmds.log"
+    fake_bin = tmp_path / "bin"
+    fake_bin.mkdir()
+    for name in ("iptables", "tc"):
+        p = fake_bin / name
+        p.write_text(f'#!/bin/sh\necho "{name} $@" >> {log}\n')
+        p.chmod(0o755)
+    monkeypatch.setenv(
+        "PATH", str(fake_bin) + os.pathsep + os.environ["PATH"])
     n.heal(test)
-    assert session.su().exec_raw("iptables -L INPUT -n").exit_code == 0
+    assert log.read_text().splitlines() == [
+        "iptables -F -w", "iptables -X -w"]
+    n.drop(test, "10.0.0.2", HOST)
+    assert log.read_text().splitlines()[-1] == \
+        "iptables -A INPUT -s 10.0.0.2 -j DROP -w"
+    n.slow(test)
+    assert log.read_text().splitlines()[-1] == \
+        "tc qdisc add dev eth0 root netem delay 50.0ms 10.0ms " \
+        "distribution normal"
+    n.fast(test)
+    assert log.read_text().splitlines()[-1] == "tc qdisc del dev eth0 root"
