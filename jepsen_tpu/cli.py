@@ -152,6 +152,11 @@ def _cmd_recheck(args) -> int:
     if len(args.path) > 1:
         from jepsen_tpu.checkers import facade, reach
 
+        if args.algorithm != "auto":
+            logging.getLogger("jepsen.cli").warning(
+                "--algorithm %s is ignored with multiple paths: the "
+                "lockstep batch engine checks them together",
+                args.algorithm)
         # containment mirrors the single-path route's check_safe: an
         # unreadable path or a history the batch engines reject yields
         # its own {"valid": "unknown", "error": ...} line instead of a
@@ -162,23 +167,24 @@ def _cmd_recheck(args) -> int:
                 loaded.append((p, _load_history(p), None))
             except Exception as e:                      # noqa: BLE001
                 loaded.append((p, None, f"{type(e).__name__}: {e}"))
-        live = [(p, hist) for p, hist, err in loaded if err is None]
+        live = [(i, hist) for i, (_p, hist, err) in enumerate(loaded)
+                if err is None]
         try:
             batch = reach.check_batch(model,
                                       [h.pack(hist) for _, hist in live])
-            res_by_path = {p: r for (p, _), r in zip(live, batch)}
+            res_by_idx = {i: r for (i, _), r in zip(live, batch)}
         except Exception as e:                          # noqa: BLE001
             # batch path rejected (overflow, unhashable values, ...):
             # per-history auto chain with full error containment
             logging.getLogger("jepsen.cli").warning(
                 "batch recheck failed (%r); per-history fallback", e)
-            res_by_path = {
-                p: facade.check_safe(facade.linearizable(model),
+            res_by_idx = {
+                i: facade.check_safe(facade.linearizable(model),
                                      {"model": model}, hist)
-                for p, hist in live}
+                for i, hist in live}
         ok = True
-        for p, _hist, err in loaded:
-            res = (res_by_path[p] if err is None
+        for i, (p, _hist, err) in enumerate(loaded):
+            res = (res_by_idx[i] if err is None
                    else {"valid": "unknown", "error": err})
             ok = ok and res.get("valid") is True
             print(json.dumps({"path": p, **res}, default=str))

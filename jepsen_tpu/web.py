@@ -14,30 +14,60 @@ from typing import Optional
 from jepsen_tpu import store
 
 
+# the artifacts the checker/report pipeline writes into a run dir,
+# in display order (upstream web.clj links the same set: results,
+# history, timeline, perf charts, the linearizability diagram, logs)
+_ARTIFACTS = ("results.json", "history.txt", "timeline.html",
+              "latency-raw.png", "rate.png", "linear.svg",
+              "jepsen.log")
+
+
+def _badge(valid: str) -> str:
+    """Upstream-style verdict badge: green valid, red invalid, amber
+    unknown/indeterminate."""
+    color, label = {
+        "True": ("#2e7d32", "valid"),
+        "False": ("#c62828", "INVALID"),
+    }.get(valid, ("#b07d2b", valid or "?"))
+    return (f"<span class='badge' style='background:{color}'>"
+            f"{html.escape(label)}</span>")
+
+
+def _run_row(root: str, name: str, run: str) -> str:
+    valid = ""
+    res_path = os.path.join(run, "results.json")
+    if os.path.exists(res_path):
+        try:
+            with open(res_path) as f:
+                valid = str(json.load(f).get("valid"))
+        except Exception:                               # noqa: BLE001
+            valid = "?"
+    rel = urllib.parse.quote(os.path.relpath(run, root))
+    links = " ".join(
+        f"<a href='/files/{rel}/{urllib.parse.quote(a)}'>"
+        f"{html.escape(a)}</a>"
+        for a in _ARTIFACTS
+        if os.path.exists(os.path.join(run, a)))
+    return (f"<tr><td><a href='/files/{rel}/'>{html.escape(name)}</a>"
+            f"</td><td>{html.escape(os.path.basename(run))}</td>"
+            f"<td>{_badge(valid)}</td>"
+            f"<td class='artifacts'>{links}</td></tr>")
+
+
 def _index_html(root: str) -> str:
-    rows = []
-    for name, runs in store.tests(root).items():
-        for run in reversed(runs):
-            valid = ""
-            res_path = os.path.join(run, "results.json")
-            if os.path.exists(res_path):
-                try:
-                    with open(res_path) as f:
-                        valid = str(json.load(f).get("valid"))
-                except Exception:                       # noqa: BLE001
-                    valid = "?"
-            color = {"True": "#6db66d", "False": "#d66"}.get(valid, "#d6a76d")
-            rel = urllib.parse.quote(os.path.relpath(run, root))
-            rows.append(
-                f"<tr><td><a href='/files/{rel}/'>{html.escape(name)}</a>"
-                f"</td><td>{html.escape(os.path.basename(run))}</td>"
-                f"<td style='color:{color}'>{valid}</td></tr>")
+    rows = [_run_row(root, name, run)
+            for name, runs in store.tests(root).items()
+            for run in reversed(runs)]
     return ("<!doctype html><title>jepsen-tpu results</title>"
             "<style>body{font-family:sans-serif;margin:2em}"
             "table{border-collapse:collapse}td,th{padding:4px 12px;"
-            "border-bottom:1px solid #eee;text-align:left}</style>"
+            "border-bottom:1px solid #eee;text-align:left}"
+            ".badge{color:#fff;border-radius:3px;padding:1px 7px;"
+            "font-size:85%}"
+            ".artifacts a{margin-right:.6em;font-size:90%}</style>"
             "<h1>jepsen-tpu results</h1><table>"
-            "<tr><th>test</th><th>run</th><th>valid?</th></tr>"
+            "<tr><th>test</th><th>run</th><th>valid?</th>"
+            "<th>artifacts</th></tr>"
             + "".join(rows) + "</table>")
 
 

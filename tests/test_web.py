@@ -33,7 +33,8 @@ def test_index_and_artifacts(store_with_run):
         status, body = _fetch(f"http://127.0.0.1:{port}/")
         assert status == 200
         assert "register-linearizable" in body
-        assert "True" in body                   # the valid? column
+        assert ">valid</span>" in body          # the verdict badge
+        assert "results.json</a>" in body       # artifact links
         import os
         rel = os.path.relpath(done["dir"], root)
         status, res = _fetch(
@@ -46,6 +47,35 @@ def test_index_and_artifacts(store_with_run):
     finally:
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_index_snapshot(tmp_path):
+    """Snapshot of one fully-artifacted run row: verdict badge +
+    links for exactly the artifacts present, in pipeline order."""
+    run = tmp_path / "cas-test" / "20260731T120000"
+    run.mkdir(parents=True)
+    artifacts = ["results.json", "history.txt", "timeline.html",
+                 "latency-raw.png", "rate.png", "linear.svg",
+                 "jepsen.log"]
+    for a in artifacts:
+        (run / a).write_text("x")
+    (run / "results.json").write_text(json.dumps({"valid": False}))
+    body = web._index_html(str(tmp_path))
+    assert (
+        "<tr><td><a href='/files/cas-test/20260731T120000/'>cas-test"
+        "</a></td><td>20260731T120000</td>"
+        "<td><span class='badge' style='background:#c62828'>INVALID"
+        "</span></td>") in body
+    for a in artifacts:
+        assert (f"<a href='/files/cas-test/20260731T120000/{a}'>"
+                f"{a}</a>") in body
+    # absent artifacts are not linked
+    (run / "linear.svg").unlink()
+    assert "linear.svg" not in web._index_html(str(tmp_path))
+    # unknown verdicts badge amber
+    (run / "results.json").write_text(json.dumps({"valid": "unknown"}))
+    assert "background:#b07d2b'>unknown" in web._index_html(
+        str(tmp_path))
 
 
 def test_path_traversal_stays_inside_store(store_with_run):
