@@ -18,3 +18,22 @@ jax.config.update("jax_platforms", "cpu")
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """XLA-CPU's in-process LLVM JIT intermittently SEGFAULTs once a
+    long single-process run has accumulated enough distinct compiled
+    programs (observed twice at ~450 tests in jax's
+    backend_compile_and_load; the fuzzer documents the same flake as
+    'LLVM compilation error: Cannot allocate memory'). Dropping jax's
+    executable/tracing caches at module boundaries keeps the resident
+    program count bounded. Costs re-compiles of cross-module shared
+    shapes — a few extra minutes over the suite — and nothing else:
+    correctness never depends on a warm cache (the repo's cached jit
+    factories hold only wrapper objects; their executables live in the
+    global caches this drops)."""
+    yield
+    jax.clear_caches()

@@ -103,6 +103,20 @@ def run_trial(params, seed: int, *, pallas: bool = False):
         d = decompose.check(model, h)
         verdicts["decompose"] = (d["valid"] if d is not None
                                  else "skipped: not-decomposable")
+    from jepsen_tpu.checkers import reach_q
+    try:
+        # the sparse-live quotient walk (round-5 epoch-rank
+        # canonicalization) — max_dense=256 forces the sparse rows
+        # wherever the dense product would otherwise absorb the trial
+        from jepsen_tpu.checkers import events as _ev
+        from jepsen_tpu.models.memo import memo as _build_memo
+        memo_q = _build_memo(model, packed, max_states=100_000)
+        stream_q = _ev.build(packed, memo_q, max_slots=128)
+        verdicts["reach-q-sparse"] = reach_q.check_quotient(
+            memo_q, stream_q, packed, max_dense=1 << 8)["valid"]
+    except (reach_q.QuotientOverflow, ConcurrencyOverflow,
+            StateExplosion) as e:
+        verdicts["reach-q-sparse"] = f"skipped: {type(e).__name__}"
     if pallas:
         try:
             from jepsen_tpu.checkers import events as ev
@@ -131,6 +145,17 @@ def run_trial(params, seed: int, *, pallas: bool = False):
                 verdicts["reach-lane"] = dead2 < 0
             except Exception as e:                      # noqa: BLE001
                 verdicts["reach-lane"] = f"skipped: {type(e).__name__}"
+            # chunk-lockstep (round-5): tiny chunk/seed/suffix geometry
+            # exercises the bound pass, union seeds, fold, and rescue
+            try:
+                from jepsen_tpu.checkers import reach_chunklock as rcl
+                dead3, _d = rcl.walk_chunklock(
+                    P, rs.ret_slot, rs.slot_ops, M, n_chunks=3,
+                    e_pad=2, suffix=6, interpret=True)
+                verdicts["reach-chunklock"] = dead3 < 0
+            except Exception as e:                      # noqa: BLE001
+                verdicts["reach-chunklock"] = \
+                    f"skipped: {type(e).__name__}"
         # lockstep batch kernel: walk THIS history alongside a fresh
         # companion of the same workload (heterogeneous lockstep — the
         # cross-history-independence property under test). The entry
@@ -240,6 +265,59 @@ def run_many(n: int, seed: int, *, pallas: bool = False,
     return mismatches, invalid_seen
 
 
+def chunklock_trials(k: int, seed: int) -> list:
+    """Real-chip chunk-lockstep differential: ``k`` engine-scale
+    histories (the routing floor is 32768 returns, so these run the
+    COMPILED production engine, not interpret mode) checked by
+    walk-level chunklock vs the C++ WGL engine — verdicts AND dead
+    events must agree. Sizes are fixed so one compile serves all
+    trials. Returns mismatch dicts (empty = clean)."""
+    from jepsen_tpu import fixtures
+    from jepsen_tpu.checkers import reach_chunklock as rcl
+    from jepsen_tpu.checkers import wgl_native
+
+    rng = random.Random(seed)
+    bad = []
+    t0 = time.monotonic()
+    for t in range(k):
+        kind = rng.choice(("cas", "register"))
+        s = rng.randrange(1 << 30)
+        packed = fixtures.gen_packed(kind, n_ops=33_000, processes=5,
+                                     seed=s)
+        corrupt = rng.random() < 0.5
+        if corrupt:
+            h = fixtures.gen_history(kind, n_ops=33_000, processes=5,
+                                     seed=s)
+            try:
+                h = fixtures.corrupt(h, seed=s)
+            except ValueError:
+                corrupt = False
+            else:
+                from jepsen_tpu.history import pack as _pack
+                packed = _pack(h)
+        model = fixtures.model_for(kind)
+        res = rcl.check_packed(model, packed)
+        ref = (wgl_native.check_packed(model, packed)
+               if wgl_native.available() else None)
+        entry = {"trial": t, "seed": s, "kind": kind,
+                 "corrupt": corrupt, "chunklock": res["valid"],
+                 "rescues": res.get("rescues")}
+        if ref is not None:
+            entry["wgl-native"] = ref["valid"]
+            ok = res["valid"] == ref["valid"]
+            if ok and res["valid"] is False:
+                # the C++ engine reports no dead-event rank; the
+                # failing OP is the shared witness currency
+                ok = res.get("op") == ref.get("op")
+            if not ok:
+                bad.append(entry)
+                print(f"CHUNKLOCK MISMATCH {entry}", file=sys.stderr)
+        if t % 10 == 9:
+            print(f"chunklock {t + 1}/{k} ok "
+                  f"({time.monotonic() - t0:.0f}s)", flush=True)
+    return bad
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1000)
@@ -250,6 +328,9 @@ def main() -> int:
                     help="run the device engine on the real accelerator "
                          "(default: CPU — per-trial dispatch round-trips "
                          "over a tunneled device dominate otherwise)")
+    ap.add_argument("--chunklock", type=int, default=0, metavar="K",
+                    help="additionally run K engine-scale chunk-lockstep "
+                         "trials vs the C++ WGL engine (real chip)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -265,11 +346,16 @@ def main() -> int:
     t0 = time.monotonic()
     mismatches, invalid_seen = run_many(
         args.n, args.seed, pallas=args.pallas, verbose=args.verbose)
+    ckl_bad: list = []
+    if args.chunklock:
+        ckl_bad = chunklock_trials(args.chunklock, args.seed + 99)
     print(json.dumps({
         "trials": args.n, "mismatches": len(mismatches),
         "invalid_histories": invalid_seen,
+        "chunklock_trials": args.chunklock,
+        "chunklock_mismatches": len(ckl_bad),
         "elapsed_s": round(time.monotonic() - t0, 1)}))
-    return 1 if mismatches else 0
+    return 1 if (mismatches or ckl_bad) else 0
 
 
 if __name__ == "__main__":
