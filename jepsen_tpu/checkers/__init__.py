@@ -6,6 +6,9 @@ the linearizability engines live in:
 - :mod:`jepsen_tpu.checkers.reach` — the TPU-native dense-reachability
   search (the north star; upstream ``knossos.linear`` + ``knossos.wgl``
   recast as a device-resident tensor program).
+- :mod:`jepsen_tpu.checkers.reach_chunklock` — one history's chunks
+  walked as simultaneous lockstep lane blocks (suffix bounds, seeded
+  restricted transfers, on-device fold; one host round trip).
 - :mod:`jepsen_tpu.checkers.wgl_ref` — CPU reference Wing-Gong-Lowe search
   (upstream ``knossos.wgl``), the correctness oracle and CPU baseline.
 - :mod:`jepsen_tpu.checkers.linear` — sparse just-in-time linearization

@@ -302,16 +302,28 @@ def chunklock_trials(k: int, seed: int) -> list:
         entry = {"trial": t, "seed": s, "kind": kind,
                  "corrupt": corrupt, "chunklock": res["valid"],
                  "rescues": res.get("rescues")}
+        ok = True
         if ref is not None:
+            # verdicts must agree with the C++ engine; witness OPS are
+            # engine-convention (the DFS legitimately stops at a
+            # different unlinearizable op than first-empty-return)
             entry["wgl-native"] = ref["valid"]
             ok = res["valid"] == ref["valid"]
-            if ok and res["valid"] is False:
-                # the C++ engine reports no dead-event rank; the
-                # failing OP is the shared witness currency
-                ok = res.get("op") == ref.get("op")
-            if not ok:
-                bad.append(entry)
-                print(f"CHUNKLOCK MISMATCH {entry}", file=sys.stderr)
+        if ok and res["valid"] is False:
+            # dead-event must be BIT-IDENTICAL to the sequential
+            # dense walk (same first-empty-return semantics)
+            os.environ["JEPSEN_TPU_NO_CHUNKLOCK"] = "1"
+            try:
+                from jepsen_tpu.checkers import reach
+                seq = reach.check_packed(model, packed)
+            finally:
+                del os.environ["JEPSEN_TPU_NO_CHUNKLOCK"]
+            entry["reach"] = seq["valid"]
+            ok = (seq["valid"] is False
+                  and res.get("dead-event") == seq.get("dead-event"))
+        if not ok:
+            bad.append(entry)
+            print(f"CHUNKLOCK MISMATCH {entry}", file=sys.stderr)
         if t % 10 == 9:
             print(f"chunklock {t + 1}/{k} ok "
                   f"({time.monotonic() - t0:.0f}s)", flush=True)
