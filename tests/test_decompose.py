@@ -289,3 +289,20 @@ class TestTransactional:
         res = linearizable(model).check(None, self._tx_history(
             n=40, values=3))
         assert res["valid"] is True
+
+
+def test_restricted_product_honors_abort():
+    """The auto chain wires its deadline into the restricted-product
+    stage via should_abort; a firing hook yields the explicit
+    unknown instead of unbounded host work."""
+    from jepsen_tpu.checkers import decompose
+    from jepsen_tpu.history import pack
+    from jepsen_tpu.op import invoke, ok
+    h = []
+    for i in range(40):
+        h += [invoke(i % 3, "write", {"x": i}), ok(i % 3, "write", {"x": i})]
+    res = decompose.check_restricted_product(
+        m.multi_register({"x": 0}), pack(index(h)),
+        should_abort=lambda: True)
+    assert res is not None and res["valid"] == "unknown"
+    assert res["cause"] == "aborted"

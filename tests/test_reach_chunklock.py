@@ -116,3 +116,15 @@ def test_chunklock_gates():
 def test_chunklock_fits_envelope():
     assert reach_chunklock.fits(8, 32, 5, 32, 8)
     assert not reach_chunklock.fits(64, 1 << 14, 8, 64, 32)
+
+
+def test_chunklock_facade_algorithm():
+    """The explicit ``chunklock`` facade algorithm routes engine
+    options through and returns the branded verdict."""
+    from jepsen_tpu.checkers import facade
+    h = fixtures.gen_history("cas", n_ops=130, processes=4, seed=21)
+    res = facade.linearizable(
+        models.cas_register(), algorithm="chunklock", n_chunks=4,
+        e_pad=2, suffix=8, interpret=True).check(None, h)
+    assert res["valid"] is True
+    assert res["engine"] == "reach-chunklock"
