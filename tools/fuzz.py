@@ -280,7 +280,7 @@ def chunklock_trials(k: int, seed: int) -> list:
     bad = []
     t0 = time.monotonic()
     for t in range(k):
-        kind = rng.choice(("cas", "register"))
+        kind = rng.choice(("cas", "register", "mutex"))
         s = rng.randrange(1 << 30)
         packed = fixtures.gen_packed(kind, n_ops=33_000, processes=5,
                                      seed=s)
