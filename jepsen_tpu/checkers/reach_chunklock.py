@@ -91,8 +91,11 @@ _EPAD_SMALL = 1 << 18
 
 # suffix length for the bound pass: long enough for projections to
 # contract ⊤ to (nearly) the true boundary set, short enough that the
-# pass is ~free next to phase B
+# pass is ~free next to phase B. Long walks (e_pad=1: ANY looseness
+# flags a rescue, and a rescue re-walks 1/C of millions of returns)
+# double it — phase A is a few hundred lockstep steps either way.
 _SUFFIX = 256
+_SUFFIX_LONG = 512
 
 # engine floor: below this many returns the single-dispatch lane walk
 # is already round-trip-bound and chunking buys nothing
@@ -264,7 +267,8 @@ def _localize(P: np.ndarray, ret_slot: np.ndarray,
 def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
                    slot_ops: np.ndarray, M: int, *,
                    n_chunks: Optional[int] = None,
-                   e_pad: Optional[int] = None, suffix: int = _SUFFIX,
+                   e_pad: Optional[int] = None,
+                   suffix: Optional[int] = None,
                    interpret: bool = False
                    ) -> Tuple[int, Dict[str, Any]]:
     """Chunk-lockstep returns walk over one history. Returns
@@ -283,6 +287,8 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
         raise ChunklockUnfit(f"W={W} beyond exact-ladder cap")
     if e_pad is None:
         e_pad = _E_PAD if Rn < _EPAD_SMALL else 1
+    if suffix is None:
+        suffix = _SUFFIX if Rn < _EPAD_SMALL else _SUFFIX_LONG
     C = n_chunks if n_chunks is not None else _auto_chunks(S, Rn)
     C = max(2, min(C, Rn))
     if not fits(S, M, W, C, e_pad):
@@ -376,7 +382,7 @@ def check_packed(model, packed, *, max_states: int = 100_000,
                  max_slots: int = 20, max_dense: int = 1 << 22,
                  n_chunks: Optional[int] = None,
                  e_pad: Optional[int] = None,
-                 suffix: int = _SUFFIX,
+                 suffix: Optional[int] = None,
                  interpret: bool = False) -> Dict[str, Any]:
     """Standalone entry (the ``chunklock`` algorithm name): prep +
     chunk-lockstep walk + knossos-style verdict/witness. Raises
