@@ -23,7 +23,21 @@ transfer measurement pays) and subtracted from the transfer figure,
 so ``transfer_sync_s`` is bytes on the wire, not latency; raw
 put+observe = ``transfer_sync_s + rtt_s``.
 
-Usage: python bench.py [--ops N] [--repeat K] [--engine reach|chunked]
+The default run's ``"batch"`` sub-object carries the lockstep batch
+rung (``reach.check_batch``) with its bucketed-dispatch diagnostics:
+per-bucket geometry (``per_bucket``: H/B/W/S/R_pad and real vs padded
+returns per lockstep group), ``pack_efficiency`` (real returns over
+padded lockstep steps — the win of length-bucketed lane packing),
+``kernel_cache`` (hit/miss counters of the per-geometry compiled-kernel
+cache), and aggregate ops/s. ``--engine batch`` promotes the batch
+dimension to the HEADLINE: a ragged independent-keys workload
+(BASELINE config #4 shape — ``--ops`` total over ≥8 keys of mixed
+lengths) through ``reach.check_many``'s bucketed lockstep lane,
+reported against the sequential per-key baseline measured in the same
+run. All of it lands in the BENCH_*.json trajectory artifacts.
+
+Usage: python bench.py [--ops N] [--repeat K]
+       [--engine reach|chunked|batch|wgl-cpu|wgl-native]
 """
 from __future__ import annotations
 
@@ -174,7 +188,8 @@ def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
                                    processes=processes,
                                    seed=seed + 1000 + i)
                for i in range(H)]
-    res = reach.check_batch(model, packeds)       # warm/compile
+    diag: dict = {}
+    res = reach.check_batch(model, packeds, diag=diag)  # warm/compile
     if not all(r["valid"] is True for r in res):
         return {"error": "bad batch verdicts"}
     engines = {r["engine"] for r in res}
@@ -192,7 +207,73 @@ def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
     best = min(times)
     return {"H": H, "e2e_s": round(best, 3),
             "agg_ops_s": round(H * n_ops / best),
-            "engine": sorted(engines)}
+            "engine": sorted(engines),
+            "pack_efficiency": diag.get("pack_efficiency"),
+            "real_returns": diag.get("real_returns"),
+            "padded_returns": diag.get("padded_returns"),
+            "kernel_cache": diag.get("kernel_cache"),
+            "per_bucket": diag.get("groups", [])}
+
+
+def _ragged_lengths(total: int, keys: int = 12,
+                    ratio: float = 1.45) -> list:
+    """Deterministic mixed-length key split (BASELINE config #4 shape):
+    a geometric spread over ``keys`` keys summing to ~``total`` ops, so
+    lengths span several power-of-two buckets and the bucketed lane
+    packer has real work to do."""
+    w = [ratio ** -i for i in range(keys)]
+    s = sum(w)
+    return [max(24, int(total * x / s)) for x in w]
+
+
+def independent_probe(model, n_ops: int, seed: int,
+                      processes: int) -> dict:
+    """Ragged independent-keys rung: ``n_ops`` total over >= 8 keys of
+    mixed lengths through ``reach.check_many`` (the bucketed LOCKSTEP
+    lane by default on TPU), against the sequential per-key
+    ``check_packed`` baseline measured in the same run — the honest
+    apples-to-apples the acceptance bar asks for. Reports per-bucket
+    geometry, pack efficiency, kernel-cache counters, and aggregate
+    ops/s for both paths."""
+    from jepsen_tpu import fixtures
+    from jepsen_tpu.checkers import reach
+
+    lens = _ragged_lengths(n_ops)
+    packeds = [fixtures.gen_packed("cas", n_ops=L, processes=processes,
+                                   seed=seed + 500 + i)
+               for i, L in enumerate(lens)]
+    total = sum(lens)
+    diag: dict = {}
+    res = reach.check_many(model, packeds, diag=diag)   # warm/compile
+    if not all(r["valid"] is True for r in res):
+        return {"error": "bad ragged verdicts"}
+    engines = sorted({r["engine"] for r in res})
+    times = []
+    for _ in range(2):
+        t1 = time.monotonic()
+        reach.check_many(model, packeds)
+        times.append(time.monotonic() - t1)
+    best = min(times)
+    # sequential per-key baseline: same histories, same run, warmed
+    # once so both sides are steady-state
+    for p in packeds:
+        reach.check_packed(model, p)
+    t1 = time.monotonic()
+    for p in packeds:
+        reach.check_packed(model, p)
+    seq_s = max(time.monotonic() - t1, 1e-9)
+    return {"keys": len(lens), "lens": lens,
+            "e2e_s": round(best, 3),
+            "agg_ops_s": round(total / best),
+            "seq_s": round(seq_s, 3),
+            "seq_ops_s": round(total / seq_s),
+            "speedup_vs_sequential": round(seq_s / best, 2),
+            "engine": engines,
+            "pack_efficiency": diag.get("pack_efficiency"),
+            "real_returns": diag.get("real_returns"),
+            "padded_returns": diag.get("padded_returns"),
+            "kernel_cache": diag.get("kernel_cache"),
+            "per_bucket": diag.get("groups", [])}
 
 
 def main() -> int:
@@ -201,7 +282,8 @@ def main() -> int:
     ap.add_argument("--processes", type=int, default=5)
     ap.add_argument("--repeat", type=int, default=5)
     ap.add_argument("--engine", default="reach",
-                    choices=["reach", "chunked", "wgl-cpu", "wgl-native"])
+                    choices=["reach", "chunked", "batch", "wgl-cpu",
+                             "wgl-native"])
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--no-batch", action="store_true",
                     help="skip the lockstep batch probe")
@@ -212,6 +294,23 @@ def main() -> int:
 
     from jepsen_tpu import fixtures, models
     from jepsen_tpu.checkers import reach, wgl_ref
+
+    if args.engine == "batch":
+        # the batch dimension AS the headline: ragged independent-keys
+        # through the bucketed lockstep lane, vs the sequential
+        # per-key baseline in the same run
+        model = models.cas_register()
+        probe = independent_probe(model, args.ops, args.seed,
+                                  args.processes)
+        agg = probe.get("agg_ops_s", 0) or 0
+        baseline_floor = 100_000 / 60.0
+        out = {"metric": (f"independent-{args.ops // 1000}k-cas-"
+                          f"x{probe.get('keys', 0)}"),
+               "value": float(agg), "unit": "ops/s",
+               "vs_baseline": round(agg / baseline_floor, 2),
+               "batch": probe}
+        print(json.dumps(out))
+        return 0 if "error" not in probe else 1
 
     t0 = time.monotonic()
     # native packed-level generation: at 10M ops the Python tick loop

@@ -288,6 +288,48 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
     return res
 
 
+def auto_check_many_packed(model: Model, packed_list,
+                           kw: Mapping) -> "list":
+    """The ``auto`` chain for MANY packed histories at once (the
+    ``independent`` checker's batch dimension, or a run that produced
+    several complete histories): the batched device engines first —
+    :func:`reach.check_many` routes bucketed lockstep groups, then the
+    keyed flat-stream kernel, then the vmapped XLA walk — falling back
+    to the per-history :func:`auto_check_packed` chain when the batch
+    route cannot hold every history (dense/union overflow, or a
+    too-concurrent key). Mirrors how :func:`auto_check_packed` is the
+    one-history chain; results align with ``packed_list``."""
+    import logging
+
+    from jepsen_tpu.checkers import reach
+    from jepsen_tpu.checkers.events import ConcurrencyOverflow
+    from jepsen_tpu.models.memo import StateExplosion
+
+    try:
+        return reach.check_many(model, packed_list,
+                                **_engine_kw(kw, _REACH_MANY_KW))
+    except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion):
+        pass
+    except Exception as e:                              # noqa: BLE001
+        # jax/XLA runtime failures keep the graceful per-history
+        # fallback (traceback preserved); our own bugs must surface
+        if not reach._raised_from_jax(e):
+            raise
+        logging.getLogger("jepsen.reach").warning(
+            "batched many-history check failed (%r); falling back to "
+            "per-history checking", e, exc_info=e)
+    out = []
+    for p in packed_list:
+        try:
+            out.append(auto_check_packed(model, p, kw))
+        except Exception as e:                          # noqa: BLE001
+            # check-safe semantics: one pathological history yields an
+            # "unknown", not a crashed batch
+            out.append({"valid": "unknown",
+                        "error": f"{type(e).__name__}: {e}"})
+    return out
+
+
 # keyword subsets understood by each engine; user opts are filtered so one
 # checker config can carry opts for every algorithm it may route to.
 _REACH_KW = ("max_states", "max_slots", "max_dense", "should_abort")

@@ -137,10 +137,12 @@ def render_analysis(history: Sequence[Op], result: Mapping[str, Any],
                 f'height="{_BAR_H}" fill="url(#crashfade)">'
                 f'<title>{title}</title></rect>')
         else:
+            # Python < 3.12 rejects backslashes inside f-string
+            # expressions, so the conditional attribute is hoisted out
+            stroke = ' stroke="#a33" stroke-width="2"' if e is stuck else ""
             parts.append(
                 f'<rect x="{x0:.1f}" y="{y}" width="{wdt:.1f}" '
-                f'height="{_BAR_H}" rx="3" fill="{color}"'
-                f'{" stroke=\"#a33\" stroke-width=\"2\"" if e is stuck else ""}>'
+                f'height="{_BAR_H}" rx="3" fill="{color}"{stroke}>'
                 f'<title>{title}</title></rect>')
         parts.append(f'<text x="{x0 + 3:.1f}" y="{y + _BAR_H - 7}" '
                      f'fill="#fff"><title>{title}</title>{label}</text>')

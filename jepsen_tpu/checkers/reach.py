@@ -418,16 +418,31 @@ def _raised_from_jax(e: BaseException) -> bool:
     """True when the exception is jax/jaxlib's — either by class (e.g.
     XlaRuntimeError) or by raise site (jax raises builtin ValueError/
     RuntimeError for mesh-shape and OOM failures, which must keep the
-    graceful fallback while our own programming errors surface)."""
+    graceful fallback while our own programming errors surface).
+
+    For NON-jax exception classes, a ``jepsen_tpu`` frame BELOW the
+    first jax frame *in the traceback* means jax re-entered our code
+    (tracing a kernel/walk body) and the raise is ours — a genuine
+    repo bug that must surface, not silently degrade to a fallback
+    engine. The below-jax test keys ONLY on traceback-observed jax
+    frames: the traceback always begins with our own caller frames
+    (the function holding the ``try``), which are ABOVE jax, not
+    below. Exceptions of jax's own classes (XlaRuntimeError & co) are
+    environmental by definition and keep the fallback even when our
+    traced body appears mid-traceback."""
     if (type(e).__module__ or "").startswith(("jax", "jaxlib")):
         return True
     tb = e.__traceback__
+    tb_jax_seen = False
+    ours_below_jax = False
     while tb is not None:
         mod = tb.tb_frame.f_globals.get("__name__", "")
         if mod.startswith(("jax", "jaxlib")):
-            return True
+            tb_jax_seen = True
+        elif tb_jax_seen and mod.startswith("jepsen_tpu"):
+            ours_below_jax = True   # our code raised inside jax tracing
         tb = tb.tb_next
-    return False
+    return tb_jax_seen and not ours_below_jax
 
 
 def _bucket(x: int, grain: int = 8) -> int:
@@ -1159,7 +1174,8 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
                 max_states: int = 100_000, max_slots: int = 20,
                 max_dense: int = 1 << 22,
                 devices: Optional[Sequence] = None,
-                group: int = _BATCH_GROUP) -> List[Dict[str, Any]]:
+                group: int = _BATCH_GROUP,
+                diag: Optional[dict] = None) -> List[Dict[str, Any]]:
     """Check SEVERAL complete histories at once on the lockstep batch
     kernel (:mod:`jepsen_tpu.checkers.reach_batch`): the config sets of
     up to ``group`` histories advance together, one return index per
@@ -1205,9 +1221,12 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
             # silently degrade every sharded batch
             if not _raised_from_jax(e):
                 raise
+            # full traceback at warning level: a silent degrade must
+            # leave enough evidence to distinguish "OOM on this mesh"
+            # from a misclassified programming error
             logging.getLogger("jepsen.reach").warning(
                 "sharded history batch failed (%r); falling back to "
-                "the single-device path", e)
+                "the single-device path", e, exc_info=e)
     t0 = _time.monotonic()
     results: List[Optional[Dict[str, Any]]] = [
         {"valid": True, "engine": "reach-lockstep", "events": 0,
@@ -1230,15 +1249,15 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
     (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
      offsets, opid_cat, crs_cat, offs, noop_op) = u
     from jepsen_tpu.checkers import reach_batch
-    dead = np.full(len(live), -1, np.int64)
     try:
-        for g0 in range(0, len(live), group):
-            gk = list(range(g0, min(g0 + group, len(live))))
-            dead[gk] = reach_batch.walk_returns_batch(
-                P,
-                [ret_flat[offsets[k]:offsets[k + 1]] for k in gk],
-                [ops_flat[offsets[k]:offsets[k + 1]] for k in gk],
-                M)
+        # length-bucketed lane packing + pipelined group dispatch: a
+        # ragged batch no longer pads every history to the longest,
+        # and group g+1's marshalling/compile hides under group g's
+        # device walk
+        groups = reach_batch.plan_buckets(
+            [int(r) for r in key_R], W, group=group)
+        dead = _dispatch_lockstep_groups(
+            P, ret_flat, ops_flat, offsets, groups, M, len(live), diag)
     except Exception as e:                              # noqa: BLE001
         _warn_pallas_failed(repr(e))
         for i in live:
@@ -1248,32 +1267,9 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
                                       max_dense=max_dense)
         return results  # type: ignore[return-value]
     elapsed = _time.monotonic() - t0
-    drop_cat = (crs_cat & noop_op[opid_cat]).astype(np.int64)
-    drop_per_key = np.add.reduceat(drop_cat, offs[:-1])
-    for k, i in enumerate(live):
-        p = packed_list[i]
-        dropped = int(drop_per_key[k])
-        if int(dead[k]) < 0:
-            results[i] = _union_valid_result(
-                "reach-lockstep", p, dropped, int(key_R[k]),
-                int(key_W[k]), memo_u.n_states, elapsed)
-        else:
-            # decode the failure in the history's LOCAL geometry with
-            # the full per-history pipeline (dead[k] is already a
-            # local return index)
-            local = int(dead[k])
-            memo_k, stream_k, _Tk, S_k, M_k = _prep(
-                model, p, max_states=max_states, max_slots=max_slots,
-                max_dense=max_dense)
-            rs_k = ev.returns_view(stream_k)
-            W_k = max(stream_k.W, 1)
-            results[i] = _result_invalid(
-                "reach-lockstep", stream_k, memo_k, p,
-                int(rs_k.ret_event[local]), elapsed)
-            _attach_witness(results[i], memo_k, rs_k,
-                            _build_P(memo_k, S_k), S_k, M_k, W_k,
-                            local, p)
-    return results  # type: ignore[return-value]
+    return _union_results("reach-lockstep", model, packed_list, live,
+                          dead, u, elapsed, max_states, max_slots,
+                          max_dense)
 
 
 def _check_many_native(model: Model,
@@ -1320,39 +1316,16 @@ def _check_many_native(model: Model,
             _warn_pallas_failed(repr(e2))
             return None
     elapsed = _time.monotonic() - t0
-    # per-key dropped-crashed-noop counts (vectorized over the concat;
-    # every live key has n >= 1, so reduceat segments are non-empty)
-    drop_cat = (crs_cat & noop_op[opid_cat]).astype(np.int64)
-    drop_per_key = np.add.reduceat(drop_cat, offs[:-1])
-    results: List[Optional[Dict[str, Any]]] = [
-        {"valid": True, "engine": "reach-batch", "events": 0,
-         "time-s": 0.0} if (packed_list[i].n == 0
-                            or packed_list[i].n_ok == 0) else None
-        for i in range(len(packed_list))]
-    for k, i in enumerate(live):
-        p = packed_list[i]
-        dropped = int(drop_per_key[k])
-        if int(dead[k]) < 0:
-            results[i] = _union_valid_result(
-                "reach-keyed", p, dropped, int(key_R[k]),
-                int(key_W[k]), memo_u.n_states, elapsed)
-        else:
-            # rare: decode the failure in the key's LOCAL geometry with
-            # the full per-key pipeline (same return ordering — drops
-            # only remove crashed entries, which never return)
-            local = int(dead[k]) - int(offsets[k])
-            memo_k, stream_k, _Tk, S_k, M_k = _prep(
-                model, p, max_states=max_states, max_slots=max_slots,
-                max_dense=max_dense)
-            rs_k = ev.returns_view(stream_k)
-            W_k = max(stream_k.W, 1)
-            results[i] = _result_invalid(
-                "reach-keyed", stream_k, memo_k, p,
-                int(rs_k.ret_event[local]), elapsed)
-            _attach_witness(results[i], memo_k, rs_k,
-                            _build_P(memo_k, S_k), S_k, M_k, W_k,
-                            local, p)
-    return results  # type: ignore[return-value]
+    # flat dead indices (into the concatenated keyed stream) -> local
+    # per-key return indices; the shared union assembly decodes the
+    # rare failed key in its own geometry (same return ordering —
+    # drops only remove crashed entries, which never return)
+    dead_local = np.array(
+        [int(d) - int(offsets[k]) if int(d) >= 0 else -1
+         for k, d in enumerate(dead)], np.int64)
+    return _union_results("reach-keyed", model, packed_list, live,
+                          dead_local, u, elapsed, max_states,
+                          max_slots, max_dense)
 
 
 def _union_valid_result(engine: str, p: h.PackedHistory, dropped: int,
@@ -1365,6 +1338,154 @@ def _union_valid_result(engine: str, p: h.PackedHistory, dropped: int,
             "events": (p.n - dropped) + key_R_k,
             "slots": key_W_k, "states": n_states,
             "dropped-crashed-noops": dropped, "time-s": elapsed}
+
+
+def _union_results(engine: str, model: Model,
+                   packed_list: Sequence[h.PackedHistory],
+                   live: Sequence[int], dead_local: np.ndarray, u,
+                   elapsed: float, max_states: int, max_slots: int,
+                   max_dense: int) -> List[Dict[str, Any]]:
+    """Assemble per-history results from union-geometry verdicts —
+    shared by the keyed and lockstep lanes of :func:`check_many` and
+    by :func:`check_batch`. ``dead_local[k]`` is live history k's
+    LOCAL dead return index (-1 = linearizable). Valid histories are
+    answered from the union accounting; the rare failed history
+    decodes in its OWN geometry with the full witness pipeline."""
+    (memo_u, _S_pad, _P, _W, _M, _ret_flat, _ops_flat, key_W, key_R,
+     _offsets, opid_cat, crs_cat, offs, noop_op) = u
+    drop_cat = (crs_cat & noop_op[opid_cat]).astype(np.int64)
+    drop_per_key = np.add.reduceat(drop_cat, offs[:-1])
+    results: List[Optional[Dict[str, Any]]] = [
+        {"valid": True, "engine": engine, "events": 0,
+         "time-s": 0.0} if (packed_list[i].n == 0
+                            or packed_list[i].n_ok == 0) else None
+        for i in range(len(packed_list))]
+    for k, i in enumerate(live):
+        p = packed_list[i]
+        dropped = int(drop_per_key[k])
+        if int(dead_local[k]) < 0:
+            results[i] = _union_valid_result(
+                engine, p, dropped, int(key_R[k]), int(key_W[k]),
+                memo_u.n_states, elapsed)
+        else:
+            local = int(dead_local[k])
+            memo_k, stream_k, _Tk, S_k, M_k = _prep(
+                model, p, max_states=max_states, max_slots=max_slots,
+                max_dense=max_dense)
+            rs_k = ev.returns_view(stream_k)
+            W_k = max(stream_k.W, 1)
+            results[i] = _result_invalid(
+                engine, stream_k, memo_k, p,
+                int(rs_k.ret_event[local]), elapsed)
+            _attach_witness(results[i], memo_k, rs_k,
+                            _build_P(memo_k, S_k), S_k, M_k, W_k,
+                            local, p)
+    return results  # type: ignore[return-value]
+
+
+# in-flight lockstep dispatch groups beyond the one being collected.
+# Depth 1 queues the NEXT group's device programs — paying its
+# marshalling, compile (on a fresh geometry), and transfer host time —
+# while the device walks the current group; the same K-deep dispatch
+# trick bench.py's kernel probe validates. Deeper pipelines pin more
+# operand sets in HBM for ~no added overlap (the host stage is the
+# bottleneck, and it is already fully hidden at depth 1).
+_LOCKSTEP_PIPE_DEPTH = 1
+
+
+def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
+                              M: int, n_live: int,
+                              diag: Optional[dict] = None) -> np.ndarray:
+    """Bucketed, pipelined lockstep dispatch: each group in ``groups``
+    (index lists into the live-key axis, from
+    :func:`reach_batch.plan_buckets`) walks the batch kernel in its own
+    geometry; group g+1's walk is QUEUED before group g's verdicts are
+    fetched, so host marshalling/compiles overlap device walks. The
+    per-geometry compiled-kernel cache (``reach_batch._batch_call``)
+    makes repeated geometries free across groups and calls. Fills
+    ``diag`` (when given) with per-group geometry, pack efficiency
+    (real vs padded returns), and kernel-cache counters. Returns the
+    per-live-key local dead indices."""
+    from jepsen_tpu.checkers import reach_batch
+
+    dead = np.full(n_live, -1, np.int64)
+    inflight: List = []
+
+    def _drain(limit: int) -> None:
+        while len(inflight) > limit:
+            g0, fl0 = inflight.pop(0)
+            dead[np.asarray(g0, np.int64)] = \
+                reach_batch.collect_returns_batch(fl0)
+
+    for g in groups:
+        fl = reach_batch.dispatch_returns_batch(
+            P,
+            [ret_flat[offsets[k]:offsets[k + 1]] for k in g],
+            [ops_flat[offsets[k]:offsets[k + 1]] for k in g],
+            M)
+        if diag is not None:
+            diag.setdefault("groups", []).append(
+                reach_batch.group_diag(fl.geom, fl.R_lens))
+        inflight.append((g, fl))
+        _drain(_LOCKSTEP_PIPE_DEPTH)
+    _drain(0)
+    if diag is not None:
+        gs = diag.get("groups", [])
+        real = sum(d["real_returns"] for d in gs)
+        padded = sum(d["padded_returns"] for d in gs)
+        diag["real_returns"] = real
+        diag["padded_returns"] = padded
+        diag["pack_efficiency"] = round(real / max(padded, 1), 4)
+        diag["kernel_cache"] = reach_batch.kernel_cache_info()
+    return dead
+
+
+def _check_many_lockstep(model: Model,
+                         packed_list: Sequence[h.PackedHistory],
+                         max_states: int, max_slots: int,
+                         max_dense: int, t0: float,
+                         group: int = 0,
+                         diag: Optional[dict] = None
+                         ) -> Optional[List[Dict[str, Any]]]:
+    """Bucketed-lockstep fast lane for :func:`check_many` — the
+    production path for ragged ``independent`` batches: ONE union
+    memo + ONE native preprocessing call (as the keyed lane), then
+    length-bucketed lane packing (:func:`reach_batch.plan_buckets`) so
+    a long key never forces short keys through its padding, pipelined
+    group dispatch, and per-geometry compiled kernels cached across
+    groups. Aggregate throughput beats the keyed kernel because H keys
+    advance per lockstep step instead of one — the flat keyed stream
+    pays the per-issue latency wall once per RETURN, this lane once
+    per step. Returns the results list, or None to fall through to the
+    keyed kernel / vmapped XLA paths (no native lib, union explosion,
+    budget overflow, kernel failure)."""
+    from jepsen_tpu.checkers import preproc_native
+
+    if not (_use_pallas() and preproc_native.available()):
+        return None
+    live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
+    if len(live) < 2:
+        return None
+    if sum(packed_list[i].n_ok for i in live) < _PALLAS_MIN_RETURNS:
+        return None
+    u = _union_prep(model, packed_list, live, max_states, max_slots)
+    if u is None:
+        return None
+    from jepsen_tpu.checkers import reach_batch
+    (_memo_u, _S_pad, P, W, M, ret_flat, ops_flat, _key_W, key_R,
+     offsets, _opid_cat, _crs_cat, _offs, _noop_op) = u
+    groups = reach_batch.plan_buckets(
+        [int(r) for r in key_R], W, group=group or _BATCH_GROUP)
+    try:
+        dead = _dispatch_lockstep_groups(
+            P, ret_flat, ops_flat, offsets, groups, M, len(live), diag)
+    except Exception as e:                              # noqa: BLE001
+        _warn_pallas_failed(f"lockstep: {e!r}")
+        return None
+    elapsed = _time.monotonic() - t0
+    return _union_results("reach-lockstep", model, packed_list, live,
+                          dead, u, elapsed, max_states, max_slots,
+                          max_dense)
 
 
 def _key_axis_shardings(devices: Sequence, n_keys: int):
@@ -1466,11 +1587,15 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                max_states: int = 100_000, max_slots: int = 20,
                max_dense: int = 1 << 22,
                devices: Optional[Sequence] = None,
-               should_abort=None) -> List[Dict[str, Any]]:
-    """Batched per-key checking (the ``independent`` checker's hot path):
-    one vmapped device call over all keys, padded to common shapes. Keys
-    whose history does not fit the dense engine raise; callers split those
-    out first via :func:`fits`.
+               should_abort=None,
+               diag: Optional[dict] = None) -> List[Dict[str, Any]]:
+    """Batched per-key checking (the ``independent`` checker's hot
+    path). Single-chip route order: the bucketed LOCKSTEP lane
+    (:func:`_check_many_lockstep` — groups of keys advance together,
+    one return index per step), then the keyed flat-stream kernel,
+    then one vmapped device call over all keys padded to common
+    shapes. Keys whose history does not fit the dense engine raise;
+    callers split those out first via :func:`fits`.
 
     With ``devices`` (>1), the key axis is sharded over a
     ``jax.sharding.Mesh`` — the data-parallel axis of SURVEY.md §2.4:
@@ -1478,7 +1603,9 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
     the while-loop's all-reduced liveness test. ``should_abort`` is
     consulted once before the batched device dispatch (the batch is one
     call — per-key granularity would defeat its throughput); when it
-    fires, every live key reports ``valid == "unknown"``."""
+    fires, every live key reports ``valid == "unknown"``. ``diag``
+    (a dict, filled in place) receives the lockstep lane's per-group
+    geometry, pack efficiency, and kernel-cache counters."""
     import jax.numpy as jnp
 
     t0 = _time.monotonic()
@@ -1486,6 +1613,13 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
         return [{"valid": "unknown", "cause": "aborted",
                  "engine": "reach-batch"} for _ in packed_list]
     if devices is None or len(devices) <= 1:
+        out = _check_many_lockstep(model, packed_list,
+                                   max_states=max_states,
+                                   max_slots=max_slots,
+                                   max_dense=max_dense, t0=t0,
+                                   diag=diag)
+        if out is not None:
+            return out
         out = _check_many_native(model, packed_list,
                                  max_states=max_states,
                                  max_slots=max_slots,

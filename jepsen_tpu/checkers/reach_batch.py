@@ -80,6 +80,78 @@ def _adaptive_block(H: int, W: int) -> int:
     return min(_BLOCK, b)
 
 
+def plan_buckets(R_lens, W: int, *, group: int = 32) -> List[List[int]]:
+    """Length-bucketed lane packing: partition a ragged batch of return
+    streams into lockstep dispatch groups such that no stream walks
+    more than ~2x its own padded length. Streams are assigned to
+    power-of-two length buckets and each bucket is greedily chunked
+    (longest first) into groups of at most ``group`` lanes — so a
+    10k-return history no longer forces 200-return co-batched keys to
+    walk 10k padded lockstep steps.
+
+    Lengths at or below the dispatch block size (the SMEM-budgeted
+    ``_adaptive_block`` floor, which every group pads to anyway) share
+    ONE floor bucket: splitting them buys nothing and costs extra
+    dispatches + compile geometries. (The floor uses the production
+    block size; interpret-mode dispatches use a smaller block, making
+    the floor bucket merely coarser there — suboptimal packing, never
+    incorrect.) Groups are ordered longest bucket first so the
+    pipelined scheduler overlaps later (cheaper) groups' marshalling
+    and compiles with the big walk. Returns a partition of
+    ``range(len(R_lens))`` — every index appears in exactly one
+    group."""
+    floor = _adaptive_block(
+        max(1, min(group, len(R_lens))), max(W, 1))
+    order = sorted(range(len(R_lens)),
+                   key=lambda i: (-int(R_lens[i]), i))
+    buckets: dict = {}
+    for i in order:
+        eff = max(int(R_lens[i]), floor, 1)
+        buckets.setdefault((eff - 1).bit_length(), []).append(i)
+    groups: List[List[int]] = []
+    for key in sorted(buckets, reverse=True):
+        idxs = buckets[key]
+        for j in range(0, len(idxs), group):
+            groups.append(idxs[j:j + group])
+    return groups
+
+
+def group_geom(R_max: int, H: int, W: int, *,
+               interpret: bool = False) -> Tuple[int, int]:
+    """Dispatch block size and padded lockstep step count for a group
+    of ``H`` streams whose longest member has ``R_max`` returns — the
+    ONE source of the padding formula, shared by
+    :func:`pack_batch_operands`, the ``tools/batch_width.py`` ragged
+    sweep, and the geometry-bounds tests (a formula drift there would
+    otherwise silently misreport pack efficiency)."""
+    from jepsen_tpu.checkers.reach import _bucket
+
+    B = min(32, _BLOCK) if interpret else _adaptive_block(H, W)
+    R_pad = max(B, _bucket(-(-max(int(R_max), 1) // B) * B, B))
+    return B, R_pad
+
+
+def group_diag(geom, R_lens) -> dict:
+    """Per-group geometry + pack-efficiency accounting for one lockstep
+    dispatch (bench.py's batch rung): real vs padded returns under this
+    group's ``(H, B, W, S, M, R_pad)`` geometry."""
+    B, W, M, S, H, O1, R_pad = geom
+    real = int(sum(int(r) for r in R_lens))
+    return {"H": H, "B": B, "W": W, "S": S, "R_pad": R_pad,
+            "real_returns": real, "padded_returns": H * R_pad}
+
+
+def kernel_cache_info() -> dict:
+    """Hit/miss counters of the per-geometry compiled-kernel cache
+    (:func:`_batch_call`, keyed on ``(B, W, M, S, H, O1, segment,
+    passes, dtype)``): a bucketed ragged batch reuses one compiled
+    program per distinct geometry, and the bench batch rung surfaces
+    these so a geometry-churn regression is visible."""
+    ci = _batch_call.cache_info()
+    return {"hits": int(ci.hits), "misses": int(ci.misses),
+            "entries": int(ci.currsize)}
+
+
 def _one_fire_pass_b(R, G_all, W: int, M: int, HS: int):
     """One Jacobi fire pass over the batched set: ONE fused
     ``[M,HS] @ [HS, W*HS]`` matmul (block-diagonal G ⇒ history h's
@@ -302,14 +374,11 @@ def pack_batch_operands(P: np.ndarray, ret_slots: List[np.ndarray],
     interleaved return-major — ``slot_ops_flat[(r*H + h)*W + jj]`` and
     ``ret_slot_rh[r, h]`` — so one SMEM/VMEM block holds a contiguous
     run of lockstep steps. Returns ``(geom, host_args, R_lens)``."""
-    from jepsen_tpu.checkers.reach import _bucket
-
     O1, S, _ = P.shape
     H = len(ret_slots)
     W = max(int(so.shape[1]) for so in slot_ops)
-    B = min(32, _BLOCK) if interpret else _adaptive_block(H, W)
     R_max = max(1, max(int(r.shape[0]) for r in ret_slots))
-    R_pad = max(B, _bucket(-(-R_max // B) * B, B))
+    B, R_pad = group_geom(R_max, H, W, interpret=interpret)
     rs_rh = np.full((R_pad, H), -1, np.int8)
     ops_rhw = np.full((R_pad, H, W), -1, np.int32)
     for h in range(H):
@@ -378,22 +447,56 @@ def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
     return ckpts, R_cur
 
 
-def walk_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
-                       slot_ops: List[np.ndarray], M: int, *,
-                       interpret: bool = False) -> np.ndarray:
-    """Walk H independent return streams in lockstep; returns
-    ``dead[H]`` — per history, the first return index at which its
-    config set emptied, or -1 if linearizable. Exact: capped fast
-    ladder first (sound for "valid"), per-history exact rescue +
-    block-checkpoint refinement on death, identical verdicts and
-    indices to H single-history walks."""
+class BatchInflight:
+    """A dispatched-but-unfetched lockstep walk: every device program
+    is queued, no result has crossed the wire. Produced by
+    :func:`dispatch_returns_batch`, consumed by
+    :func:`collect_returns_batch` — the split lets a scheduler queue
+    the NEXT group's walk (and pay its marshalling/compile host time)
+    before fetching the previous group's verdicts, overlapping host
+    work with device walks across bucket groups."""
+    __slots__ = ("P", "geom", "host_args", "R_lens", "dsegs",
+                 "ckpts", "final", "interpret")
+
+    def __init__(self, P, geom, host_args, R_lens, dsegs, ckpts,
+                 final, interpret):
+        self.P = P
+        self.geom = geom
+        self.host_args = host_args
+        self.R_lens = R_lens
+        self.dsegs = dsegs
+        self.ckpts = ckpts
+        self.final = final
+        self.interpret = interpret
+
+
+def dispatch_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
+                           slot_ops: List[np.ndarray], M: int, *,
+                           interpret: bool = False) -> BatchInflight:
+    """Marshal + queue the lockstep walk of H return streams without
+    fetching anything. Pair with :func:`collect_returns_batch`."""
     geom, host_args, R_lens = pack_batch_operands(
         P, ret_slots, slot_ops, M, interpret=interpret)
-    B, W, M, S, H, O1, R_pad = geom
+    W = geom[1]
     n_fast = min(W, _FAST_PASSES)
     dsegs: dict = {}
     ckpts, final = _pipe_walk_b(host_args, geom, n_fast, interpret,
                                 dsegs)
+    return BatchInflight(P, geom, host_args, R_lens, dsegs, ckpts,
+                         final, interpret)
+
+
+def collect_returns_batch(fl: BatchInflight) -> np.ndarray:
+    """Fetch an in-flight lockstep walk's verdicts: ``dead[H]`` — per
+    history, the first return index at which its config set emptied,
+    or -1 if linearizable (exact rescue + localization as
+    :func:`walk_returns_batch`)."""
+    P, interpret = fl.P, fl.interpret
+    geom, host_args, R_lens, dsegs = (fl.geom, fl.host_args, fl.R_lens,
+                                      fl.dsegs)
+    B, W, M, S, H, O1, R_pad = geom
+    n_fast = min(W, _FAST_PASSES)
+    ckpts, final = fl.ckpts, fl.final
     final_np = np.asarray(final)                 # the ONE round-trip
     HS = H * S
     alive = np.array([final_np[:, h * S:(h + 1) * S].any()
@@ -428,3 +531,18 @@ def walk_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
             col[blk].T > 0.5, blk * B,
             min(B, max(1, R_lens[h] - blk * B)))
     return dead
+
+
+def walk_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
+                       slot_ops: List[np.ndarray], M: int, *,
+                       interpret: bool = False) -> np.ndarray:
+    """Walk H independent return streams in lockstep; returns
+    ``dead[H]`` — per history, the first return index at which its
+    config set emptied, or -1 if linearizable. Exact: capped fast
+    ladder first (sound for "valid"), per-history exact rescue +
+    block-checkpoint refinement on death, identical verdicts and
+    indices to H single-history walks. One-shot form of the
+    :func:`dispatch_returns_batch` / :func:`collect_returns_batch`
+    pair."""
+    return collect_returns_batch(dispatch_returns_batch(
+        P, ret_slots, slot_ops, M, interpret=interpret))

@@ -5,9 +5,12 @@ history per key, runs the inner checker on each sub-history, and merges.
 
 TPU-first difference: per-key sub-histories are an *embarrassingly parallel
 batch dimension* (SURVEY.md §2.4). When the inner checker is
-``linearizable``, all keys that fit the dense engine are checked in ONE
-vmapped device call (:func:`jepsen_tpu.checkers.reach.check_many`) — the
-upstream runs per-key Knossos analyses on a thread pool.
+``linearizable``, all keys that fit the dense engine are checked through
+the batched device engines (:func:`jepsen_tpu.checkers.reach.check_many`
+— by default the bucketed LOCKSTEP lane, where groups of keys advance
+through the walk together, one return index per step, with
+length-bucketed lane packing so a long key never pads the short ones) —
+the upstream runs per-key Knossos analyses on a thread pool.
 
 Generator-side combinators (``sequential_generator``,
 ``concurrent_generator``) live in :mod:`jepsen_tpu.generators`.
@@ -75,21 +78,20 @@ class IndependentChecker(Checker):
                 "failures": failures, "results": results}
 
     def _check_batched(self, test, subs, keys, opts):
-        """One vmapped device call for every key that fits the dense
-        engine; per-key fallback for the rest."""
+        """One batched device dispatch for every key that fits the
+        dense engine (the bucketed lockstep lane by default); per-key
+        fallback for the rest."""
         from jepsen_tpu.checkers import reach
         from jepsen_tpu.checkers.events import ConcurrencyOverflow
         from jepsen_tpu.models.memo import StateExplosion
 
         from jepsen_tpu.checkers.facade import (_REACH_MANY_KW,
-                                                _engine_kw, _model_from)
+                                                _engine_kw, _model_from,
+                                                auto_check_many_packed)
         model = _model_from(self.inner.model, test)
         kw = dict(self.inner.opts)
         if opts:
             kw.update(opts)
-        # _REACH_MANY_KW includes "devices": the key axis IS the
-        # sharded axis, so a user-supplied mesh must reach check_many
-        kw = _engine_kw(kw, _REACH_MANY_KW)
         packs, fits, results = {}, [], {}
         for k in keys:
             try:
@@ -98,6 +100,18 @@ class IndependentChecker(Checker):
             except Exception as e:                      # noqa: BLE001
                 results[k] = {"valid": "unknown",
                               "error": f"{type(e).__name__}: {e}"}
+        if self.inner.algorithm == "auto":
+            # the many-histories auto chain: batched device engines
+            # with the per-history fallback chain built in
+            batch = auto_check_many_packed(model,
+                                           [packs[k] for k in fits], kw)
+            for k, r in zip(fits, batch):
+                results[k] = r
+            return results
+        # explicit "reach": stay on the reach engines only.
+        # _REACH_MANY_KW includes "devices": the key axis IS the
+        # sharded axis, so a user-supplied mesh must reach check_many
+        kw = _engine_kw(kw, _REACH_MANY_KW)
         try:
             batch = reach.check_many(model, [packs[k] for k in fits], **kw)
             for k, r in zip(fits, batch):

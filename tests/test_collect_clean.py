@@ -1,0 +1,22 @@
+"""Tier-1 suite self-check: ``pytest --collect-only`` must report ZERO
+collection errors. A SyntaxError in one imported module once silently
+shrank the suite by an entire test file (``--continue-on-collection-
+errors`` keeps the run green while dropping the file), so the guard
+runs collection in a subprocess and fails loudly on any error."""
+import os
+import subprocess
+import sys
+
+
+def test_collect_only_reports_no_errors():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/", "-q",
+         "--collect-only", "-p", "no:cacheprovider"],
+        cwd=root, capture_output=True, text=True, timeout=240, env=env)
+    tail = (proc.stdout or "")[-3000:] + (proc.stderr or "")[-1500:]
+    # rc 2 = collection interrupted (errors); any nonzero is a failure
+    assert proc.returncode == 0, f"collection not clean:\n{tail}"
+    summary = [ln for ln in (proc.stdout or "").splitlines() if ln][-1]
+    assert "error" not in summary.lower(), tail

@@ -1,0 +1,217 @@
+"""Differential tests for the bucketed-lockstep ``independent`` route
+(ISSUE 1): ragged multi-key batches through ``reach.check_many``'s
+lockstep lane must produce verdicts and dead events bit-identical to
+the per-key sequential path, across mixed key lengths, a single-key
+degenerate batch, and an empty-key history — plus unit coverage of the
+bucket packer's partition and geometry bounds."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from jepsen_tpu import fixtures, independent, models
+from jepsen_tpu.checkers import preproc_native, reach, reach_batch
+from jepsen_tpu.checkers.facade import linearizable
+from jepsen_tpu.history import index as hindex
+from jepsen_tpu.history import pack
+
+needs_native = pytest.mark.skipif(
+    not preproc_native.available(),
+    reason="native preprocessing library unavailable")
+
+
+def _force_lockstep(monkeypatch):
+    """Route check_many's lockstep lane on CPU: pallas gates open,
+    return floor off, batch kernel in interpret mode (the scheduler
+    never passes ``interpret`` itself, so wrapping the dispatch entry
+    forces it everywhere)."""
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    orig = reach_batch.dispatch_returns_batch
+    monkeypatch.setattr(
+        reach_batch, "dispatch_returns_batch",
+        lambda *a, **kw: orig(*a, **{**kw, "interpret": True}))
+
+
+def _ragged_packs(lens, corrupt=(), crash_p=0.0):
+    packs = []
+    for i, n in enumerate(lens):
+        h = fixtures.gen_history("cas", n_ops=n, processes=3,
+                                 seed=1000 + i, crash_p=crash_p)
+        if i in corrupt:
+            h = fixtures.corrupt(h, seed=i)
+        packs.append(pack(h))
+    return packs
+
+
+@needs_native
+def test_ragged_mix_matches_per_key(monkeypatch):
+    """Mixed key lengths spanning several buckets: lockstep verdicts,
+    dead events, and witness ops must be bit-identical to the per-key
+    sequential path."""
+    lens = [220, 30, 90, 250, 45, 60, 150, 35, 40, 70]
+    packs = _ragged_packs(lens, corrupt={0, 6})
+    refs = [reach.check_packed(models.cas_register(), p) for p in packs]
+    _force_lockstep(monkeypatch)
+    # shrink the planner's floor bucket so this small mix genuinely
+    # exercises multi-bucket packing (production floor is the 1024
+    # block — every history here would share one bucket)
+    monkeypatch.setattr(reach_batch, "_adaptive_block",
+                        lambda H, W: 64)
+    diag = {}
+    res = reach.check_many(models.cas_register(), packs, diag=diag)
+    assert all(r["engine"] == "reach-lockstep" for r in res)
+    assert len(diag["groups"]) >= 2          # bucketing actually split
+    assert 0 < diag["pack_efficiency"] <= 1
+    for i, (a, b) in enumerate(zip(res, refs)):
+        assert a["valid"] == b["valid"], f"key {i}"
+        if a["valid"] is False:
+            assert a["dead-event"] == b["dead-event"], f"key {i}"
+            assert a["op"] == b["op"], f"key {i}"
+            assert a.get("final-configs"), f"key {i} missing witness"
+
+
+@needs_native
+def test_crashy_ragged_mix_matches_per_key(monkeypatch):
+    """Crashed (info) ops survive the union-alphabet lockstep route
+    with verdicts identical to the per-key path. (Kept small: crashed
+    ops widen W, and interpret-mode step cost grows with H*W.)"""
+    lens = [60, 35, 45, 50]
+    packs = _ragged_packs(lens, corrupt={2}, crash_p=0.05)
+    refs = [reach.check_packed(models.cas_register(), p) for p in packs]
+    _force_lockstep(monkeypatch)
+    res = reach.check_many(models.cas_register(), packs)
+    assert all(r["engine"] == "reach-lockstep" for r in res)
+    for i, (a, b) in enumerate(zip(res, refs)):
+        assert a["valid"] == b["valid"], f"key {i}"
+        if a["valid"] is False:
+            assert a["dead-event"] == b["dead-event"], f"key {i}"
+
+
+@needs_native
+def test_single_key_degenerate_batch(monkeypatch):
+    """ONE live key: the lockstep lane declines (no batch to win on)
+    and check_many still answers, identically to check_packed."""
+    packs = _ragged_packs([90], corrupt={0})
+    ref = reach.check_packed(models.cas_register(), packs[0])
+    _force_lockstep(monkeypatch)
+    res = reach.check_many(models.cas_register(), packs)
+    assert res[0]["valid"] == ref["valid"] is False
+    assert res[0]["dead-event"] == ref["dead-event"]
+
+
+@needs_native
+def test_empty_key_history_passthrough(monkeypatch):
+    """An empty packed history rides the batch as a trivially-valid
+    entry; live keys still go lockstep with exact verdicts."""
+    packs = _ragged_packs([80, 60, 50], corrupt={1})
+    packs.insert(1, pack([]))
+    refs = [reach.check_packed(models.cas_register(), p)
+            for p in packs]
+    _force_lockstep(monkeypatch)
+    res = reach.check_many(models.cas_register(), packs)
+    assert res[1]["valid"] is True
+    for i, (a, b) in enumerate(zip(res, refs)):
+        assert a["valid"] == b["valid"], f"key {i}"
+    live = [r for i, r in enumerate(res) if i != 1]
+    assert all(r["engine"] == "reach-lockstep" for r in live)
+
+
+@needs_native
+def test_independent_checker_routes_lockstep(monkeypatch):
+    """The full ``independent.checker`` path — split, pack, facade
+    auto chain — lands on the lockstep engine and agrees with the
+    unforced per-key route key for key."""
+    ops = []
+    for k, n in enumerate([60, 25, 40, 80]):
+        h = fixtures.gen_history("cas", n_ops=n, processes=3,
+                                 seed=50 + k)
+        if k == 2:
+            h = fixtures.corrupt(h, seed=k)
+        for op in h:
+            ops.append(op.with_(value=independent.ktuple(k, op.value),
+                                index=-1))
+    hist = hindex(ops)
+    c = independent.checker(linearizable(models.cas_register()))
+    ref = c.check(None, hist)
+    _force_lockstep(monkeypatch)
+    res = c.check(None, hist)
+    assert res["valid"] is ref["valid"] is False
+    assert res["failures"] == ref["failures"] == [2]
+    assert {k: r["valid"] for k, r in res["results"].items()} == \
+           {k: r["valid"] for k, r in ref["results"].items()}
+    assert any(r.get("engine") == "reach-lockstep"
+               for r in res["results"].values())
+
+
+def test_bucket_packer_partition_and_ratio():
+    """plan_buckets returns an exact partition; group sizes respect the
+    lane cap; within a group, effective lengths (above the block floor)
+    stay within one power-of-two octave (max/min < 2)."""
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        n = int(rng.integers(1, 80))
+        lens = [int(x) for x in rng.integers(1, 20_000, size=n)]
+        cap = int(rng.choice([4, 8, 32]))
+        W = int(rng.choice([1, 3, 5, 8]))
+        groups = reach_batch.plan_buckets(lens, W, group=cap)
+        flat = sorted(i for g in groups for i in g)
+        assert flat == list(range(n))            # partition, no dupes
+        floor = reach_batch._adaptive_block(min(cap, n), max(W, 1))
+        for g in groups:
+            assert 1 <= len(g) <= cap
+            eff = [max(lens[i], floor, 1) for i in g]
+            assert max(eff) < 2 * min(eff), (lens, cap, W, g)
+
+
+def test_bucket_packer_geometry_bounds():
+    """Every planned group's dispatch geometry respects the measured
+    chip ceilings: the adaptive block keeps the double-buffered
+    slot_ops SMEM window under budget at the group's width, and the
+    padded step count covers the longest member."""
+    lens = [10_000, 9_000, 5_000, 1_500, 900, 700, 250, 240, 80, 10]
+    for W in (1, 5, 8, 20):
+        groups = reach_batch.plan_buckets(lens, W, group=8)
+        for g in groups:
+            H = len(g)
+            R_max = max(lens[i] for i in g)
+            B, R_pad = reach_batch.group_geom(R_max, H, W)
+            assert (B * H * W * 8 <= reach_batch._SMEM_BUDGET
+                    or B == 32)
+            assert R_pad >= R_max
+
+
+def test_group_diag_accounting():
+    """group_diag's padded/real return accounting is consistent with
+    the packed geometry."""
+    geom = (512, 5, 32, 8, 4, 37, 2048)
+    d = reach_batch.group_diag(geom, [2000, 1500, 1800, 100])
+    assert d["H"] == 4 and d["R_pad"] == 2048
+    assert d["real_returns"] == 5400
+    assert d["padded_returns"] == 4 * 2048
+
+
+@needs_native
+def test_dispatch_collect_matches_one_shot(monkeypatch):
+    """The dispatch/collect split is exactly the one-shot walk: same
+    dead indices on a mixed batch, and the per-geometry kernel cache
+    registers a hit on the second identical dispatch."""
+    model = models.cas_register()
+    packs = _ragged_packs([60, 45, 70], corrupt={1})
+    live = list(range(3))
+    u = reach._union_prep(model, packs, live, 100_000, 20)
+    assert u is not None
+    (_m, _S, P, W, M, ret_flat, ops_flat, _kW, _kR, offsets,
+     *_rest) = u
+    rets = [ret_flat[offsets[k]:offsets[k + 1]] for k in live]
+    ops = [ops_flat[offsets[k]:offsets[k + 1]] for k in live]
+    d1 = reach_batch.walk_returns_batch(P, rets, ops, M,
+                                        interpret=True)
+    before = reach_batch.kernel_cache_info()
+    fl = reach_batch.dispatch_returns_batch(P, rets, ops, M,
+                                            interpret=True)
+    d2 = reach_batch.collect_returns_batch(fl)
+    after = reach_batch.kernel_cache_info()
+    assert list(d1) == list(d2)
+    assert (d1 >= 0).sum() == 1
+    assert after["hits"] > before["hits"]    # same geometry: cache hit

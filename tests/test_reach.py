@@ -343,3 +343,48 @@ class TestSupersetSeeds:
             reach.memo_ops = orig
         assert all(r["valid"] is True for r in res)
         assert len(calls) <= 2, f"{len(calls)} BFS runs for 24 keys"
+
+
+class TestRaisedFromJax:
+    """Classification driving the graceful-fallback/surface-our-bugs
+    split: jax runtime errors keep the fallback even when caught inside
+    a jepsen_tpu frame (the traceback STARTS with our caller frames,
+    which are ABOVE jax, not below); errors raised by our own code
+    while jax traces it must surface."""
+
+    @staticmethod
+    def _shim(body):
+        """A function whose frame reports a jepsen_tpu module name."""
+        g = {"__name__": "jepsen_tpu.checkers._fake_for_test",
+             "body": body}
+        exec("def shim(*a):\n    return body(*a)", g)
+        return g["shim"]
+
+    def test_jax_error_caught_in_repo_frame_keeps_fallback(self):
+        import jax.numpy as jnp
+
+        shim = self._shim(
+            lambda: jnp.dot(jnp.ones((2, 3)), jnp.ones((5, 2))))
+        try:
+            shim()
+        except Exception as e:
+            assert reach._raised_from_jax(e) is True
+        else:
+            pytest.skip("jnp.dot did not raise")
+
+    def test_repo_raise_inside_jax_tracing_surfaces(self):
+        import jax
+
+        def bug(x):
+            raise KeyError("repo bug inside tracing")
+
+        shim = self._shim(bug)
+        with pytest.raises(Exception) as ei:
+            jax.jit(shim)(1.0)
+        assert reach._raised_from_jax(ei.value) is False
+
+    def test_plain_repo_error_is_ours(self):
+        try:
+            raise RuntimeError("nope")
+        except RuntimeError as e:
+            assert reach._raised_from_jax(e) is False

@@ -4,8 +4,6 @@ it backs :func:`reach.check_batch` and the ``cas-100k x 8`` benchmark
 rung). Histories in a batch are independent — verdicts AND dead
 indices must be bit-identical to running the single-history lane walk
 per history."""
-import functools
-
 import numpy as np
 import pytest
 
@@ -111,15 +109,22 @@ def test_batch_rescue_path(monkeypatch):
         assert (dead[k] < 0) == bool(ref["valid"]), f"history {k}"
 
 
+def _force_interpret_dispatch(monkeypatch):
+    """check_batch routes through the dispatch/collect pair (pipelined
+    scheduler); forcing interpret at the dispatch entry covers every
+    group."""
+    orig = reach_batch.dispatch_returns_batch
+    monkeypatch.setattr(
+        reach_batch, "dispatch_returns_batch",
+        lambda *a, **kw: orig(*a, **{**kw, "interpret": True}))
+
+
 def test_check_batch_end_to_end(monkeypatch):
     """Public API: verdicts, witnesses, and dead events identical to
     check_packed; groups split at _BATCH_GROUP; empty histories pass."""
     monkeypatch.setattr(reach, "_use_pallas", lambda: True)
     monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
-    monkeypatch.setattr(
-        reach_batch, "walk_returns_batch",
-        functools.partial(reach_batch.walk_returns_batch,
-                          interpret=True))
+    _force_interpret_dispatch(monkeypatch)
     model = models.cas_register()
     hists = []
     for seed in range(10):
@@ -182,10 +187,7 @@ def test_batch_width_one_tail_group(monkeypatch):
     cross-checked at the kernel level including the dead index."""
     monkeypatch.setattr(reach, "_use_pallas", lambda: True)
     monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
-    monkeypatch.setattr(
-        reach_batch, "walk_returns_batch",
-        functools.partial(reach_batch.walk_returns_batch,
-                          interpret=True))
+    _force_interpret_dispatch(monkeypatch)
     model = models.cas_register()
     hists = [fixtures.gen_history("cas", n_ops=60, processes=3, seed=s)
              for s in range(3)]

@@ -6,7 +6,15 @@ dispatch-slope kernel figure per width so the "step cost is flat in H"
 claim (BASELINE.md round-4 batch rung) can be extended or refuted at
 H=32 without guessing.
 
+``--ragged`` instead sweeps a mixed-length independent-keys batch
+through the bucketed lane packer (``reach_batch.plan_buckets``):
+reports each lockstep group's geometry and pack efficiency (real vs
+padded returns), against the naive single-group packing that pads
+every key to the longest — the quantity the ISSUE-1 bucketing exists
+to fix.
+
 Usage: python tools/batch_width.py [--ops 100000] [--widths 8,16,32]
+       [--ragged] [--keys 12]
 """
 from __future__ import annotations
 
@@ -19,12 +27,84 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def ragged_sweep(total_ops: int, keys: int, repeat: int) -> int:
+    """Bucketed vs naive packing on a ragged independent-keys batch:
+    plan, per-group geometry, pack efficiency, and (when the lockstep
+    lane runs) measured e2e through ``reach.check_many``."""
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import reach, reach_batch
+
+    model = models.cas_register()
+    from bench import _ragged_lengths
+    lens = _ragged_lengths(total_ops, keys=keys)
+    packeds = [fixtures.gen_packed("cas", n_ops=n, seed=100 + i)
+               for i, n in enumerate(lens)]
+    live = list(range(len(packeds)))
+    u = reach._union_prep(model, packeds, live, 100_000, 20)
+    if u is None:
+        print(json.dumps({"error": "union prep failed"}))
+        return 1
+    (_memo_u, _S_pad, _P, W, _M, _ret_flat, _ops_flat, _key_W, key_R,
+     *_rest) = u
+    R_lens = [int(r) for r in key_R]
+    groups = reach_batch.plan_buckets(R_lens, W)
+
+    def _padded(groups_):
+        tot = 0
+        for g in groups_:
+            H = len(g)
+            _B, R_pad = reach_batch.group_geom(
+                max(R_lens[k] for k in g), H, W)
+            tot += H * R_pad
+        return tot
+
+    real = sum(R_lens)
+    bucketed = _padded(groups)
+    naive = _padded([live])             # one group, longest pads all
+    plan = {
+        "keys": keys, "lens": lens, "W": W,
+        "groups": [[R_lens[k] for k in g] for g in groups],
+        "real_returns": real,
+        "bucketed_padded": bucketed,
+        "naive_padded": naive,
+        "bucketed_efficiency": round(real / max(bucketed, 1), 4),
+        "naive_efficiency": round(real / max(naive, 1), 4),
+    }
+    print(json.dumps(plan), flush=True)
+    diag: dict = {}
+    res = reach.check_many(model, packeds, diag=diag)   # warm
+    engines = sorted({r["engine"] for r in res})
+    times = []
+    for _ in range(max(1, repeat)):
+        t0 = time.monotonic()
+        reach.check_many(model, packeds)
+        times.append(time.monotonic() - t0)
+    best = min(times)
+    total = sum(lens)       # actual generated ops (per-key floor can
+    print(json.dumps({      # push the sum past the requested total)
+        "engine": engines, "e2e_s": round(best, 3),
+        "agg_ops_s": round(total / best),
+        "pack_efficiency": diag.get("pack_efficiency"),
+        "kernel_cache": diag.get("kernel_cache"),
+        "per_bucket": diag.get("groups", []),
+    }), flush=True)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", type=int, default=100_000)
     ap.add_argument("--widths", default="8,16,32")
     ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--ragged", action="store_true",
+                    help="sweep a mixed-length key batch through the "
+                         "bucketed lane packer instead of the uniform "
+                         "width ladder")
+    ap.add_argument("--keys", type=int, default=12,
+                    help="key count for --ragged")
     args = ap.parse_args()
+    if args.ragged:
+        return ragged_sweep(args.ops, args.keys, args.repeat)
     widths = [int(w) for w in args.widths.split(",")]
     H_max = max(widths)
 

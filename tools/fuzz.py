@@ -265,6 +265,23 @@ def run_many(n: int, seed: int, *, pallas: bool = False,
     return mismatches, invalid_seen
 
 
+def _seq_reach(model, packed):
+    """Sequential dense-walk reference with chunklock disabled,
+    preserving any operator-set ``JEPSEN_TPU_NO_CHUNKLOCK`` value
+    (unconditionally deleting it mid-run clobbered the operator's
+    setting for every later trial)."""
+    prev = os.environ.get("JEPSEN_TPU_NO_CHUNKLOCK")
+    os.environ["JEPSEN_TPU_NO_CHUNKLOCK"] = "1"
+    try:
+        from jepsen_tpu.checkers import reach
+        return reach.check_packed(model, packed)
+    finally:
+        if prev is None:
+            os.environ.pop("JEPSEN_TPU_NO_CHUNKLOCK", None)
+        else:
+            os.environ["JEPSEN_TPU_NO_CHUNKLOCK"] = prev
+
+
 def chunklock_trials(k: int, seed: int) -> list:
     """Real-chip chunk-lockstep differential: ``k`` engine-scale
     histories (the routing floor is 32768 returns, so these run the
@@ -309,15 +326,17 @@ def chunklock_trials(k: int, seed: int) -> list:
             # different unlinearizable op than first-empty-return)
             entry["wgl-native"] = ref["valid"]
             ok = res["valid"] == ref["valid"]
+        elif res["valid"] is True:
+            # no C++ engine built: True verdicts previously went
+            # entirely unreferenced — cross-check them against the
+            # sequential dense walk instead
+            seq = _seq_reach(model, packed)
+            entry["reach"] = seq["valid"]
+            ok = seq["valid"] is True
         if ok and res["valid"] is False:
             # dead-event must be BIT-IDENTICAL to the sequential
             # dense walk (same first-empty-return semantics)
-            os.environ["JEPSEN_TPU_NO_CHUNKLOCK"] = "1"
-            try:
-                from jepsen_tpu.checkers import reach
-                seq = reach.check_packed(model, packed)
-            finally:
-                del os.environ["JEPSEN_TPU_NO_CHUNKLOCK"]
+            seq = _seq_reach(model, packed)
             entry["reach"] = seq["valid"]
             ok = (seq["valid"] is False
                   and res.get("dead-event") == seq.get("dead-event"))
