@@ -13,7 +13,9 @@ exactly like ``jepsen.checker/check-safe``.
 """
 from __future__ import annotations
 
+import logging
 import threading
+import traceback as _traceback
 from collections import Counter as _Counter
 from dataclasses import dataclass, field
 from typing import Any, Dict, Mapping, Optional, Sequence
@@ -21,6 +23,7 @@ from typing import Any, Dict, Mapping, Optional, Sequence
 import numpy as np
 
 from jepsen_tpu import history as h
+from jepsen_tpu import obs
 from jepsen_tpu.models import Model
 from jepsen_tpu.op import FAIL, INFO, INVOKE, OK, Op
 from jepsen_tpu.util import hashable
@@ -40,11 +43,24 @@ def check_safe(checker: Checker, test: Optional[Mapping],
                history: Sequence[Op],
                opts: Optional[Mapping] = None) -> Dict[str, Any]:
     """Run a checker, turning exceptions into ``{"valid": "unknown"}``
-    (upstream ``jepsen.checker/check-safe``)."""
+    (upstream ``jepsen.checker/check-safe``) — but never silently: the
+    full traceback is logged at warning, returned under a
+    ``"traceback"`` key, and recorded in the ``obs`` ledger/counters
+    (``checker.swallowed.<name>.<exception>``) so a crashing checker is
+    visible to tests and the fuzzer."""
     try:
         return checker.check(test, history, opts)
     except Exception as e:                              # noqa: BLE001
-        return {"valid": "unknown", "error": f"{type(e).__name__}: {e}"}
+        name = getattr(checker, "name", type(checker).__name__)
+        tb = _traceback.format_exc()
+        logging.getLogger("jepsen.checker").warning(
+            "checker %s crashed (returning unknown): %s", name, e,
+            exc_info=e)
+        obs.checker_swallowed(name, type(e).__name__,
+                              ops=len(history))
+        return {"valid": "unknown",
+                "error": f"{type(e).__name__}: {e}",
+                "traceback": tb}
 
 
 def _model_from(model: Optional[Model], test: Optional[Mapping]) -> Model:
@@ -164,9 +180,15 @@ class Linearizable(Checker):
                     res = decompose.check_packed(
                         model, packed, **_engine_kw(kw, _DECOMPOSE_KW))
                     if res is not None:
+                        obs.engine_selected(
+                            res.get("engine", "decompose"),
+                            ops=packed.n, valid=res.get("valid"))
                         return res
-                except Exception:                       # noqa: BLE001
-                    pass            # fall through to the monolithic chain
+                except Exception as e:                  # noqa: BLE001
+                    # fall through to the monolithic chain — recorded,
+                    # not silent
+                    obs.engine_fallback("decompose", type(e).__name__,
+                                        ops=packed.n)
             return auto_check_packed(model, packed, kw)
         if algorithm == "competition":
             return _competition(model, history, kw)
@@ -185,12 +207,37 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
     so a history that times out in every stage costs ~1× the configured
     limit, not 1× per stage. (The dense first stage is bounded by
     structure — ``max_dense``/``max_states`` — not wall-clock, and runs
-    before the budget is consulted.)"""
+    before the budget is consulted.)
+
+    Every stage transition lands in the :mod:`jepsen_tpu.obs`
+    engine-decision ledger: exactly ONE ``"selected"`` record per call
+    (the engine that produced the verdict) and one ``"fallback"``
+    record per abandoned stage, with the exception class, the history
+    geometry, and the stage's elapsed time — so ``obs.capture()`` can
+    assert "no silent fallback occurred"."""
     import time as _time
 
     from jepsen_tpu.checkers import frontier, reach, wgl_native, wgl_ref
     from jepsen_tpu.checkers.events import ConcurrencyOverflow
     from jepsen_tpu.models.memo import StateExplosion
+
+    geom = {"ops": packed.n, "ok-ops": packed.n_ok}
+    t_stage = _time.monotonic()
+
+    def _selected(res: Dict[str, Any], default_stage: str
+                  ) -> Dict[str, Any]:
+        obs.engine_selected(res.get("engine", default_stage), **geom,
+                            valid=res.get("valid"),
+                            elapsed_s=round(_time.monotonic() - t_stage,
+                                            6))
+        return res
+
+    def _fellback(stage: str, cause: str) -> None:
+        nonlocal t_stage
+        obs.engine_fallback(stage, cause, **geom,
+                            elapsed_s=round(_time.monotonic() - t_stage,
+                                            6))
+        t_stage = _time.monotonic()
 
     tl = kw.get("time_limit")
     deadline = _time.monotonic() + tl if tl else None
@@ -219,33 +266,52 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
         # dispatches in bounded segments and turns "unknown" when
         # the deadline passes (round-2 advisor finding)
         ekw = _with_deadline_abort(_engine_kw(kw, _REACH_KW))
-        res = reach.check_packed(model, packed, **ekw)
+        with obs.span("facade.reach", **geom):
+            res = reach.check_packed(model, packed, **ekw)
         if res.get("valid") in (True, False):
-            return res
-    except (reach.DenseOverflow, StateExplosion):
+            return _selected(res, "reach")
+        _fellback("reach", f"unknown:{res.get('cause', '?')}")
+    except (reach.DenseOverflow, StateExplosion) as e:
         exploded = True
-    except ConcurrencyOverflow:
-        pass
+        _fellback("reach", type(e).__name__)
+    except ConcurrencyOverflow as e:
+        _fellback("reach", type(e).__name__)
+    if not wgl_native.available() and not _spent():
+        # a whole stage silently missing from a degraded install is
+        # exactly what the ledger must catch: record the skip (event
+        # "skipped", distinct from "fallback" — the chain is intact,
+        # the INSTALL is degraded)
+        obs.count("engine.skipped.wgl-native.unavailable")
+        obs.decision("wgl-native", "skipped", cause="unavailable",
+                     **geom)
     if wgl_native.available() and not _spent():
         try:
-            res = wgl_native.check_packed(
-                model, packed, **_budgeted(_engine_kw(kw, _NATIVE_KW)))
+            with obs.span("facade.wgl-native", **geom):
+                res = wgl_native.check_packed(
+                    model, packed,
+                    **_budgeted(_engine_kw(kw, _NATIVE_KW)))
             if res.get("valid") in (True, False):
                 res["engine"] = "wgl-native-fallback"
-                return res
-        except StateExplosion:
+                return _selected(res, "wgl-native-fallback")
+            _fellback("wgl-native", f"unknown:{res.get('cause', '?')}")
+        except StateExplosion as e:
             exploded = True         # un-memoizable / product blow-up
+            _fellback("wgl-native", type(e).__name__)
     if not _spent():
         try:
             # the frontier engine's crashed-op quotient can survive
             # crash-heavy histories that explode the exact C++ search
-            res = frontier.check_packed(
-                model, packed, **_budgeted(_engine_kw(kw, _FRONTIER_KW)))
+            with obs.span("facade.frontier", **geom):
+                res = frontier.check_packed(
+                    model, packed,
+                    **_budgeted(_engine_kw(kw, _FRONTIER_KW)))
             if res.get("valid") in (True, False):
                 res["engine"] = "frontier-fallback"
-                return res
-        except Exception:                               # noqa: BLE001
-            pass            # overflow or device failure: Python path next
+                return _selected(res, "frontier-fallback")
+            _fellback("frontier", f"unknown:{res.get('cause', '?')}")
+        except Exception as e:                          # noqa: BLE001
+            # overflow or device failure: Python path next
+            _fellback("frontier", type(e).__name__)
     from jepsen_tpu import models as _models
     if isinstance(model, _models.MultiRegister):
         # multi-key TRANSACTIONAL histories on an exploding product
@@ -261,10 +327,11 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
                     model, packed,
                     **_with_deadline_abort(_engine_kw(kw, _REACH_KW)))
                 if rp is not None and rp.get("valid") in (True, False):
-                    return rp
+                    return _selected(rp, "restricted-product")
             except (StateExplosion, reach.DenseOverflow,
-                    ConcurrencyOverflow):
-                pass        # restricted space exploded too: screen next
+                    ConcurrencyOverflow) as e:
+                # restricted space exploded too: screen next
+                _fellback("restricted-product", type(e).__name__)
         # then the sound per-key projection screen — an invalid
         # projection proves non-linearizability outright; all-valid
         # projections yield an explicit "unknown + reason" instead of
@@ -274,18 +341,21 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
             tx = decompose.check_transactional(
                 model, packed,
                 **_budgeted(_engine_kw(kw, _DECOMPOSE_KW)))
-        except Exception:                               # noqa: BLE001
+        except Exception as e:                          # noqa: BLE001
             tx = None
+            _fellback("transactional-screen", type(e).__name__)
         if tx is not None and (tx.get("valid") is False or exploded
                                or _spent()):
-            return tx
+            return _selected(tx, "transactional-screen")
     if _spent():
+        obs.decision("auto-chain", "timeout", **geom)
         return {"valid": "unknown", "cause": "timeout",
                 "engine": "auto-chain"}
-    res = wgl_ref.check_packed(model, packed,
-                               **_budgeted(_engine_kw(kw, _WGL_KW)))
+    with obs.span("facade.wgl-cpu", **geom):
+        res = wgl_ref.check_packed(model, packed,
+                                   **_budgeted(_engine_kw(kw, _WGL_KW)))
     res["engine"] = "wgl-cpu-fallback"
-    return res
+    return _selected(res, "wgl-cpu-fallback")
 
 
 def auto_check_many_packed(model: Model, packed_list,
@@ -299,17 +369,22 @@ def auto_check_many_packed(model: Model, packed_list,
     route cannot hold every history (dense/union overflow, or a
     too-concurrent key). Mirrors how :func:`auto_check_packed` is the
     one-history chain; results align with ``packed_list``."""
-    import logging
-
     from jepsen_tpu.checkers import reach
     from jepsen_tpu.checkers.events import ConcurrencyOverflow
     from jepsen_tpu.models.memo import StateExplosion
 
     try:
-        return reach.check_many(model, packed_list,
-                                **_engine_kw(kw, _REACH_MANY_KW))
-    except (reach.DenseOverflow, ConcurrencyOverflow, StateExplosion):
-        pass
+        with obs.span("facade.check-many", histories=len(packed_list)):
+            out = reach.check_many(model, packed_list,
+                                   **_engine_kw(kw, _REACH_MANY_KW))
+        obs.engine_selected("reach-many", histories=len(packed_list),
+                            engines=sorted({r.get("engine", "?")
+                                            for r in out}))
+        return out
+    except (reach.DenseOverflow, ConcurrencyOverflow,
+            StateExplosion) as e:
+        obs.engine_fallback("reach-many", type(e).__name__,
+                            histories=len(packed_list))
     except Exception as e:                              # noqa: BLE001
         # jax/XLA runtime failures keep the graceful per-history
         # fallback (traceback preserved); our own bugs must surface
@@ -318,6 +393,8 @@ def auto_check_many_packed(model: Model, packed_list,
         logging.getLogger("jepsen.reach").warning(
             "batched many-history check failed (%r); falling back to "
             "per-history checking", e, exc_info=e)
+        obs.engine_fallback("reach-many", type(e).__name__,
+                            histories=len(packed_list), jax=True)
     out = []
     for p in packed_list:
         try:
@@ -325,6 +402,8 @@ def auto_check_many_packed(model: Model, packed_list,
         except Exception as e:                          # noqa: BLE001
             # check-safe semantics: one pathological history yields an
             # "unknown", not a crashed batch
+            obs.checker_swallowed("auto-chain", type(e).__name__,
+                                  ops=p.n)
             out.append({"valid": "unknown",
                         "error": f"{type(e).__name__}: {e}"})
     return out
@@ -418,10 +497,16 @@ def _competition(model: Model, history: Sequence[Op],
             verdicts.put(("frontier", {"valid": "unknown",
                                        "error": str(e)}))
 
-    threads = [threading.Thread(target=run_cpu, daemon=True),
-               threading.Thread(target=run_tpu, daemon=True),
-               threading.Thread(target=run_linear, daemon=True),
-               threading.Thread(target=run_frontier, daemon=True)]
+    import contextvars
+
+    def _ctx_target(fn):
+        # each racer runs under a copy of the caller's context so spans
+        # and ledger records reach any active obs.capture()
+        ctx = contextvars.copy_context()
+        return lambda: ctx.run(fn)
+
+    threads = [threading.Thread(target=_ctx_target(fn), daemon=True)
+               for fn in (run_cpu, run_tpu, run_linear, run_frontier)]
     for t in threads:
         t.start()
     winner: Optional[Dict[str, Any]] = None

@@ -860,7 +860,13 @@ class OnlineLinearizable:
     # -- thread lifecycle ----------------------------------------------------
 
     def start(self) -> "OnlineLinearizable":
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        import contextvars
+
+        # run under a copy of the starter's context so obs records from
+        # monitor flushes reach the enclosing run's capture scope
+        ctx = contextvars.copy_context()
+        self._thread = threading.Thread(target=lambda: ctx.run(self._loop),
+                                        daemon=True,
                                         name="jepsen-online-check")
         self._thread.start()
         return self

@@ -58,6 +58,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from jepsen_tpu import history as h
+from jepsen_tpu import obs
 from jepsen_tpu.checkers import events as ev
 from jepsen_tpu.models import Model
 from jepsen_tpu.models.memo import (
@@ -385,13 +386,24 @@ def _fetch(x) -> np.ndarray:
 
 
 @functools.cache
-def _warn_pallas_failed(err: str) -> None:
+def _warn_pallas_failed_once(err: str) -> None:
     """Surface each distinct Pallas failure once — a permanent kernel
     breakage silently degrading every check to the slower XLA walk should
     not be invisible."""
     logging.getLogger("jepsen.reach").warning(
         "pallas returns-walk failed (%s); falling back to the XLA walk",
         err)
+
+
+def _warn_pallas_failed(err: str) -> None:
+    """Every Pallas → fallback degradation bumps
+    ``reach.pallas_fallback`` and lands in the obs ledger (the log
+    line stays once-per-distinct-error); fuzz/soak summaries and the
+    bench ``obs`` sub-object surface the counter, so a kernel breakage
+    that silently costs throughput is visible without log greps."""
+    obs.count("reach.pallas_fallback")
+    obs.decision("pallas", "fallback", cause=err[:200])
+    _warn_pallas_failed_once(err)
 
 
 @functools.cache
@@ -848,9 +860,10 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     if packed.n == 0 or packed.n_ok == 0:
         return {"valid": True, "engine": "reach", "events": 0,
                 "time-s": 0.0}
-    memo, stream, T, S_pad, M = _prep(
-        model, packed, max_states=max_states, max_slots=max_slots,
-        max_dense=max_dense, memo=memo)
+    with obs.span("reach.prep", ops=packed.n):
+        memo, stream, T, S_pad, M = _prep(
+            model, packed, max_states=max_states, max_slots=max_slots,
+            max_dense=max_dense, memo=memo)
     W = max(stream.W, 1)
     if _fast_ok(S_pad, W, M, memo.n_ops):
         rs = ev.returns_view(stream)
@@ -864,8 +877,10 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
             from jepsen_tpu.checkers import reach_chunklock as rcl
             if rcl.enabled() and rcl.admits(S_pad, M, W, rs.n_returns):
                 try:
-                    dead, diag = rcl.walk_chunklock(
-                        P_np, rs.ret_slot, rs.slot_ops, M)
+                    with obs.span("reach.walk", engine="reach-chunklock",
+                                  returns=int(rs.n_returns)):
+                        dead, diag = rcl.walk_chunklock(
+                            P_np, rs.ret_slot, rs.slot_ops, M)
                     elapsed = _time.monotonic() - t0
                     if dead < 0:
                         out = _result_valid("reach-chunklock", stream,
@@ -891,9 +906,11 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                 # third-generation kernel: exact gate-ladder walk (for
                 # W > 5, a sound 5-pass-capped walk with an exact
                 # rescue on death)
-                dead, _ = reach_lane.walk_returns(
-                    P_np, rs.ret_slot, rs.slot_ops, R0_np, fetch_R=False,
-                    should_abort=should_abort)
+                with obs.span("reach.walk", engine="reach-pallas",
+                              returns=int(rs.n_returns)):
+                    dead, _ = reach_lane.walk_returns(
+                        P_np, rs.ret_slot, rs.slot_ops, R0_np,
+                        fetch_R=False, should_abort=should_abort)
             except reach_lane.Aborted:
                 return dict(_ABORTED)
             except Exception as e:                      # noqa: BLE001
@@ -941,9 +958,11 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                     break
                 base += seg
         else:
-            ptr, _, alive, R_block = _jitted_walk_returns()(
-                P, xc, bm, jnp.asarray(rs.ret_slot),
-                jnp.asarray(rs.slot_ops), R0)
+            with obs.span("reach.walk", engine="reach",
+                          returns=int(rs.n_returns)):
+                ptr, _, alive, R_block = _jitted_walk_returns()(
+                    P, xc, bm, jnp.asarray(rs.ret_slot),
+                    jnp.asarray(rs.slot_ops), R0)
         elapsed = _time.monotonic() - t0
         if bool(alive):
             return _result_valid("reach", stream, memo, elapsed)
@@ -957,9 +976,12 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         return out
     R0 = jnp.zeros((S_pad, M), jnp.bool_).at[0, 0].set(True)
     slot_op0 = jnp.full((W,), -1, jnp.int32)
-    ptr, _, alive = _jitted_walk()(
-        jnp.asarray(T), jnp.asarray(stream.kind), jnp.asarray(stream.slot),
-        jnp.asarray(stream.opid), R0, slot_op0)
+    with obs.span("reach.walk", engine="reach-events",
+                  events=int(stream.n_events)):
+        ptr, _, alive = _jitted_walk()(
+            jnp.asarray(T), jnp.asarray(stream.kind),
+            jnp.asarray(stream.slot), jnp.asarray(stream.opid), R0,
+            slot_op0)
     elapsed = _time.monotonic() - t0
     if bool(alive):
         return _result_valid("reach", stream, memo, elapsed)
@@ -1214,6 +1236,8 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
             logging.getLogger("jepsen.reach").warning(
                 "sharded history batch failed (%r); falling back to "
                 "the single-device path", e)
+            obs.engine_fallback("reach-batch-mesh", type(e).__name__,
+                                histories=len(packed_list))
         except Exception as e:                          # noqa: BLE001
             # jax/XLA runtime failures (mesh shape, compile, OOM) keep
             # the graceful fallback; genuine programming errors
@@ -1227,6 +1251,8 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
             logging.getLogger("jepsen.reach").warning(
                 "sharded history batch failed (%r); falling back to "
                 "the single-device path", e, exc_info=e)
+            obs.engine_fallback("reach-batch-mesh", type(e).__name__,
+                                histories=len(packed_list), jax=True)
     t0 = _time.monotonic()
     results: List[Optional[Dict[str, Any]]] = [
         {"valid": True, "engine": "reach-lockstep", "events": 0,
@@ -1240,6 +1266,10 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
     if _use_pallas() and preproc_native.available() and len(live) >= 2:
         u = _union_prep(model, packed_list, live, max_states, max_slots)
     if u is None:
+        # the ISSUE-named silent degradation point: the lockstep batch
+        # quietly became H sequential per-history checks
+        obs.engine_fallback("reach-lockstep", "no-union-prep",
+                            histories=len(live))
         for i in live:
             results[i] = check_packed(model, packed_list[i],
                                       max_states=max_states,
@@ -1260,6 +1290,8 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
             P, ret_flat, ops_flat, offsets, groups, M, len(live), diag)
     except Exception as e:                              # noqa: BLE001
         _warn_pallas_failed(repr(e))
+        obs.engine_fallback("reach-lockstep", type(e).__name__,
+                            histories=len(live))
         for i in live:
             results[i] = check_packed(model, packed_list[i],
                                       max_states=max_states,
@@ -1272,10 +1304,28 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
                           max_dense)
 
 
+def _union_prep_shared(model: Model, packed_list, live,
+                       max_states: int, max_slots: int,
+                       u_box: Optional[dict]):
+    """One :func:`_union_prep` per ``check_many`` call: the lockstep
+    and keyed lanes take identical ``(live, max_states, max_slots,
+    need_pallas=True)`` preps, so when the first lane declines (or its
+    kernel fails) the second must not pay the union-alphabet BFS +
+    native build again (~2 s of host time at 4096 keys). ``u_box``
+    caches the result — including a failed (None) prep."""
+    if u_box is not None and "u" in u_box:
+        return u_box["u"]
+    u = _union_prep(model, packed_list, live, max_states, max_slots)
+    if u_box is not None:
+        u_box["u"] = u
+    return u
+
+
 def _check_many_native(model: Model,
                        packed_list: Sequence[h.PackedHistory],
                        max_states: int, max_slots: int, max_dense: int,
-                       t0: float) -> Optional[List[Dict[str, Any]]]:
+                       t0: float, u_box: Optional[dict] = None
+                       ) -> Optional[List[Dict[str, Any]]]:
     """Uniform-workload fast lane for :func:`check_many`: ONE union
     memo + ONE batched native preprocessing call
     (``preproc_native.build_keyed``) replace the per-key
@@ -1297,7 +1347,8 @@ def _check_many_native(model: Model,
     total_returns = sum(packed_list[i].n_ok for i in live)
     if not live or total_returns < _PALLAS_MIN_RETURNS:
         return None
-    u = _union_prep(model, packed_list, live, max_states, max_slots)
+    u = _union_prep_shared(model, packed_list, live, max_states,
+                           max_slots, u_box)
     if u is None:
         return None
     (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
@@ -1414,29 +1465,41 @@ def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
     def _drain(limit: int) -> None:
         while len(inflight) > limit:
             g0, fl0 = inflight.pop(0)
-            dead[np.asarray(g0, np.int64)] = \
-                reach_batch.collect_returns_batch(fl0)
+            with obs.span("lockstep.collect", lanes=len(g0)):
+                dead[np.asarray(g0, np.int64)] = \
+                    reach_batch.collect_returns_batch(fl0)
 
+    gdiags: List[dict] = []
     for g in groups:
-        fl = reach_batch.dispatch_returns_batch(
-            P,
-            [ret_flat[offsets[k]:offsets[k + 1]] for k in g],
-            [ops_flat[offsets[k]:offsets[k + 1]] for k in g],
-            M)
-        if diag is not None:
-            diag.setdefault("groups", []).append(
-                reach_batch.group_diag(fl.geom, fl.R_lens))
+        with obs.span("lockstep.dispatch", lanes=len(g)):
+            fl = reach_batch.dispatch_returns_batch(
+                P,
+                [ret_flat[offsets[k]:offsets[k + 1]] for k in g],
+                [ops_flat[offsets[k]:offsets[k + 1]] for k in g],
+                M)
+        gdiags.append(reach_batch.group_diag(fl.geom, fl.R_lens))
         inflight.append((g, fl))
         _drain(_LOCKSTEP_PIPE_DEPTH)
     _drain(0)
+    real = sum(d["real_returns"] for d in gdiags)
+    padded = sum(d["padded_returns"] for d in gdiags)
+    cache = reach_batch.kernel_cache_info()
+    # bucket pack efficiency and kernel-cache counters flow to obs on
+    # EVERY dispatch (cache counters are cumulative, so gauges), not
+    # only when a caller passes a diag dict
+    obs.count("lockstep.groups", len(gdiags))
+    obs.count("lockstep.real_returns", real)
+    obs.count("lockstep.padded_returns", padded)
+    obs.gauge("lockstep.pack_efficiency", round(real / max(padded, 1), 4))
+    obs.gauge("lockstep.kernel_cache.hits", cache["hits"])
+    obs.gauge("lockstep.kernel_cache.misses", cache["misses"])
+    obs.gauge("lockstep.kernel_cache.entries", cache["entries"])
     if diag is not None:
-        gs = diag.get("groups", [])
-        real = sum(d["real_returns"] for d in gs)
-        padded = sum(d["padded_returns"] for d in gs)
+        diag["groups"] = gdiags
         diag["real_returns"] = real
         diag["padded_returns"] = padded
         diag["pack_efficiency"] = round(real / max(padded, 1), 4)
-        diag["kernel_cache"] = reach_batch.kernel_cache_info()
+        diag["kernel_cache"] = cache
     return dead
 
 
@@ -1445,7 +1508,8 @@ def _check_many_lockstep(model: Model,
                          max_states: int, max_slots: int,
                          max_dense: int, t0: float,
                          group: int = 0,
-                         diag: Optional[dict] = None
+                         diag: Optional[dict] = None,
+                         u_box: Optional[dict] = None
                          ) -> Optional[List[Dict[str, Any]]]:
     """Bucketed-lockstep fast lane for :func:`check_many` — the
     production path for ragged ``independent`` batches: ONE union
@@ -1468,7 +1532,8 @@ def _check_many_lockstep(model: Model,
         return None
     if sum(packed_list[i].n_ok for i in live) < _PALLAS_MIN_RETURNS:
         return None
-    u = _union_prep(model, packed_list, live, max_states, max_slots)
+    u = _union_prep_shared(model, packed_list, live, max_states,
+                           max_slots, u_box)
     if u is None:
         return None
     from jepsen_tpu.checkers import reach_batch
@@ -1613,24 +1678,34 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
         return [{"valid": "unknown", "cause": "aborted",
                  "engine": "reach-batch"} for _ in packed_list]
     if devices is None or len(devices) <= 1:
+        u_box: dict = {}        # one union prep shared by both lanes
         out = _check_many_lockstep(model, packed_list,
                                    max_states=max_states,
                                    max_slots=max_slots,
                                    max_dense=max_dense, t0=t0,
-                                   diag=diag)
+                                   diag=diag, u_box=u_box)
         if out is not None:
+            obs.decision("reach-many", "route", cause="lockstep",
+                         histories=len(packed_list))
             return out
         out = _check_many_native(model, packed_list,
                                  max_states=max_states,
                                  max_slots=max_slots,
-                                 max_dense=max_dense, t0=t0)
+                                 max_dense=max_dense, t0=t0,
+                                 u_box=u_box)
         if out is not None:
+            obs.decision("reach-many", "route", cause="keyed",
+                         histories=len(packed_list))
             return out
     else:
         out = _check_many_mesh_native(model, packed_list, max_states,
                                       max_slots, max_dense, devices, t0)
         if out is not None:
+            obs.decision("reach-many", "route", cause="mesh-union",
+                         histories=len(packed_list))
             return out
+    obs.decision("reach-many", "route", cause="vmapped-xla",
+                 histories=len(packed_list))
     _seed_union_memo(model, [p for p in packed_list
                              if p.n and p.n_ok], max_states)
     preps = []

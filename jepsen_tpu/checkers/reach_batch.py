@@ -49,6 +49,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from jepsen_tpu import obs
 from jepsen_tpu.checkers.reach_lane import (_BLOCK, _FAST_PASSES,
                                             _idx_dtype, _refine_dead)
 
@@ -425,6 +426,8 @@ def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
         dsegs["dP"] = jnp.asarray(P, dtype=cdt)
         dsegs["dR0"] = jnp.asarray(R0, dtype=cdt)
         dsegs["segs"] = []
+        obs.count("lockstep.transfer_bytes",
+                  sum(int(a.nbytes) for a in host_args))
     R_cur = dsegs["dR0"]
     ckpts = []
     HW = H * W
@@ -504,6 +507,7 @@ def collect_returns_batch(fl: BatchInflight) -> np.ndarray:
     if not alive.all() and n_fast < W:
         # capped-ladder deaths may be false: decide with the exact
         # W-pass walk (reuses the uploaded device segments)
+        obs.count("lockstep.exact_rescue")
         ckpts, final = _pipe_walk_b(host_args, geom, W, interpret,
                                     dsegs)
         final_np = np.asarray(final)

@@ -24,12 +24,15 @@ The history is appended under a lock and (crash-safely) streamed to
 """
 from __future__ import annotations
 
+import contextvars
+import json
 import logging
 import threading
 import time as _time
 from typing import Any, Dict, List, Mapping, Optional
 
 from jepsen_tpu import db as db_mod
+from jepsen_tpu import obs
 from jepsen_tpu import os_setup
 from jepsen_tpu.checkers.facade import check_safe
 from jepsen_tpu.client import Client
@@ -52,7 +55,6 @@ class History:
         self._observer = observer
 
     def append(self, op: Op) -> Op:
-        import json
         with self._lock:
             op = op.with_(index=len(self._ops))
             self._ops.append(op)
@@ -90,7 +92,11 @@ class _Worker:
         self.process: Any = wid             # logical process, bumps on crash
         self.generator = generator
         self.client: Optional[Client] = None
-        self.thread = threading.Thread(target=self._loop, daemon=True,
+        # run under a copy of the spawning thread's context so obs
+        # spans recorded by the worker reach the run's capture scope
+        ctx = contextvars.copy_context()
+        self.thread = threading.Thread(target=lambda: ctx.run(self._loop),
+                                       daemon=True,
                                        name=f"jepsen-worker-{wid}")
 
     # -- client lifecycle ----------------------------------------------------
@@ -117,6 +123,11 @@ class _Worker:
 
     # -- op loop -------------------------------------------------------------
     def _loop(self) -> None:
+        name = "run.nemesis" if self.process == NEMESIS else "run.worker"
+        with obs.span(name, wid=self.wid):
+            self._loop_inner()
+
+    def _loop_inner(self) -> None:
         test, run = self.test, self.run
         try:
             self.client = self._open_client()
@@ -235,7 +246,18 @@ def _normalize(test: Mapping) -> Dict[str, Any]:
 def run(test: Mapping) -> Dict[str, Any]:
     """Run a complete test (upstream ``jepsen.core/run!``). Returns the
     test map extended with ``"history"``, ``"results"``, ``"start-time"``,
-    and ``"dir"`` (when stored)."""
+    and ``"dir"`` (when stored).
+
+    The whole run executes inside an :func:`jepsen_tpu.obs.capture`
+    scope with per-phase spans (setup / workers / teardown / check /
+    store); ``results["obs"]`` carries the run's counters + engine
+    ledger, and stored runs persist ``obs.jsonl`` + ``trace.json``
+    next to the history (:func:`jepsen_tpu.store.save_obs`)."""
+    with obs.capture() as obs_cap:
+        return _run_captured(test, obs_cap)
+
+
+def _run_captured(test: Mapping, obs_cap) -> Dict[str, Any]:
     from jepsen_tpu import store as store_mod
 
     test = _normalize(test)
@@ -281,8 +303,9 @@ def run(test: Mapping) -> Dict[str, Any]:
         online.start()
 
     try:
-        os_setup.setup_all(test)
-        db_mod.setup_all(test)
+        with obs.span("run.setup", test=str(test.get("name"))):
+            os_setup.setup_all(test)
+            db_mod.setup_all(test)
 
         # workers -------------------------------------------------------------
         generator = gen(test.get("generator"))
@@ -295,49 +318,55 @@ def run(test: Mapping) -> Dict[str, Any]:
             nem_worker = _Worker(test, run_state, 0, generator)
             nem_worker.process = NEMESIS
             nem_worker.client = None
+            nem_ctx = contextvars.copy_context()
             nem_worker.thread = threading.Thread(
-                target=nem_worker._loop, daemon=True, name="jepsen-nemesis")
+                target=lambda: nem_ctx.run(nem_worker._loop),
+                daemon=True, name="jepsen-nemesis")
             # the nemesis IS its own client
             nem_worker._open_client = lambda: nemesis     # type: ignore
             nem_worker._close_client = lambda: None       # type: ignore
         run_state.active = set(range(n)) | ({NEMESIS} if nem_worker else set())
 
-        for w in workers:
-            w.thread.start()
-        if nem_worker:
-            nem_worker.thread.start()
-        limit = test.get("run-time-limit")
-        end = None if limit is None else _time.monotonic() + limit
-        for w in workers:
-            w.thread.join(None if end is None else
-                          max(0.0, end - _time.monotonic()))
-            if w.thread.is_alive():
-                run_state.stop.set()
-        run_state.stop.set()                    # client phase over
-        if nem_worker:
-            nem_worker.thread.join(10)
-        if nemesis is not None:
-            try:
-                nemesis.teardown(test)
-            except Exception:                           # noqa: BLE001
-                pass
+        with obs.span("run.workers", concurrency=n,
+                      nemesis=nem_worker is not None):
+            for w in workers:
+                w.thread.start()
+            if nem_worker:
+                nem_worker.thread.start()
+            limit = test.get("run-time-limit")
+            end = None if limit is None else _time.monotonic() + limit
+            for w in workers:
+                w.thread.join(None if end is None else
+                              max(0.0, end - _time.monotonic()))
+                if w.thread.is_alive():
+                    run_state.stop.set()
+            run_state.stop.set()                # client phase over
+            if nem_worker:
+                nem_worker.thread.join(10)
+            if nemesis is not None:
+                try:
+                    nemesis.teardown(test)
+                except Exception:                       # noqa: BLE001
+                    pass
     finally:
         history.close()
-        try:
-            if not test.get("leave-db-running"):
-                db_mod.teardown_all(test)
-            if store_dir:
-                db_mod.snarf_logs(test, store_dir)
-            os_setup.teardown_all(test)
-        except Exception as e:                          # noqa: BLE001
-            log.warning("teardown failed: %s", e)
+        with obs.span("run.teardown"):
+            try:
+                if not test.get("leave-db-running"):
+                    db_mod.teardown_all(test)
+                if store_dir:
+                    db_mod.snarf_logs(test, store_dir)
+                os_setup.teardown_all(test)
+            except Exception as e:                      # noqa: BLE001
+                log.warning("teardown failed: %s", e)
 
     test["history"] = history.snapshot()
     log.info("History complete (%d ops); analyzing", len(test["history"]))
 
     checker = test.get("checker")
-    results = (check_safe(checker, test, test["history"])
-               if checker is not None else {"valid": True})
+    with obs.span("run.check", ops=len(test["history"])):
+        results = (check_safe(checker, test, test["history"])
+                   if checker is not None else {"valid": True})
     if online is not None:
         results["online-check"] = online.stop()
         if results["online-check"].get("valid") is False:
@@ -345,9 +374,14 @@ def run(test: Mapping) -> Dict[str, Any]:
             # checkers/online.py); it must not be masked by a post-hoc
             # "unknown" (state explosion / timeout) or a missing checker
             results["valid"] = False
+    # the run's own observability record: counters + engine-decision
+    # ledger (assertable by callers, serialized into results.json)
+    results["obs"] = obs_cap.summary()
     test["results"] = results
     if store_dir:
-        store_mod.save(test, store_dir)
+        with obs.span("run.store"):
+            store_mod.save(test, store_dir)
+        store_mod.save_obs(store_dir, obs_cap)
     log.info("Analysis complete: valid? = %s", results.get("valid"))
     if log_handler is not None:
         store_mod.detach_log(log_handler)

@@ -1,0 +1,41 @@
+"""``jepsen_tpu.obs`` — observability for the whole checker pipeline
+(ISSUE 2 tentpole): a thread-safe span tracer with Chrome/Perfetto
+``trace_event`` export, a process-wide counters/gauges registry, and
+the **engine-decision ledger** — every auto-chain stage transition and
+every silent-degradation point (``check_safe`` swallows, lockstep →
+per-key fallbacks, Pallas → XLA downgrades) appends a structured
+record, retrievable via :func:`capture` so tests and ``tools/fuzz.py``
+can assert "no silent fallback occurred".
+
+Quick tour::
+
+    from jepsen_tpu import obs
+
+    with obs.span("phase", detail=1):        # nestable, thread-safe
+        obs.count("cache.hits")              # process-wide counter
+        obs.decision("reach", "selected")    # ledger record
+
+    with obs.capture() as cap:               # isolated assertion scope
+        run_check()
+    assert cap.fallbacks() == []
+
+    obs.export_trace("trace.json")           # chrome://tracing
+    obs.export_jsonl("obs.jsonl")            # stream/grep-friendly
+
+Set ``JEPSEN_TPU_NO_OBS=1`` to disable all recording. See
+``docs/OBSERVABILITY.md`` for the full API, the counter taxonomy, and
+the trace-viewer workflow.
+"""
+from jepsen_tpu.obs.core import (Capture, Recorder, capture,
+                                 checker_swallowed, count, counters,
+                                 decision, enabled, engine_fallback,
+                                 engine_selected, gauge, reset, span)
+from jepsen_tpu.obs.trace import (export_jsonl, export_trace, load_any,
+                                  snapshot, trace_events)
+
+__all__ = [
+    "Capture", "Recorder", "capture", "checker_swallowed", "count",
+    "counters", "decision", "enabled", "engine_fallback",
+    "engine_selected", "gauge", "reset", "span", "export_jsonl",
+    "export_trace", "load_any", "snapshot", "trace_events",
+]

@@ -1,0 +1,334 @@
+"""Recorder core of the :mod:`jepsen_tpu.obs` subsystem: a thread-safe,
+low-overhead span tracer, a process-wide counters/gauges registry, and
+the engine-decision ledger.
+
+Design constraints (ISSUE 2):
+
+- **Cheap enough for hot-ish paths.** One span costs two
+  ``time.perf_counter()`` reads, one small dict build, and one
+  lock-guarded list append per active sink — single-digit microseconds.
+  Instrumentation sits at phase/engine granularity (per check, per
+  dispatch group, per run phase), never per history event, so tracer
+  overhead on the 100k-op bench rung is bounded by a handful of events
+  (asserted under 2% of ``check_s`` in ``tests/test_obs.py``).
+  ``JEPSEN_TPU_NO_OBS=1`` disables recording entirely.
+- **Thread-safe.** ``core.run`` records from worker threads and the
+  competition facade races engines on threads; every recorder mutation
+  is lock-guarded and span state lives on the stack (the context
+  manager object), not in thread-local registries.
+- **Capture isolation.** :func:`capture` registers an extra sink on a
+  ``contextvars.ContextVar`` — concurrent captures on different
+  threads never see each other's events, while threads *spawned inside*
+  a capture can opt in by running under ``contextvars.copy_context()``
+  (``core.run`` does this for its workers). Events always also reach
+  the process-global recorder, which :mod:`jepsen_tpu.obs.trace`
+  exports.
+- **Bounded.** Span and ledger stores are capped; drops are themselves
+  counted (``obs.dropped.spans`` / ``obs.dropped.ledger``) so a capped
+  export is never mistaken for a complete one.
+"""
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_ENABLED = not os.environ.get("JEPSEN_TPU_NO_OBS")
+
+# one process-wide monotonic origin so span timestamps from every
+# thread land on one comparable axis (Chrome traces sort by ts)
+_T0 = time.perf_counter()
+
+_MAX_SPANS = 100_000
+_MAX_LEDGER = 10_000
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _T0) * 1e6
+
+
+class Recorder:
+    """One sink of spans, counters, gauges, and ledger records. The
+    process-global instance backs :func:`jepsen_tpu.obs.trace.export_*`;
+    additional instances are created per :func:`capture`."""
+
+    __slots__ = ("_lock", "spans", "counters", "gauges", "ledger")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.spans: List[Dict[str, Any]] = []
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, Any] = {}
+        self.ledger: List[Dict[str, Any]] = []
+
+    # -- mutation (all lock-guarded) ------------------------------------
+    def add_span(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self.spans) >= _MAX_SPANS:
+                self.counters["obs.dropped.spans"] = \
+                    self.counters.get("obs.dropped.spans", 0) + 1
+                return
+            self.spans.append(ev)
+
+    def count(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: Any) -> None:
+        with self._lock:
+            self.gauges[name] = value
+
+    def decide(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self.ledger) >= _MAX_LEDGER:
+                self.counters["obs.dropped.ledger"] = \
+                    self.counters.get("obs.dropped.ledger", 0) + 1
+                return
+            self.ledger.append(rec)
+
+    # -- read side ------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Consistent copy of counters, gauges, and the ledger (spans are
+        exported separately — they can be large)."""
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
+                    "ledger": [dict(r) for r in self.ledger]}
+
+    def span_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self.spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self.spans.clear()
+            self.counters.clear()
+            self.gauges.clear()
+            self.ledger.clear()
+
+
+GLOBAL = Recorder()
+
+# extra sinks registered by capture(); a ContextVar (not a thread-local)
+# so captures nest and explicit contextvars.copy_context() propagation
+# into worker threads works, while unrelated threads stay isolated.
+_CAPTURES: "contextvars.ContextVar[Tuple[Recorder, ...]]" = \
+    contextvars.ContextVar("jepsen_tpu_obs_captures", default=())
+
+
+def _sinks() -> Tuple[Recorder, ...]:
+    caps = _CAPTURES.get()
+    return (GLOBAL,) + caps if caps else (GLOBAL,)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+# -- spans ---------------------------------------------------------------
+
+class Span:
+    """Context manager recording one Chrome-trace ``"X"`` (complete)
+    event on exit. ``set(key, value)`` adds args mid-flight (e.g. the
+    engine a check ultimately selected)."""
+
+    __slots__ = ("name", "cat", "args", "_ts")
+
+    def __init__(self, name: str, cat: str = "",
+                 args: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def set(self, key: str, value: Any) -> None:
+        if self.args is None:
+            self.args = {}
+        self.args[key] = value
+
+    def __enter__(self) -> "Span":
+        self._ts = _now_us()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        end = _now_us()
+        ev: Dict[str, Any] = {
+            "name": self.name, "ph": "X", "ts": self._ts,
+            "dur": end - self._ts, "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if self.cat:
+            ev["cat"] = self.cat
+        if self.args:
+            ev["args"] = self.args
+        if exc_type is not None:
+            ev.setdefault("args", {})["error"] = exc_type.__name__
+        for s in _sinks():
+            s.add_span(ev)
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def set(self, key: str, value: Any) -> None:
+        pass
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def span(name: str, cat: str = "", **args: Any):
+    """``with obs.span("reach.walk", engine="reach-lockstep"): ...`` —
+    nestable, thread-safe; exported as a Chrome/Perfetto trace event."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return Span(name, cat, args or None)
+
+
+# -- counters / gauges ---------------------------------------------------
+
+def count(name: str, n: float = 1) -> None:
+    """Bump a process-wide (and any captured) counter."""
+    if not _ENABLED:
+        return
+    for s in _sinks():
+        s.count(name, n)
+
+
+def gauge(name: str, value: Any) -> None:
+    """Set a last-value-wins gauge (e.g. kernel-cache hit counts)."""
+    if not _ENABLED:
+        return
+    for s in _sinks():
+        s.gauge(name, value)
+
+
+def counters() -> Dict[str, float]:
+    """Snapshot of the process-global counters."""
+    return GLOBAL.snapshot()["counters"]
+
+
+# -- engine-decision ledger ---------------------------------------------
+
+def decision(stage: str, event: str, cause: Optional[str] = None,
+             **fields: Any) -> None:
+    """Append a structured record to the engine-decision ledger:
+    ``stage`` (engine or pipeline stage), ``event`` (``"selected"`` /
+    ``"fallback"`` / ``"swallowed"`` / ``"route"``), optional ``cause``
+    (exception class or reason), plus free-form fields (history
+    geometry, elapsed seconds)."""
+    if not _ENABLED:
+        return
+    rec: Dict[str, Any] = {"ts": round(_now_us()), "stage": stage,
+                           "event": event}
+    if cause is not None:
+        rec["cause"] = cause
+    rec.update(fields)
+    for s in _sinks():
+        s.decide(rec)
+
+
+def engine_selected(stage: str, **fields: Any) -> None:
+    """An engine produced the conclusive verdict for a check. Bumps
+    ``engine.selected.<stage>`` and appends a ledger record."""
+    count(f"engine.selected.{stage}")
+    decision(stage, "selected", **fields)
+
+
+def engine_fallback(stage: str, cause: str, **fields: Any) -> None:
+    """A stage was abandoned and the chain moved on. Bumps
+    ``engine.fallback.<stage>.<cause>`` (fallback causes keyed by
+    exception class and stage) and appends a ledger record."""
+    count(f"engine.fallback.{stage}.{cause}")
+    decision(stage, "fallback", cause=cause, **fields)
+
+
+def checker_swallowed(stage: str, cause: str, **fields: Any) -> None:
+    """``check_safe`` turned a checker crash into ``"unknown"`` — the
+    crash is preserved here (and in the result's ``"traceback"``) so it
+    is never silent."""
+    count(f"checker.swallowed.{stage}.{cause}")
+    decision(stage, "swallowed", cause=cause, **fields)
+
+
+# -- capture -------------------------------------------------------------
+
+class Capture:
+    """Events recorded while a :func:`capture` context is active, plus
+    assertion helpers for tests (``selections()`` / ``fallbacks()`` /
+    ``swallowed()``)."""
+
+    def __init__(self) -> None:
+        self._rec = Recorder()
+
+    # field-specific locked copies — a counters read must not copy a
+    # ledger sitting at its 10k cap
+    @property
+    def spans(self) -> List[Dict[str, Any]]:
+        return self._rec.span_events()
+
+    @property
+    def counters(self) -> Dict[str, float]:
+        with self._rec._lock:
+            return dict(self._rec.counters)
+
+    @property
+    def gauges(self) -> Dict[str, Any]:
+        with self._rec._lock:
+            return dict(self._rec.gauges)
+
+    @property
+    def ledger(self) -> List[Dict[str, Any]]:
+        with self._rec._lock:
+            return [dict(r) for r in self._rec.ledger]
+
+    def _by_event(self, event: str) -> List[Dict[str, Any]]:
+        return [r for r in self.ledger if r.get("event") == event]
+
+    def selections(self) -> List[Dict[str, Any]]:
+        return self._by_event("selected")
+
+    def fallbacks(self) -> List[Dict[str, Any]]:
+        return self._by_event("fallback")
+
+    def swallowed(self) -> List[Dict[str, Any]]:
+        return self._by_event("swallowed")
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON-serializable counters + gauges + ledger (no spans)."""
+        return self._rec.snapshot()
+
+
+class _CaptureCtx:
+    __slots__ = ("_cap", "_token")
+
+    def __init__(self) -> None:
+        self._cap = Capture()
+
+    def __enter__(self) -> Capture:
+        self._token = _CAPTURES.set(_CAPTURES.get() + (self._cap._rec,))
+        return self._cap
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        _CAPTURES.reset(self._token)
+
+
+def capture() -> _CaptureCtx:
+    """``with obs.capture() as cap:`` — everything recorded in this
+    context (same thread, or threads run under a copied
+    ``contextvars`` context) is ALSO collected into ``cap``, isolated
+    from concurrent captures on other threads. Recording into the
+    process-global recorder is unaffected."""
+    return _CaptureCtx()
+
+
+def reset() -> None:
+    """Clear the process-global recorder (tests and long-lived tools)."""
+    GLOBAL.clear()

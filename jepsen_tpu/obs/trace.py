@@ -1,0 +1,110 @@
+"""Export side of :mod:`jepsen_tpu.obs`: Chrome/Perfetto ``trace.json``
+(the ``trace_event`` format — load in ``chrome://tracing`` or
+https://ui.perfetto.dev), a line-oriented ``obs.jsonl`` (one record per
+span/counter/gauge/decision, grep- and stream-friendly), and the
+``snapshot()`` sub-object :mod:`bench` embeds in its output JSON.
+
+``tools/trace_view.py`` parses both formats back (top spans by
+self-time, the fallback table); :func:`load_any` is the shared reader.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu.obs.core import GLOBAL, Capture, Recorder
+
+
+def _recorder_of(source: Optional[Any]) -> Recorder:
+    if source is None:
+        return GLOBAL
+    if isinstance(source, Capture):
+        return source._rec
+    return source
+
+
+def trace_events(source: Optional[Any] = None) -> List[Dict[str, Any]]:
+    """The Chrome ``traceEvents`` list: every recorded span as a ``"X"``
+    (complete) event, plus one metadata event naming the process."""
+    rec = _recorder_of(source)
+    meta = {"name": "process_name", "ph": "M", "pid": os.getpid(),
+            "tid": 0, "args": {"name": "jepsen-tpu"}}
+    return [meta] + rec.span_events()
+
+
+def export_trace(path: str, source: Optional[Any] = None) -> str:
+    """Write a Chrome/Perfetto ``trace_event`` JSON file."""
+    data = {"traceEvents": trace_events(source),
+            "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(data, f, default=str)
+    return path
+
+
+def export_jsonl(path: str, source: Optional[Any] = None) -> str:
+    """Write ``obs.jsonl``: one JSON object per line, each tagged with a
+    ``"type"`` of ``span`` / ``counter`` / ``gauge`` / ``decision``."""
+    rec = _recorder_of(source)
+    snap = rec.snapshot()
+    with open(path, "w") as f:
+        for name, value in sorted(snap["counters"].items()):
+            f.write(json.dumps({"type": "counter", "name": name,
+                                "value": value}, default=str) + "\n")
+        for name, value in sorted(snap["gauges"].items()):
+            f.write(json.dumps({"type": "gauge", "name": name,
+                                "value": value}, default=str) + "\n")
+        for r in snap["ledger"]:
+            f.write(json.dumps({"type": "decision", **r},
+                               default=str) + "\n")
+        for e in rec.span_events():
+            f.write(json.dumps({"type": "span", **e},
+                               default=str) + "\n")
+    return path
+
+
+def snapshot(source: Optional[Any] = None) -> Dict[str, Any]:
+    """JSON-serializable counters + gauges + engine ledger + span count
+    — the ``"obs"`` sub-object of ``bench.py`` output and of run
+    ``results``."""
+    rec = _recorder_of(source)
+    out = rec.snapshot()
+    out["span-count"] = len(rec.spans)
+    return out
+
+
+def load_any(path: str) -> Dict[str, List[Dict[str, Any]]]:
+    """Read a ``trace.json`` OR an ``obs.jsonl`` back into
+    ``{"spans": [...], "decisions": [...], "counters": [...],
+    "gauges": [...]}`` — the shared parser behind
+    ``tools/trace_view.py``."""
+    out: Dict[str, List[Dict[str, Any]]] = {
+        "spans": [], "decisions": [], "counters": [], "gauges": []}
+    with open(path) as f:
+        head = f.read(1)
+        f.seek(0)
+        if head == "{":
+            try:
+                data = json.load(f)
+            except json.JSONDecodeError:
+                data = None
+            if isinstance(data, dict) and "traceEvents" in data:
+                out["spans"] = [e for e in data["traceEvents"]
+                                if e.get("ph") == "X"]
+                return out
+            f.seek(0)
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.pop("type", "span")
+            if kind == "span":
+                out["spans"].append(rec)
+            elif kind == "decision":
+                out["decisions"].append(rec)
+            elif kind == "counter":
+                out["counters"].append(rec)
+            elif kind == "gauge":
+                out["gauges"].append(rec)
+    return out

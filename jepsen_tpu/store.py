@@ -112,6 +112,23 @@ def save(test: Mapping, run_dir: Optional[str] = None) -> str:
     return run_dir
 
 
+def save_obs(run_dir: str, capture: Optional[Any] = None) -> None:
+    """Persist the run's observability record next to its history:
+    ``obs.jsonl`` (spans + counters + engine-decision ledger, one JSON
+    object per line) and ``trace.json`` (Chrome/Perfetto
+    ``trace_event`` — load in ``chrome://tracing`` or ui.perfetto.dev;
+    summarize with ``tools/trace_view.py``). ``capture`` is the run's
+    :class:`jepsen_tpu.obs.Capture` (None exports the process-global
+    recorder). Best-effort: persistence failures must never fail a
+    completed run."""
+    from jepsen_tpu import obs
+    try:
+        obs.export_jsonl(os.path.join(run_dir, "obs.jsonl"), capture)
+        obs.export_trace(os.path.join(run_dir, "trace.json"), capture)
+    except Exception as e:                              # noqa: BLE001
+        log.warning("obs persistence failed: %s", e)
+
+
 def load_history(run_dir: str) -> List[Op]:
     """Load a stored history for offline re-analysis (the upstream
     re-check path; SURVEY.md §5 checkpoint/resume)."""

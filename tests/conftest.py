@@ -22,6 +22,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests excluded from the tier-1 'not slow' run "
+        "(e.g. the cas-100k obs acceptance rung)")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """XLA-CPU's in-process LLVM JIT intermittently SEGFAULTs once a

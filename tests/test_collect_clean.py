@@ -20,3 +20,34 @@ def test_collect_only_reports_no_errors():
     assert proc.returncode == 0, f"collection not clean:\n{tail}"
     summary = [ln for ln in (proc.stdout or "").splitlines() if ln][-1]
     assert "error" not in summary.lower(), tail
+
+
+def test_tools_and_obs_modules_import_cleanly():
+    """The ``tools/`` CLIs and the ``jepsen_tpu.obs`` package are not
+    imported by the pytest suite's collection, so a SyntaxError or a
+    missing-dep import there would ship silently. Import every one of
+    them in a CPU-pinned subprocess (tools are standalone scripts —
+    loaded by file path; obs is a package — imported by name)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    code = (
+        "import glob, importlib, importlib.util, os, sys\n"
+        "root = sys.argv[1]\n"
+        "sys.path.insert(0, root)\n"
+        "for name in ('jepsen_tpu.obs', 'jepsen_tpu.obs.core',\n"
+        "             'jepsen_tpu.obs.trace'):\n"
+        "    importlib.import_module(name)\n"
+        "files = sorted(glob.glob(os.path.join(root, 'tools', '*.py')))\n"
+        "assert files, 'no tools found'\n"
+        "for f in files:\n"
+        "    name = 'toolcheck_' + os.path.splitext(os.path.basename(f))[0]\n"
+        "    spec = importlib.util.spec_from_file_location(name, f)\n"
+        "    mod = importlib.util.module_from_spec(spec)\n"
+        "    spec.loader.exec_module(mod)\n"
+        "print('imported', len(files) + 3)\n")
+    proc = subprocess.run([sys.executable, "-c", code, root], cwd=root,
+                          capture_output=True, text=True, timeout=240,
+                          env=env)
+    tail = (proc.stdout or "")[-2000:] + (proc.stderr or "")[-2000:]
+    assert proc.returncode == 0, f"import not clean:\n{tail}"
+    assert "imported" in proc.stdout, tail

@@ -1,0 +1,295 @@
+"""Tests for the :mod:`jepsen_tpu.obs` subsystem (ISSUE 2): trace
+export round-trip, counter/ledger assertions across the auto-chain
+paths, capture isolation under threads, and the tracer-overhead bound
+on the 100k-op rung."""
+import json
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import fixtures, models, obs
+from jepsen_tpu.checkers.facade import (Checker, auto_check_packed,
+                                        check_safe)
+from jepsen_tpu.history import pack
+
+
+# -- tracer core ---------------------------------------------------------
+
+def test_trace_export_roundtrip_valid_chrome_json(tmp_path):
+    with obs.capture() as cap:
+        with obs.span("outer", kind="test"):
+            time.sleep(0.002)
+            with obs.span("inner"):
+                time.sleep(0.001)
+    path = str(tmp_path / "trace.json")
+    obs.export_trace(path, cap)
+    data = json.loads(open(path).read())
+    assert "traceEvents" in data
+    evs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    # required Chrome trace_event keys on every complete event
+    for e in evs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+    (inner,) = [e for e in evs if e["name"] == "inner"]
+    (outer,) = [e for e in evs if e["name"] == "outer"]
+    # nested spans well-formed: child interval contained in parent's
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert outer["args"]["kind"] == "test"
+
+
+def test_jsonl_export_and_load_any(tmp_path):
+    with obs.capture() as cap:
+        with obs.span("phase-a"):
+            pass
+        obs.count("some.counter", 3)
+        obs.gauge("some.gauge", 0.5)
+        obs.decision("reach", "selected", ops=10)
+    path = str(tmp_path / "obs.jsonl")
+    obs.export_jsonl(path, cap)
+    data = obs.load_any(path)
+    assert [s["name"] for s in data["spans"]] == ["phase-a"]
+    assert {"name": "some.counter", "value": 3} in data["counters"]
+    assert data["gauges"][0]["name"] == "some.gauge"
+    (dec,) = data["decisions"]
+    assert dec["stage"] == "reach" and dec["event"] == "selected"
+    # load_any reads the Chrome trace form too
+    tpath = str(tmp_path / "trace.json")
+    obs.export_trace(tpath, cap)
+    assert [s["name"]
+            for s in obs.load_any(tpath)["spans"]] == ["phase-a"]
+
+
+def test_capture_isolation_under_threads():
+    """Concurrent captures on different threads never see each other's
+    events; each sees its own."""
+    out = {}
+    barrier = threading.Barrier(2)
+
+    def work(tag):
+        with obs.capture() as cap:
+            barrier.wait()
+            obs.count(f"iso.{tag}")
+            with obs.span(f"span.{tag}"):
+                pass
+            obs.decision(f"stage.{tag}", "selected")
+            barrier.wait()      # both have recorded before either exits
+            out[tag] = {"counters": cap.counters, "spans": cap.spans,
+                        "ledger": cap.ledger}
+
+    ts = [threading.Thread(target=work, args=(t,)) for t in ("a", "b")]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    for mine, other in (("a", "b"), ("b", "a")):
+        assert f"iso.{mine}" in out[mine]["counters"]
+        assert f"iso.{other}" not in out[mine]["counters"]
+        assert [s["name"] for s in out[mine]["spans"]] \
+            == [f"span.{mine}"]
+        assert [r["stage"] for r in out[mine]["ledger"]] \
+            == [f"stage.{mine}"]
+
+
+def test_capture_nests_and_global_still_records():
+    before = obs.counters().get("nest.test", 0)
+    with obs.capture() as outer:
+        obs.count("nest.test")
+        with obs.capture() as inner:
+            obs.count("nest.test")
+        obs.count("nest.test")
+    assert inner.counters["nest.test"] == 1
+    assert outer.counters["nest.test"] == 3
+    assert obs.counters()["nest.test"] == before + 3
+
+
+def test_capture_propagates_into_copied_context_threads():
+    """Threads spawned under contextvars.copy_context() (as core.run
+    spawns its workers) record into the enclosing capture."""
+    import contextvars
+
+    with obs.capture() as cap:
+        ctx = contextvars.copy_context()
+        t = threading.Thread(
+            target=lambda: ctx.run(lambda: obs.count("worker.tick")))
+        t.start()
+        t.join(10)
+    assert cap.counters.get("worker.tick") == 1
+
+
+# -- auto-chain ledger ---------------------------------------------------
+
+def _small():
+    h = fixtures.gen_history("cas", n_ops=40, processes=3, seed=7)
+    return models.cas_register(), pack(h)
+
+
+def test_auto_chain_clean_path_single_selection():
+    model, packed = _small()
+    with obs.capture() as cap:
+        res = auto_check_packed(model, packed, {})
+    assert res["valid"] is True
+    sel = cap.selections()
+    assert len(sel) == 1
+    assert sel[0]["stage"] == res["engine"]
+    assert cap.fallbacks() == []
+    assert cap.swallowed() == []
+    assert cap.counters.get(f"engine.selected.{res['engine']}") == 1
+
+
+def test_auto_chain_forced_dense_overflow_records_fallback():
+    """max_dense=1 forces DenseOverflow out of the dense stage; the
+    ledger must record the fallback (stage, exception class, geometry)
+    and exactly one selection by whichever stage concluded."""
+    model, packed = _small()
+    with obs.capture() as cap:
+        res = auto_check_packed(model, packed, {"max_dense": 1})
+    assert res["valid"] is True
+    fbs = cap.fallbacks()
+    assert any(f["stage"] == "reach" and f["cause"] == "DenseOverflow"
+               and f["ops"] == packed.n for f in fbs)
+    assert cap.counters["engine.fallback.reach.DenseOverflow"] == 1
+    sel = cap.selections()
+    assert len(sel) == 1
+    assert sel[0]["stage"] == res["engine"]
+    # the fallback engine is one of the chain's later stages
+    assert res["engine"] in ("wgl-native-fallback", "frontier-fallback",
+                             "wgl-cpu-fallback")
+
+
+def test_auto_chain_records_skipped_unavailable_stage(monkeypatch):
+    """A degraded install (no C++ WGL library) must not yield a clean
+    ledger: the chain records the missing stage as event "skipped"."""
+    from jepsen_tpu.checkers import wgl_native
+
+    monkeypatch.setattr(wgl_native, "available", lambda: False)
+    model, packed = _small()
+    with obs.capture() as cap:
+        res = auto_check_packed(model, packed, {"max_dense": 1})
+    assert res["valid"] is True
+    skips = [r for r in cap.ledger if r["event"] == "skipped"]
+    assert any(r["stage"] == "wgl-native"
+               and r["cause"] == "unavailable" for r in skips)
+    assert cap.counters["engine.skipped.wgl-native.unavailable"] == 1
+    assert len(cap.selections()) == 1
+
+
+def test_check_safe_preserves_traceback_and_counts():
+    class Boom(Checker):
+        name = "boom"
+
+        def check(self, test, history, opts=None):
+            raise ValueError("deliberate crash")
+
+    with obs.capture() as cap:
+        res = check_safe(Boom(), None, [])
+    assert res["valid"] == "unknown"
+    assert res["error"] == "ValueError: deliberate crash"
+    assert "deliberate crash" in res["traceback"]
+    assert "test_obs.py" in res["traceback"]    # the full stack, kept
+    (sw,) = cap.swallowed()
+    assert sw["stage"] == "boom" and sw["cause"] == "ValueError"
+    assert cap.counters["checker.swallowed.boom.ValueError"] == 1
+
+
+def test_run_results_carry_obs_ledger(tmp_path):
+    """core.run embeds the run's capture (counters + ledger) in
+    results["obs"] and persists obs.jsonl + trace.json into the run
+    dir."""
+    import os
+
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import register
+
+    t = register.register_test(mode="linearizable", time_limit=0.6,
+                               seed=3, with_nemesis=False, store=True,
+                               concurrency=3)
+    t["store-root"] = str(tmp_path / "store")
+    done = core.run(t)
+    assert done["results"]["valid"] is True
+    sub = done["results"]["obs"]
+    assert sub["counters"], "run recorded no counters"
+    selections = [r for r in sub["ledger"] if r["event"] == "selected"]
+    assert len(selections) == 1
+    d = done["dir"]
+    assert os.path.exists(os.path.join(d, "obs.jsonl"))
+    trace = os.path.join(d, "trace.json")
+    assert os.path.exists(trace)
+    spans = {s["name"] for s in obs.load_any(trace)["spans"]}
+    # the run phases are traced, workers included
+    assert {"run.setup", "run.workers", "run.check",
+            "run.worker"} <= spans
+
+
+# -- the 100k acceptance rung -------------------------------------------
+
+@pytest.mark.slow
+def test_cas_100k_auto_single_selection_and_overhead_bound():
+    """ISSUE 2 acceptance: the cas-100k auto path records exactly one
+    engine selection and zero silent fallbacks, and tracer overhead on
+    the rung stays under 2% of check_s (bounded by events-recorded ×
+    measured per-event cost — the instrumentation sits at phase
+    granularity, so the event count is tiny)."""
+    packed = fixtures.gen_packed("cas", n_ops=100_000, processes=5,
+                                 seed=42)
+    model = models.cas_register()
+    with obs.capture() as cap:
+        t0 = time.monotonic()
+        res = auto_check_packed(model, packed, {})
+        check_s = time.monotonic() - t0
+    assert res["valid"] is True
+    assert len(cap.selections()) == 1
+    assert cap.fallbacks() == []
+    assert cap.swallowed() == []
+    n_events = len(cap.spans) + len(cap.ledger) + len(cap.counters)
+    # measured per-event cost of the tracer (span enter/exit + counter)
+    reps = 2000
+    t0 = time.monotonic()
+    for _ in range(reps):
+        with obs.span("overhead-probe"):
+            obs.count("overhead.probe")
+    per_event = (time.monotonic() - t0) / (2 * reps)
+    overhead = n_events * per_event
+    assert overhead < 0.02 * check_s, (
+        f"tracer overhead {overhead:.4f}s exceeds 2% of "
+        f"check_s={check_s:.3f}s ({n_events} events, "
+        f"{per_event * 1e6:.1f}us each)")
+
+
+# -- kill switch ---------------------------------------------------------
+
+def test_no_obs_env_disables_recording(monkeypatch):
+    from jepsen_tpu.obs import core as obs_core
+
+    monkeypatch.setattr(obs_core, "_ENABLED", False)
+    with obs.capture() as cap:
+        with obs.span("dark"):
+            obs.count("dark.counter")
+            obs.decision("dark", "selected")
+    assert cap.spans == []
+    assert cap.counters == {}
+    assert cap.ledger == []
+
+
+def test_no_obs_env_var_honored_at_import():
+    """The documented interface is the JEPSEN_TPU_NO_OBS environment
+    variable, read at import — exercise it in a subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "from jepsen_tpu import obs\n"
+        "with obs.capture() as cap:\n"
+        "    with obs.span('dark'):\n"
+        "        obs.count('dark.counter')\n"
+        "assert not obs.enabled()\n"
+        "assert cap.spans == [] and cap.counters == {}\n"
+        "print('DISABLED-OK')\n")
+    env = dict(os.environ, JEPSEN_TPU_NO_OBS="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=root,
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "DISABLED-OK" in proc.stdout

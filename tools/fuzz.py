@@ -375,16 +375,31 @@ def main() -> int:
             pass
 
     t0 = time.monotonic()
-    mismatches, invalid_seen = run_many(
-        args.n, args.seed, pallas=args.pallas, verbose=args.verbose)
-    ckl_bad: list = []
-    if args.chunklock:
-        ckl_bad = chunklock_trials(args.chunklock, args.seed + 99)
+    from jepsen_tpu import obs
+    with obs.capture() as cap:
+        mismatches, invalid_seen = run_many(
+            args.n, args.seed, pallas=args.pallas, verbose=args.verbose)
+        ckl_bad: list = []
+        if args.chunklock:
+            ckl_bad = chunklock_trials(args.chunklock, args.seed + 99)
+    # observability over the whole fuzz session: silent-degradation
+    # counters (pallas → XLA downgrades, swallowed checker crashes,
+    # lockstep → per-key fallbacks) become greppable output instead of
+    # log noise; "no silent fallback occurred" is now assertable
+    obs_counters = {k: v for k, v in sorted(cap.counters.items())
+                    if k.startswith(("reach.", "engine.fallback.",
+                                     "engine.skipped.",
+                                     "checker.swallowed.",
+                                     "lockstep."))}
     print(json.dumps({
         "trials": args.n, "mismatches": len(mismatches),
         "invalid_histories": invalid_seen,
         "chunklock_trials": args.chunklock,
         "chunklock_mismatches": len(ckl_bad),
+        "swallowed_checker_crashes": sum(
+            v for k, v in cap.counters.items()
+            if k.startswith("checker.swallowed.")),
+        "obs": obs_counters,
         "elapsed_s": round(time.monotonic() - t0, 1)}))
     return 1 if (mismatches or ckl_bad) else 0
 

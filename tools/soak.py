@@ -74,7 +74,7 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
-    from jepsen_tpu import core
+    from jepsen_tpu import core, obs
 
     rng = random.Random(args.seed)
     t0 = time.monotonic()
@@ -108,9 +108,17 @@ def main() -> int:
             failures.append({"suite": suite, "mode": mode,
                              "error": "bug never caught"})
             print(f"NEVER CAUGHT: {suite}-{mode}", file=sys.stderr)
+    # cross-run observability: every run's engine selections and every
+    # silent-degradation counter, aggregated over the whole soak (each
+    # run's own ledger also lands in its results["obs"])
+    snap = obs.counters()
     print(json.dumps({
         "runs": runs, "unexpected": len(failures),
-        "caught": caught, "elapsed_s": round(time.monotonic() - t0, 1)}))
+        "caught": caught,
+        "obs": {k: v for k, v in sorted(snap.items())
+                if k.startswith(("engine.", "checker.swallowed.",
+                                 "reach.", "lockstep."))},
+        "elapsed_s": round(time.monotonic() - t0, 1)}))
     return 1 if failures else 0
 
 

@@ -1,0 +1,136 @@
+"""Summarize a ``trace.json`` / ``obs.jsonl`` produced by
+:mod:`jepsen_tpu.obs` (bench runs, stored run dirs) without opening a
+trace viewer: top spans by SELF time (span duration minus the duration
+of its children — children are spans on the same thread whose interval
+is contained in the parent's), the engine-decision ledger as a
+fallback/selection table, and the counters.
+
+Usage:
+    python tools/trace_view.py trace.json [--top 15] [--json]
+    python tools/trace_view.py store/<name>/latest/obs.jsonl
+
+Exit codes: 0 on success, 2 when the file cannot be parsed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def self_times(spans: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Aggregate per span NAME: count, total wall duration, and total
+    self time (duration minus directly-contained child spans on the
+    same thread). O(n log n) per thread via a sweep over spans sorted
+    by (start, -duration): a stack of open intervals attributes each
+    child's duration to its nearest enclosing parent."""
+    by_tid: Dict[Any, List[Dict[str, Any]]] = defaultdict(list)
+    for s in spans:
+        if "ts" in s and "dur" in s:
+            by_tid[s.get("tid", 0)].append(s)
+    agg: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0})
+    for tid_spans in by_tid.values():
+        tid_spans.sort(key=lambda s: (s["ts"], -s["dur"]))
+        stack: List[Dict[str, Any]] = []    # open enclosing spans
+        child_us: Dict[int, float] = {}     # id(span) -> children dur
+        for s in tid_spans:
+            while stack and stack[-1]["ts"] + stack[-1]["dur"] <= s["ts"]:
+                stack.pop()
+            if stack:
+                parent = stack[-1]
+                child_us[id(parent)] = child_us.get(id(parent), 0.0) \
+                    + s["dur"]
+            stack.append(s)
+        for s in tid_spans:
+            a = agg[s["name"]]
+            a["count"] += 1
+            a["total_us"] += s["dur"]
+            a["self_us"] += max(0.0, s["dur"] - child_us.get(id(s), 0.0))
+    return dict(agg)
+
+
+def decision_table(decisions: List[Dict[str, Any]]
+                   ) -> Dict[str, Dict[str, int]]:
+    """Ledger records grouped ``event -> "stage[/cause]" -> count``."""
+    out: Dict[str, Dict[str, int]] = defaultdict(lambda: defaultdict(int))
+    for d in decisions:
+        key = str(d.get("stage", "?"))
+        if d.get("cause"):
+            key += f" / {d['cause']}"
+        out[str(d.get("event", "?"))][key] += 1
+    return {ev: dict(rows) for ev, rows in out.items()}
+
+
+def summarize(path: str, top: int = 15) -> Dict[str, Any]:
+    from jepsen_tpu import obs
+
+    data = obs.load_any(path)
+    st = self_times(data["spans"])
+    ranked = sorted(st.items(), key=lambda kv: -kv[1]["self_us"])[:top]
+    return {
+        "file": path,
+        "spans": len(data["spans"]),
+        "top_spans_by_self_time": [
+            {"name": name, "count": int(a["count"]),
+             "total_ms": round(a["total_us"] / 1e3, 3),
+             "self_ms": round(a["self_us"] / 1e3, 3)}
+            for name, a in ranked],
+        "decisions": decision_table(data["decisions"]),
+        "counters": {c["name"]: c["value"] for c in data["counters"]},
+        "gauges": {g["name"]: g["value"] for g in data["gauges"]},
+    }
+
+
+def _print_human(s: Dict[str, Any]) -> None:
+    print(f"{s['file']}: {s['spans']} spans")
+    if s["top_spans_by_self_time"]:
+        print("\ntop spans by self time:")
+        print(f"  {'name':32} {'count':>6} {'self ms':>10} {'total ms':>10}")
+        for row in s["top_spans_by_self_time"]:
+            print(f"  {row['name']:32} {row['count']:>6} "
+                  f"{row['self_ms']:>10.3f} {row['total_ms']:>10.3f}")
+    if s["decisions"]:
+        print("\nengine-decision ledger:")
+        for event, rows in sorted(s["decisions"].items()):
+            print(f"  {event}:")
+            for key, n in sorted(rows.items(), key=lambda kv: -kv[1]):
+                print(f"    {key:48} x{n}")
+    if s["counters"]:
+        print("\ncounters:")
+        for name, v in sorted(s["counters"].items()):
+            print(f"  {name:48} {v}")
+    if s["gauges"]:
+        print("\ngauges:")
+        for name, v in sorted(s["gauges"].items()):
+            print(f"  {name:48} {v}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("path", help="trace.json or obs.jsonl")
+    ap.add_argument("--top", type=int, default=15,
+                    help="spans to list (by self time)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args()
+    try:
+        s = summarize(args.path, args.top)
+    except (OSError, json.JSONDecodeError, KeyError, TypeError) as e:
+        print(f"cannot parse {args.path}: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(s))
+    else:
+        _print_human(s)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
