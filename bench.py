@@ -74,6 +74,7 @@ def kernel_probe(model, packed) -> dict:
     from jepsen_tpu.checkers import events as ev
     from jepsen_tpu.checkers import reach, reach_lane
 
+    t_prep = time.monotonic()
     memo, stream, _T, S, M = reach._prep(
         model, packed, max_states=100_000, max_slots=20,
         max_dense=1 << 22)
@@ -87,6 +88,7 @@ def kernel_probe(model, packed) -> dict:
     # kernel or a pipeline production does not execute
     geom, _, _, host_args = reach_lane.pack_operands(
         P_np, rs.ret_slot, rs.slot_ops, R0)
+    prep_s = time.monotonic() - t_prep
     B, W, M, S, O1, R_pad = geom
     n_pass = min(W, reach_lane._FAST_PASSES)
     n_bytes = sum(a.nbytes for a in host_args)
@@ -121,11 +123,20 @@ def kernel_probe(model, packed) -> dict:
     args2 = jax.device_put(host_args)
     _ = int(observe(*args2))
     transfer_s = max(0.0, time.monotonic() - t0 - rtt_s)
+    # steady-state walk split into its pipeline stages: dispatch_s is
+    # the host time to queue every device program, fetch_s the
+    # verdict round-trip — together with prep_s these attribute the
+    # ~47 ms of check_s the kernel slope leaves unexplained, so the
+    # overlap win is measurable run-over-run
     t0 = time.monotonic()
     _, final = reach_lane._pipe_walk(host_args, geom, n_pass, False,
                                      dsegs)
+    t1 = time.monotonic()
     _ = np.asarray(final)
-    one_s = time.monotonic() - t0         # 1 walk (dispatches) + fetch
+    t2 = time.monotonic()
+    dispatch_only_s = t1 - t0
+    fetch_s = t2 - t1
+    one_s = t2 - t0                       # 1 walk (dispatches) + fetch
     K = 6
     t0 = time.monotonic()
     for _i in range(K):
@@ -148,6 +159,9 @@ def kernel_probe(model, packed) -> dict:
         "transfer_bytes": int(n_bytes),
         "rtt_s": round(rtt_s, 4),
         "dispatch_fetch_s": round(one_s - kernel_s, 4),
+        "prep_s": round(prep_s, 4),
+        "dispatch_s": round(dispatch_only_s, 4),
+        "fetch_s": round(fetch_s, 4),
         "mfu_pct": round(flops / max(kernel_s, 1e-9) / _PEAK_FLOPS * 100,
                          4),
     }
@@ -210,19 +224,32 @@ def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
         # mislabel sequential throughput, so skip like kernel_probe
         return {"skipped": f"no lockstep path ({sorted(engines)})"}
     times = []
+    best_diag = diag
     for _ in range(2):
+        d: dict = {}
         t1 = time.monotonic()
-        reach.check_batch(model, packeds)
-        times.append(time.monotonic() - t1)
+        reach.check_batch(model, packeds, diag=d)
+        dt = time.monotonic() - t1
+        if not times or dt < min(times):
+            best_diag = d or diag
+        times.append(dt)
     best = min(times)
+    prep = best_diag.get("prep", {})
     return {"H": H, "e2e_s": round(best, 3),
             "agg_ops_s": round(H * n_ops / best),
             "engine": sorted(engines),
-            "pack_efficiency": diag.get("pack_efficiency"),
-            "real_returns": diag.get("real_returns"),
-            "padded_returns": diag.get("padded_returns"),
-            "kernel_cache": diag.get("kernel_cache"),
-            "per_bucket": diag.get("groups", [])}
+            # prep/dispatch/fetch attribution of the best e2e run —
+            # prep_hidden_s / prep_s is the streaming overlap win
+            "prep_s": prep.get("wall_s"),
+            "prep_hidden_s": prep.get("hidden_s"),
+            "prep_mode": prep.get("mode"),
+            "dispatch_s": best_diag.get("dispatch_s"),
+            "fetch_s": best_diag.get("fetch_s"),
+            "pack_efficiency": best_diag.get("pack_efficiency"),
+            "real_returns": best_diag.get("real_returns"),
+            "padded_returns": best_diag.get("padded_returns"),
+            "kernel_cache": best_diag.get("kernel_cache"),
+            "per_bucket": best_diag.get("groups", [])}
 
 
 def _ragged_lengths(total: int, keys: int = 12,
@@ -259,10 +286,15 @@ def independent_probe(model, n_ops: int, seed: int,
         return {"error": "bad ragged verdicts"}
     engines = sorted({r["engine"] for r in res})
     times = []
+    best_diag = diag
     for _ in range(2):
+        d: dict = {}
         t1 = time.monotonic()
-        reach.check_many(model, packeds)
-        times.append(time.monotonic() - t1)
+        reach.check_many(model, packeds, diag=d)
+        dt = time.monotonic() - t1
+        if not times or dt < min(times):
+            best_diag = d or diag
+        times.append(dt)
     best = min(times)
     # sequential per-key baseline: same histories, same run, warmed
     # once, and timed with the SAME best-of-2 discipline as the batch
@@ -276,6 +308,7 @@ def independent_probe(model, n_ops: int, seed: int,
             reach.check_packed(model, p)
         seq_times.append(time.monotonic() - t1)
     seq_s = max(min(seq_times), 1e-9)
+    prep = best_diag.get("prep", {})
     return {"keys": len(lens), "lens": lens,
             "e2e_s": round(best, 3),
             "agg_ops_s": round(total / best),
@@ -283,11 +316,16 @@ def independent_probe(model, n_ops: int, seed: int,
             "seq_ops_s": round(total / seq_s),
             "speedup_vs_sequential": round(seq_s / best, 2),
             "engine": engines,
-            "pack_efficiency": diag.get("pack_efficiency"),
-            "real_returns": diag.get("real_returns"),
-            "padded_returns": diag.get("padded_returns"),
-            "kernel_cache": diag.get("kernel_cache"),
-            "per_bucket": diag.get("groups", [])}
+            "prep_s": prep.get("wall_s"),
+            "prep_hidden_s": prep.get("hidden_s"),
+            "prep_mode": prep.get("mode"),
+            "dispatch_s": best_diag.get("dispatch_s"),
+            "fetch_s": best_diag.get("fetch_s"),
+            "pack_efficiency": best_diag.get("pack_efficiency"),
+            "real_returns": best_diag.get("real_returns"),
+            "padded_returns": best_diag.get("padded_returns"),
+            "kernel_cache": best_diag.get("kernel_cache"),
+            "per_bucket": best_diag.get("groups", [])}
 
 
 def main() -> int:
@@ -309,8 +347,15 @@ def main() -> int:
                          "JSON; '' disables)")
     args = ap.parse_args()
 
-    from jepsen_tpu import fixtures, models, obs
+    from jepsen_tpu import fixtures, models, obs, store
     from jepsen_tpu.checkers import reach, wgl_ref
+
+    # persistent compilation cache (ISSUE 3): a cold second process
+    # re-running bench.py loads every kernel geometry from disk instead
+    # of recompiling — first-iteration latency drops and
+    # compile_cache.hits > 0 lands in the output. JEPSEN_TPU_NO_PERSIST=1
+    # reverts to cacheless runs.
+    cc_dir = store.enable_compilation_cache()
 
     def _finish(out: dict, probe_engine) -> None:
         # the bench selects its engine explicitly — record it in the
@@ -318,7 +363,14 @@ def main() -> int:
         # attach the counters/ledger snapshot and write the trace
         obs.decision(str(probe_engine or args.engine), "selected",
                      cause="bench-cli", ops=args.ops)
-        out["obs"] = obs.snapshot()
+        snap = obs.snapshot()
+        out["obs"] = snap
+        counters = snap.get("counters", {})
+        out["compile_cache"] = {
+            "dir": cc_dir,
+            "hits": int(counters.get("compile_cache.hits", 0)),
+            "requests": int(counters.get("compile_cache.requests", 0)),
+        }
         if args.trace:
             try:
                 out["trace_file"] = obs.export_trace(args.trace)
@@ -364,10 +416,15 @@ def main() -> int:
             return wgl_native.check_packed(model, packed)
         return wgl_ref.check_packed(model, packed, time_limit=300)
 
-    # warm-up: first call pays jit compilation; the measurement is steady
-    # state (compile caches persist across runs of the same shapes).
+    # warm-up: first call pays jit compilation (or a persistent-cache
+    # load on a warm start — first_iter_s in the output is the number
+    # that drops when compile_cache.hits > 0); the measurement is
+    # steady state (compile caches persist across runs of the same
+    # shapes).
+    t1 = time.monotonic()
     with obs.span("bench.warm", engine=args.engine, ops=args.ops):
         res = run()
+    first_iter_s = time.monotonic() - t1
     if res["valid"] is not True:
         # the ledger explaining WHICH engine produced the bad verdict
         # (and what fell back en route) ships with the error too
@@ -401,6 +458,7 @@ def main() -> int:
         "unit": "ops/s",
         "vs_baseline": round(ops_per_s / baseline_floor, 2),
         "check_s": round(best, 3),
+        "first_iter_s": round(first_iter_s, 3),
         "gen_s": round(gen_s, 2),
         "engine": res.get("engine"),
         "valid": res.get("valid"),

@@ -129,6 +129,10 @@ class Linearizable(Checker):
         from jepsen_tpu.checkers.events import ConcurrencyOverflow
         from jepsen_tpu.models.memo import StateExplosion
 
+        # warm-start tier (ISSUE 3): wire the persistent compilation
+        # cache before ANY engine compiles, so every algorithm route —
+        # not just the reach entry points — starts warm on a recheck
+        reach._ensure_persistent_caches()
         model = _model_from(self.model, test)
         kw = dict(self.opts)
         if opts:

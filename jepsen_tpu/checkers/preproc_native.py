@@ -5,6 +5,14 @@ event-stream preprocessing (slot assignment + returns projection).
 :func:`returns_view` when the library builds, and falls back to its
 pure-Python scans otherwise (same contract as
 :mod:`jepsen_tpu.checkers.wgl_native` for the search itself).
+
+Thread-safety contract: the stateless entry points (everything except
+:class:`Monitor`, which owns mutable C++ state) take only caller-owned
+buffers and keep no globals beyond the loaded library handle, and
+ctypes releases the GIL for the call's duration — which is what lets
+the streaming prep thread (``reach._dispatch_lockstep_stream``) run
+:func:`build_keyed` per dispatch group while the main thread drives
+jax, with the two genuinely overlapping.
 """
 from __future__ import annotations
 

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import threading
 import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -407,6 +408,22 @@ def _warn_pallas_failed(err: str) -> None:
 
 
 @functools.cache
+def _ensure_persistent_caches() -> None:
+    """Once per process, at the first engine entry: point jax's
+    persistent compilation cache under the store dir
+    (:func:`jepsen_tpu.store.enable_compilation_cache`) so warm starts
+    skip XLA recompiles of every previously-seen kernel geometry.
+    Best-effort and opt-out (``JEPSEN_TPU_NO_PERSIST=1``); the
+    disk-backed memo tier (:func:`_disk_memo_get`) shares the same
+    root and switch."""
+    try:
+        from jepsen_tpu import store
+        store.enable_compilation_cache()
+    except Exception:                                   # noqa: BLE001
+        pass                            # persistence must never fail a check
+
+
+@functools.cache
 def _jitted_walk():
     import jax
     return jax.jit(_walk)
@@ -513,7 +530,14 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
         return build_memo(model, packed, max_states=max_states)
     with _MEMO_CACHE_LOCK:
         m = _MEMO_CACHE.get(sig)
+        if m is not None:
+            # LRU, not insertion order: a hit moves the entry to the
+            # MRU end, so a hot memo inserted early outlives cold
+            # recent ones when _cache_put evicts from the front
+            _MEMO_CACHE.pop(sig)
+            _MEMO_CACHE[sig] = m
     if m is None:
+        obs.count("memo_cache.miss")
         # superset fallback: random workloads give every key a slightly
         # different SUBSET of one underlying alphabet (a 100-op cas
         # history hits ~30 of 36 possible ops), so exact-signature
@@ -537,8 +561,13 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
             _cache_put(sig, canon)
             return m2
         canonical_ops = tuple(packed.distinct_ops[i] for i in order)
-        m = memo_ops(model, canonical_ops, max_states=max_states)
+        m = _disk_memo_get(sig, canonical_ops)
+        if m is None:
+            m = memo_ops(model, canonical_ops, max_states=max_states)
+            _disk_memo_put(sig, m)
         _cache_put(sig, m)
+    else:
+        obs.count("memo_cache.hit")
     # local op id i lives in canonical column lut[i]
     lut = np.empty(len(keys), np.int32)
     for col, i in enumerate(order):
@@ -559,8 +588,126 @@ def _cache_put(sig, m: Memo) -> None:
         return
     with _MEMO_CACHE_LOCK:
         if len(_MEMO_CACHE) >= _MEMO_CACHE_MAX:
+            # front = LRU end (hits re-append in _cached_memo)
             _MEMO_CACHE.pop(next(iter(_MEMO_CACHE)), None)
+            obs.count("memo_cache.evict")
         _MEMO_CACHE[sig] = m
+
+
+# -- disk tier below _MEMO_CACHE (ISSUE 3 persistent caches) ----------------
+#
+# Memo tables depend only on (model, alphabet, cap): a fresh process
+# re-checking the same workload re-ran the BFS for every alphabet it had
+# already enumerated. The disk tier persists the canonical-order memo
+# under the store dir (same root + opt-out as the compilation cache),
+# keyed by a digest of the model's class+repr, the cap, and the sorted
+# alphabet — so a changed model signature can never serve a stale table.
+# Same size gates as _cache_put: big memos are cheap to rebuild relative
+# to their footprint.
+
+_DISK_MEMO_VERSION = 1
+
+
+def _disk_memo_path(sig) -> Optional[Tuple[str, str]]:
+    """(path, signature-repr) for ``sig``'s disk entry, or None when
+    persistence is off. The repr is stored inside the pickle and
+    compared on load — a digest collision or a model whose repr
+    changed meaning can never alias. A model whose repr is the default
+    address-stamped ``<C object at 0x...>`` has no stable cross-process
+    signature: the tier is skipped for it (every process would mint a
+    fresh orphan entry that can never hit)."""
+    from jepsen_tpu import store
+    root = store.persist_root()
+    if root is None:
+        return None
+    import hashlib
+    model, max_states, keys = sig
+    model_rep = repr(model)
+    if model_rep.endswith(f"at {hex(id(model))}>"):
+        return None                     # default object repr: unstable
+    rep = repr((_DISK_MEMO_VERSION, type(model).__module__,
+                type(model).__qualname__, model_rep, max_states, keys))
+    name = hashlib.sha256(rep.encode()).hexdigest()[:40] + ".memo.pkl"
+    return os.path.join(root, "memo", name), rep
+
+
+def _disk_memo_get(sig, canonical_ops: Tuple[Op, ...]) -> Optional[Memo]:
+    """Load ``sig``'s memo from the disk tier. The stored table is in
+    canonical (sorted-alphabet) order — identical to what the in-memory
+    build would produce — and ``distinct_ops`` are replaced with THIS
+    history's op objects, mirroring the superset-projection care. The
+    stored MODEL OBJECT is compared by equality against the requester's
+    — the same relation the BFS itself keys states on — so a custom
+    ``__repr__`` that omits a behavior-affecting field (repr collision)
+    still cannot serve a stale table."""
+    import pickle
+    pr = _disk_memo_path(sig)
+    if pr is None:
+        return None
+    path, rep = pr
+    try:
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        if (payload.get("sig") != rep
+                or type(payload.get("model")) is not type(sig[0])
+                or payload.get("model") != sig[0]):
+            raise ValueError("memo signature mismatch")
+        m = payload["memo"]
+        m = Memo(table=m.table, states=m.states,
+                 distinct_ops=canonical_ops, initial=m.initial)
+    except FileNotFoundError:
+        obs.count("memo_cache.disk.miss")
+        return None
+    except Exception:                                   # noqa: BLE001
+        obs.count("memo_cache.disk.invalid")
+        try:
+            os.unlink(path)             # corrupt/stale entry: drop it
+        except OSError:
+            pass
+        return None
+    obs.count("memo_cache.disk.hit")
+    return m
+
+
+# entry-count cap for the disk memo dir: a fuzz/soak campaign mints a
+# fresh alphabet (→ a fresh entry) per random workload, and nothing
+# else ever deletes them — evict oldest-mtime past the cap on store
+_DISK_MEMO_MAX_ENTRIES = 512
+
+
+def _disk_memo_put(sig, m: Memo) -> None:
+    """Best-effort insert into the disk tier (atomic rename; a full or
+    read-only disk must never fail a check). Bounded: past
+    ``_DISK_MEMO_MAX_ENTRIES`` the oldest entries are evicted, so a
+    long soak cannot grow the tier monotonically."""
+    import pickle
+    if (m.table.nbytes > _MEMO_CACHE_MAX_ENTRY_BYTES
+            or m.n_states > _MEMO_CACHE_MAX_ENTRY_STATES):
+        return
+    pr = _disk_memo_path(sig)
+    if pr is None:
+        return
+    path, rep = pr
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump({"sig": rep, "model": sig[0], "memo": m}, f)
+        os.replace(tmp, path)
+        obs.count("memo_cache.disk.store")
+        d = os.path.dirname(path)
+        names = [n for n in os.listdir(d) if n.endswith(".memo.pkl")]
+        if len(names) > _DISK_MEMO_MAX_ENTRIES:
+            by_age = sorted(
+                names, key=lambda n: os.path.getmtime(os.path.join(d, n)))
+            for n in by_age[:len(names) - _DISK_MEMO_MAX_ENTRIES]:
+                try:
+                    os.unlink(os.path.join(d, n))
+                    obs.count("memo_cache.disk.evict")
+                except OSError:
+                    pass
+    except Exception:                                   # noqa: BLE001
+        pass
 
 
 # superset seeds: a few union-alphabet memos with precomputed
@@ -856,6 +1003,7 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                  memo: Optional[Memo] = None) -> Dict[str, Any]:
     import jax.numpy as jnp
 
+    _ensure_persistent_caches()
     t0 = _time.monotonic()
     if packed.n == 0 or packed.n_ok == 0:
         return {"valid": True, "engine": "reach", "events": 0,
@@ -1099,23 +1247,67 @@ def _check_many_keyed(model, rss, preps, live, results, packed_list,
     return results
 
 
-def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
-                live: Sequence[int], max_states: int, max_slots: int,
-                need_pallas: bool = True):
-    """Shared union-alphabet native preprocessing for the batched
-    device engines (keyed kernel and the lockstep batch kernel): ONE
-    memo over the union of every history's op alphabet + ONE native
-    call building every history's slotted return stream. Returns None
-    when the union explodes, ops are unhashable, the native lib is
-    missing, the kernels' dense budgets don't fit, or a history
-    overflows max_slots under the union memo's coarser noop
-    classification (callers fall back to per-history paths, whose
-    per-key noop dropping may still fit — and which raise
-    ConcurrencyOverflow on genuine overflow). ``need_pallas=False``
-    skips the Pallas VMEM gate for consumers that only run the XLA
-    walk (the mesh lane)."""
-    from jepsen_tpu.checkers import preproc_native
+class _UnionPrepA:
+    """Stage A of the split union prep (ISSUE 3 tentpole): everything
+    that must be built ONCE per batch — the union alphabet, its memo
+    and noop classification — plus each live key's packed arrays with
+    op ids remapped into the union alphabet, the inputs stage B's
+    per-group native packing (:func:`_union_pack_group`) consumes.
+    Pure host data; safe to share with the streaming prep thread."""
+    __slots__ = ("memo_u", "S_pad", "noop_op", "opids", "invs", "rets",
+                 "crs", "_P", "_cats", "pack_s")
 
+    def __init__(self, memo_u, S_pad, noop_op, opids, invs, rets, crs):
+        self.memo_u = memo_u
+        self.S_pad = S_pad
+        self.noop_op = noop_op
+        self.opids = opids
+        self.invs = invs
+        self.rets = rets
+        self.crs = crs
+        self._P = None
+        self._cats = None
+        # cumulative stage-B wall (native packing) over this batch —
+        # the synchronous scheduler's prep.wall_s base, so stream and
+        # sync report the SAME quantity (packing + marshalling)
+        self.pack_s = 0.0
+
+    def P(self) -> np.ndarray:
+        """Union transition tensor, built once on first use (streaming
+        and synchronous consumers share it)."""
+        if self._P is None:
+            self._P = _build_P(self.memo_u, self.S_pad)
+        return self._P
+
+    def cats(self):
+        """Full-batch concatenations ``(inv, ret, opid, crs, offs)``,
+        built once — the synchronous whole-batch stage B and the
+        result-assembly accounting both need them, and re-concatenating
+        per consumer was a multi-hundred-MB memcpy at 4096×100k."""
+        if self._cats is None:
+            offs = np.zeros(len(self.opids) + 1, np.int64)
+            for j in range(len(self.opids)):
+                offs[j + 1] = offs[j] + len(self.opids[j])
+            self._cats = (np.concatenate(self.invs),
+                          np.concatenate(self.rets),
+                          np.concatenate(self.opids),
+                          np.concatenate(self.crs), offs)
+        return self._cats
+
+    def drop_per_key(self) -> np.ndarray:
+        """Per-live-key count of dropped crashed-noop entries (the
+        events accounting of :func:`_union_results`)."""
+        return np.array(
+            [int((self.crs[j] & self.noop_op[self.opids[j]]).sum())
+             for j in range(len(self.opids))], np.int64)
+
+
+def _union_stage_a(model: Model,
+                   packed_list: Sequence[h.PackedHistory],
+                   live: Sequence[int],
+                   max_states: int) -> Optional["_UnionPrepA"]:
+    """Build stage A, or None when the union alphabet explodes or ops
+    are unhashable (callers fall back to per-history paths)."""
     union: Dict[Any, int] = {}
     union_ops: List[Op] = []
     try:
@@ -1133,10 +1325,8 @@ def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
     tbl = memo_u.table
     states = np.arange(tbl.shape[0], dtype=tbl.dtype)[:, None]
     noop_op = np.all((tbl == states) | (tbl == -1), axis=0)
-    # concatenate the keys' packed arrays, op ids remapped to union ids
     opids, invs, rets, crs = [], [], [], []
-    offs = np.zeros(len(live) + 1, np.int64)
-    for j, i in enumerate(live):
+    for i in live:
         p = packed_list[i]
         keys = h.op_keys_of(p)
         lut = np.fromiter((union[k] for k in keys), np.int32,
@@ -1145,33 +1335,87 @@ def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
         invs.append(p.inv_ev)
         rets.append(p.ret_ev)
         crs.append(p.crashed)
-        offs[j + 1] = offs[j] + p.n
-    opid_cat = np.concatenate(opids)
-    crs_cat = np.concatenate(crs)
+    return _UnionPrepA(memo_u, S_pad, noop_op, opids, invs, rets, crs)
+
+
+def _union_pack_group(sa: "_UnionPrepA", sel: Sequence[int],
+                      max_slots: int):
+    """Stage B: native packing (``preproc_native.build_keyed``) of the
+    keys at positions ``sel`` of the live axis — per dispatch group in
+    the streaming pipeline, or all live keys at once on the
+    synchronous path. Returns ``(ret_flat, ops_flat, key_W, key_R,
+    offsets, W)`` or None (native lib missing, or slot overflow under
+    the union memo's coarser noop classification — union-noop ⊆
+    per-key-noop, so a key near the max_slots boundary can overflow
+    here yet fit the general per-key path; genuine overflow raises
+    ConcurrencyOverflow from the per-key build later). Host-only work
+    (numpy + the GIL-releasing native lib): safe on the prep thread."""
+    from jepsen_tpu.checkers import preproc_native
+
+    t0 = _time.monotonic()
+    sel = list(sel)
+    if sel == list(range(len(sa.opids))):
+        # whole-batch selection (the synchronous path): reuse stage
+        # A's cached concatenations instead of re-building them
+        inv_c, ret_c, opid_c, crs_c, offs = sa.cats()
+    else:
+        offs = np.zeros(len(sel) + 1, np.int64)
+        for j, k in enumerate(sel):
+            offs[j + 1] = offs[j] + len(sa.opids[k])
+        inv_c = np.concatenate([sa.invs[k] for k in sel])
+        ret_c = np.concatenate([sa.rets[k] for k in sel])
+        opid_c = np.concatenate([sa.opids[k] for k in sel])
+        crs_c = np.concatenate([sa.crs[k] for k in sel])
     built = preproc_native.build_keyed(
-        offs, np.concatenate(invs), np.concatenate(rets), opid_cat,
-        crs_cat, noop_op, max_slots, max_slots)
+        offs, inv_c, ret_c, opid_c, crs_c,
+        sa.noop_op, max_slots, max_slots)
+    sa.pack_s += _time.monotonic() - t0
     if built is None:
         return None
-    ret_flat, ops_wide, pend, key_W, key_R, ret_entry_flat, R_tot = built
+    ret_flat, ops_wide, _pend, key_W, key_R, _ret_entry, _R_tot = built
     if (key_W < 0).any():
-        # slot overflow under the UNION memo's noop classification —
-        # which drops a SUBSET of what per-key memos drop (union-noop
-        # ⊆ per-key-noop), so a key near the max_slots boundary can
-        # overflow here yet fit the general per-key path. Fall through
-        # and let per-key noop dropping get its chance; if the history
-        # genuinely needs more slots, the per-key build raises
-        # ConcurrencyOverflow there.
         return None
     W = max(int(key_W.max()), 1)
+    ops_flat = np.ascontiguousarray(ops_wide[:, :W])
+    offsets = np.concatenate([[0], np.cumsum(key_R)])
+    return ret_flat, ops_flat, key_W, key_R, offsets, W
+
+
+def _union_prep(model: Model, packed_list: Sequence[h.PackedHistory],
+                live: Sequence[int], max_states: int, max_slots: int,
+                need_pallas: bool = True,
+                stage_a: Optional["_UnionPrepA"] = None):
+    """Shared union-alphabet native preprocessing for the batched
+    device engines (keyed kernel and the lockstep batch kernel): ONE
+    memo over the union of every history's op alphabet + ONE native
+    call building every history's slotted return stream — composed
+    from the stage A / stage B split the streaming pipeline reuses
+    per-group (a prebuilt ``stage_a`` skips the union BFS, so a
+    streaming→synchronous fallback never pays it twice). Returns None
+    when the union explodes, ops are unhashable, the native lib is
+    missing, the kernels' dense budgets don't fit, or a history
+    overflows max_slots under the union memo's coarser noop
+    classification (callers fall back to per-history paths, whose
+    per-key noop dropping may still fit — and which raise
+    ConcurrencyOverflow on genuine overflow). ``need_pallas=False``
+    skips the Pallas VMEM gate for consumers that only run the XLA
+    walk (the mesh lane)."""
+    sa = stage_a if stage_a is not None else _union_stage_a(
+        model, packed_list, live, max_states)
+    if sa is None:
+        return None
+    g = _union_pack_group(sa, range(len(live)), max_slots)
+    if g is None:
+        return None
+    ret_flat, ops_flat, key_W, key_R, offsets, W = g
     M = 1 << W
+    memo_u, S_pad, noop_op = sa.memo_u, sa.S_pad, sa.noop_op
     if not (_fast_ok(S_pad, W, M, memo_u.n_ops)
             and (not need_pallas
                  or _pallas_fits(S_pad, M, memo_u.n_ops))):
         return None                     # general path may still fit
-    ops_flat = np.ascontiguousarray(ops_wide[:, :W])
-    offsets = np.concatenate([[0], np.cumsum(key_R)])
-    P = _build_P(memo_u, S_pad)
+    _inv_c, _ret_c, opid_cat, crs_cat, offs = sa.cats()
+    P = sa.P()
     return (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
             offsets, opid_cat, crs_cat, offs, noop_op)
 
@@ -1226,6 +1470,7 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
     falls through to the single-device route below and its per-history
     fallbacks, rather than raising where ``devices=None`` would have
     succeeded."""
+    _ensure_persistent_caches()
     if devices is not None and len(devices) > 1:
         try:
             return check_many(model, packed_list, max_states=max_states,
@@ -1262,9 +1507,22 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
     if not live:
         return results  # type: ignore[return-value]
     u = None
+    sa = None
     from jepsen_tpu.checkers import preproc_native
     if _use_pallas() and preproc_native.available() and len(live) >= 2:
-        u = _union_prep(model, packed_list, live, max_states, max_slots)
+        sa = _union_stage_a(model, packed_list, live, max_states)
+        if sa is not None:
+            if _stream_prep_enabled():
+                # tentpole path: per-group packing streams from a prep
+                # thread while earlier groups walk on device
+                out = _check_lockstep_stream(
+                    "reach-lockstep", model, packed_list, live, sa,
+                    max_states, max_slots, max_dense,
+                    group or _BATCH_GROUP, diag, t0)
+                if out is not None:
+                    return out
+            u = _union_prep(model, packed_list, live, max_states,
+                            max_slots, stage_a=sa)
     if u is None:
         # the ISSUE-named silent degradation point: the lockstep batch
         # quietly became H sequential per-history checks
@@ -1287,7 +1545,8 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
         groups = reach_batch.plan_buckets(
             [int(r) for r in key_R], W, group=group)
         dead = _dispatch_lockstep_groups(
-            P, ret_flat, ops_flat, offsets, groups, M, len(live), diag)
+            P, ret_flat, ops_flat, offsets, groups, M, len(live), diag,
+            prep_base_s=sa.pack_s if sa is not None else 0.0)
     except Exception as e:                              # noqa: BLE001
         _warn_pallas_failed(repr(e))
         obs.engine_fallback("reach-lockstep", type(e).__name__,
@@ -1304,6 +1563,22 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
                           max_dense)
 
 
+def _union_stage_a_shared(model: Model, packed_list, live,
+                          max_states: int, u_box: Optional[dict]
+                          ) -> Optional["_UnionPrepA"]:
+    """One :func:`_union_stage_a` per ``check_many`` call, shared by
+    the streaming pipeline, the synchronous lockstep lane, and the
+    keyed lane (the union BFS is the expensive half of the old
+    monolithic prep — a streaming→synchronous fallback must not pay
+    it twice). Caches the result — including a failed (None) one."""
+    if u_box is not None and "sa" in u_box:
+        return u_box["sa"]
+    sa = _union_stage_a(model, packed_list, live, max_states)
+    if u_box is not None:
+        u_box["sa"] = sa
+    return sa
+
+
 def _union_prep_shared(model: Model, packed_list, live,
                        max_states: int, max_slots: int,
                        u_box: Optional[dict]):
@@ -1312,10 +1587,14 @@ def _union_prep_shared(model: Model, packed_list, live,
     need_pallas=True)`` preps, so when the first lane declines (or its
     kernel fails) the second must not pay the union-alphabet BFS +
     native build again (~2 s of host time at 4096 keys). ``u_box``
-    caches the result — including a failed (None) prep."""
+    caches the result — including a failed (None) prep — and reuses a
+    cached stage A from the streaming attempt."""
     if u_box is not None and "u" in u_box:
         return u_box["u"]
-    u = _union_prep(model, packed_list, live, max_states, max_slots)
+    sa = _union_stage_a_shared(model, packed_list, live, max_states,
+                               u_box)
+    u = None if sa is None else _union_prep(
+        model, packed_list, live, max_states, max_slots, stage_a=sa)
     if u_box is not None:
         u_box["u"] = u
     return u
@@ -1396,16 +1675,34 @@ def _union_results(engine: str, model: Model,
                    live: Sequence[int], dead_local: np.ndarray, u,
                    elapsed: float, max_states: int, max_slots: int,
                    max_dense: int) -> List[Dict[str, Any]]:
-    """Assemble per-history results from union-geometry verdicts —
-    shared by the keyed and lockstep lanes of :func:`check_many` and
-    by :func:`check_batch`. ``dead_local[k]`` is live history k's
-    LOCAL dead return index (-1 = linearizable). Valid histories are
-    answered from the union accounting; the rare failed history
-    decodes in its OWN geometry with the full witness pipeline."""
+    """Assemble per-history results from a full :func:`_union_prep`
+    tuple — thin adapter over :func:`_union_results_parts` for the
+    keyed/lockstep/mesh lanes that carry one."""
     (memo_u, _S_pad, _P, _W, _M, _ret_flat, _ops_flat, key_W, key_R,
      _offsets, opid_cat, crs_cat, offs, noop_op) = u
     drop_cat = (crs_cat & noop_op[opid_cat]).astype(np.int64)
     drop_per_key = np.add.reduceat(drop_cat, offs[:-1])
+    return _union_results_parts(engine, model, packed_list, live,
+                                dead_local, memo_u, key_W, key_R,
+                                drop_per_key, elapsed, max_states,
+                                max_slots, max_dense)
+
+
+def _union_results_parts(engine: str, model: Model,
+                         packed_list: Sequence[h.PackedHistory],
+                         live: Sequence[int], dead_local: np.ndarray,
+                         memo_u: Memo, key_W, key_R,
+                         drop_per_key: np.ndarray, elapsed: float,
+                         max_states: int, max_slots: int,
+                         max_dense: int) -> List[Dict[str, Any]]:
+    """Assemble per-history results from union-geometry verdicts —
+    shared by the keyed and lockstep lanes of :func:`check_many`, by
+    :func:`check_batch`, and by the streaming pipeline (which carries
+    per-group ``key_W``/``key_R`` instead of a prep tuple).
+    ``dead_local[k]`` is live history k's LOCAL dead return index
+    (-1 = linearizable). Valid histories are answered from the union
+    accounting; the rare failed history decodes in its OWN geometry
+    with the full witness pipeline."""
     results: List[Optional[Dict[str, Any]]] = [
         {"valid": True, "engine": engine, "events": 0,
          "time-s": 0.0} if (packed_list[i].n == 0
@@ -1444,43 +1741,19 @@ def _union_results(engine: str, model: Model,
 _LOCKSTEP_PIPE_DEPTH = 1
 
 
-def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
-                              M: int, n_live: int,
-                              diag: Optional[dict] = None) -> np.ndarray:
-    """Bucketed, pipelined lockstep dispatch: each group in ``groups``
-    (index lists into the live-key axis, from
-    :func:`reach_batch.plan_buckets`) walks the batch kernel in its own
-    geometry; group g+1's walk is QUEUED before group g's verdicts are
-    fetched, so host marshalling/compiles overlap device walks. The
-    per-geometry compiled-kernel cache (``reach_batch._batch_call``)
-    makes repeated geometries free across groups and calls. Fills
-    ``diag`` (when given) with per-group geometry, pack efficiency
-    (real vs padded returns), and kernel-cache counters. Returns the
-    per-live-key local dead indices."""
+def _lockstep_accounting(gdiags: List[dict], prep_s: float,
+                         hidden_s: float, stall_s: float,
+                         dispatch_s: float, fetch_s: float, mode: str,
+                         queue_hwm: int,
+                         diag: Optional[dict]) -> None:
+    """Shared obs/diag accounting tail of the synchronous and streaming
+    lockstep schedulers: pack efficiency, kernel-cache counters, and
+    the prep/dispatch/fetch wall breakdown. ``prep.hidden_s`` is the
+    prep wall time that did NOT extend the critical path (prep minus
+    the consumer's queue stalls) — the overlap win as ONE tracked
+    number; on the synchronous path it is 0 by construction."""
     from jepsen_tpu.checkers import reach_batch
 
-    dead = np.full(n_live, -1, np.int64)
-    inflight: List = []
-
-    def _drain(limit: int) -> None:
-        while len(inflight) > limit:
-            g0, fl0 = inflight.pop(0)
-            with obs.span("lockstep.collect", lanes=len(g0)):
-                dead[np.asarray(g0, np.int64)] = \
-                    reach_batch.collect_returns_batch(fl0)
-
-    gdiags: List[dict] = []
-    for g in groups:
-        with obs.span("lockstep.dispatch", lanes=len(g)):
-            fl = reach_batch.dispatch_returns_batch(
-                P,
-                [ret_flat[offsets[k]:offsets[k + 1]] for k in g],
-                [ops_flat[offsets[k]:offsets[k + 1]] for k in g],
-                M)
-        gdiags.append(reach_batch.group_diag(fl.geom, fl.R_lens))
-        inflight.append((g, fl))
-        _drain(_LOCKSTEP_PIPE_DEPTH)
-    _drain(0)
     real = sum(d["real_returns"] for d in gdiags)
     padded = sum(d["padded_returns"] for d in gdiags)
     cache = reach_batch.kernel_cache_info()
@@ -1494,13 +1767,302 @@ def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
     obs.gauge("lockstep.kernel_cache.hits", cache["hits"])
     obs.gauge("lockstep.kernel_cache.misses", cache["misses"])
     obs.gauge("lockstep.kernel_cache.entries", cache["entries"])
+    obs.gauge("prep.wall_s", round(prep_s, 6))
+    obs.gauge("prep.hidden_s", round(hidden_s, 6))
+    obs.gauge("prep.stall_s", round(stall_s, 6))
+    obs.gauge("prep.queue_depth_max", queue_hwm)
+    obs.gauge("prep.mode", mode)
     if diag is not None:
         diag["groups"] = gdiags
         diag["real_returns"] = real
         diag["padded_returns"] = padded
         diag["pack_efficiency"] = round(real / max(padded, 1), 4)
         diag["kernel_cache"] = cache
+        diag["dispatch_s"] = round(dispatch_s, 6)
+        diag["fetch_s"] = round(fetch_s, 6)
+        diag["prep"] = {"mode": mode, "wall_s": round(prep_s, 6),
+                        "hidden_s": round(hidden_s, 6),
+                        "stall_s": round(stall_s, 6),
+                        "queue_depth_max": queue_hwm,
+                        "groups": len(gdiags)}
+
+
+def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
+                              M: int, n_live: int,
+                              diag: Optional[dict] = None,
+                              prep_base_s: float = 0.0) -> np.ndarray:
+    """Bucketed, pipelined lockstep dispatch (the SYNCHRONOUS
+    scheduler — the streaming pipeline's fallback and the verdict
+    reference of its differential tests): each group in ``groups``
+    (index lists into the live-key axis, from
+    :func:`reach_batch.plan_buckets`) walks the batch kernel in its own
+    geometry; group g+1's walk is QUEUED before group g's verdicts are
+    fetched, so host marshalling/compiles overlap device walks. The
+    per-geometry compiled-kernel cache (``reach_batch._batch_call``)
+    makes repeated geometries free across groups and calls. Fills
+    ``diag`` (when given) with per-group geometry, pack efficiency
+    (real vs padded returns), kernel-cache counters, and the
+    prep/dispatch/fetch wall breakdown. Returns the per-live-key local
+    dead indices."""
+    from jepsen_tpu.checkers import reach_batch
+
+    dead = np.full(n_live, -1, np.int64)
+    inflight: List = []
+    # prep_base_s carries the caller's stage-B packing wall
+    # (sa.pack_s) so sync prep.wall_s covers packing + marshalling —
+    # the same quantity the streaming scheduler reports
+    prep_s = prep_base_s
+    dispatch_s = fetch_s = 0.0
+
+    def _drain(limit: int) -> None:
+        nonlocal fetch_s
+        while len(inflight) > limit:
+            g0, fl0 = inflight.pop(0)
+            t0 = _time.monotonic()
+            with obs.span("lockstep.collect", lanes=len(g0)):
+                dead[np.asarray(g0, np.int64)] = \
+                    reach_batch.collect_returns_batch(fl0)
+            fetch_s += _time.monotonic() - t0
+
+    gdiags: List[dict] = []
+    for g in groups:
+        t0 = _time.monotonic()
+        with obs.span("lockstep.prep", lanes=len(g)):
+            prep = reach_batch.prepare_returns_batch(
+                P,
+                [ret_flat[offsets[k]:offsets[k + 1]] for k in g],
+                [ops_flat[offsets[k]:offsets[k + 1]] for k in g],
+                M)
+        t1 = _time.monotonic()
+        prep_s += t1 - t0
+        with obs.span("lockstep.dispatch", lanes=len(g)):
+            fl = reach_batch.dispatch_prepared(prep)
+        dispatch_s += _time.monotonic() - t1
+        gdiags.append(reach_batch.group_diag(fl.geom, fl.R_lens))
+        inflight.append((g, fl))
+        _drain(_LOCKSTEP_PIPE_DEPTH)
+    _drain(0)
+    _lockstep_accounting(gdiags, prep_s, 0.0, 0.0, dispatch_s, fetch_s,
+                         "sync", 0, diag)
     return dead
+
+
+# bounded handoff between the streaming prep thread and the dispatch
+# loop: depth 2 keeps one marshalled group waiting while another packs,
+# without pinning unbounded host operand sets in memory
+_PREP_QUEUE_DEPTH = 2
+
+
+def _stream_prep_enabled() -> bool:
+    """The streaming prep→dispatch pipeline is on by default wherever
+    the lockstep lane runs; ``JEPSEN_TPU_NO_STREAM_PREP=1`` forces the
+    synchronous scheduler (consulted per call — tests toggle it)."""
+    return not os.environ.get("JEPSEN_TPU_NO_STREAM_PREP")
+
+
+def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
+                              max_slots: int, n_live: int,
+                              diag: Optional[dict]):
+    """Streaming producer/consumer lockstep scheduler (the ISSUE 3
+    tentpole): a background prep thread runs per-group native packing
+    (:func:`_union_pack_group`) and operand marshalling
+    (:func:`reach_batch.prepare_returns_batch`) and feeds this thread
+    through a bounded queue — group 0 walks on device while groups
+    1..G are still being packed, extending the
+    ``dispatch_returns_batch``/``collect_returns_batch`` split
+    upstream into host prep. All jax work (device puts, compiles,
+    dispatches, fetches) stays on the calling thread; the producer
+    touches only numpy and the GIL-releasing native lib, so the two
+    genuinely overlap.
+
+    Returns ``(dead, key_W, key_R)`` over the live axis, or None when
+    the producer declined (slot overflow / budget gates) or raised —
+    the caller falls back to the synchronous path, reusing stage A, so
+    verdicts stay bit-identical by construction. Exactly one
+    ``stream-prep`` fallback lands in the obs ledger on that path, and
+    the queue is drained so the producer can never deadlock on a full
+    queue. Overlap efficiency is tracked: ``prep.wall_s`` (total prep
+    thread work) vs ``prep.hidden_s`` (prep time that did not extend
+    the critical path — wall minus the consumer's queue stalls)."""
+    import queue as _queue
+
+    from jepsen_tpu.checkers import reach_batch
+
+    P = sa.P()
+    q: "_queue.Queue" = _queue.Queue(maxsize=_PREP_QUEUE_DEPTH)
+    stop = threading.Event()
+    prep_wall = [0.0]
+    queue_hwm = [0]
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _producer() -> None:
+        try:
+            for gi, g in enumerate(groups):
+                if stop.is_set():
+                    return
+                t0 = _time.monotonic()
+                built = _union_pack_group(sa, g, max_slots)
+                if built is None:
+                    _put(("decline", gi, None))
+                    return
+                ret_flat, ops_flat, key_W, key_R, offsets, W = built
+                M = 1 << W
+                if not (_fast_ok(sa.S_pad, W, M, sa.memo_u.n_ops)
+                        and _pallas_fits(sa.S_pad, M, sa.memo_u.n_ops)):
+                    _put(("decline", gi, None))
+                    return
+                prep = reach_batch.prepare_returns_batch(
+                    P,
+                    [ret_flat[offsets[k]:offsets[k + 1]]
+                     for k in range(len(g))],
+                    [ops_flat[offsets[k]:offsets[k + 1]]
+                     for k in range(len(g))],
+                    M)
+                prep_wall[0] += _time.monotonic() - t0
+                if not _put(("group", gi, (prep, key_W, key_R))):
+                    return
+                queue_hwm[0] = max(queue_hwm[0], q.qsize())
+            _put(("done", -1, None))
+        except BaseException as e:                      # noqa: BLE001
+            _put(("error", -1, e))
+
+    dead = np.full(n_live, -1, np.int64)
+    key_W_full = np.zeros(n_live, np.int32)
+    key_R_full = np.zeros(n_live, np.int32)
+    inflight: List = []
+    gdiags: List[dict] = []
+    stall_s = dispatch_s = fetch_s = 0.0
+    failure: Optional[Tuple[str, Any]] = None
+
+    def _drain_inflight(limit: int) -> None:
+        nonlocal fetch_s
+        while len(inflight) > limit:
+            g0, fl0 = inflight.pop(0)
+            t0 = _time.monotonic()
+            with obs.span("lockstep.collect", lanes=len(g0)):
+                dead[np.asarray(g0, np.int64)] = \
+                    reach_batch.collect_returns_batch(fl0)
+            fetch_s += _time.monotonic() - t0
+
+    th = threading.Thread(target=_producer, name="jepsen-stream-prep",
+                          daemon=True)
+    th.start()
+    try:
+        while True:
+            t0 = _time.monotonic()
+            kind, gi, payload = q.get()
+            stall_s += _time.monotonic() - t0
+            if kind == "done":
+                break
+            if kind in ("decline", "error"):
+                failure = (kind, payload)
+                break
+            prep, key_W, key_R = payload
+            g = groups[gi]
+            t0 = _time.monotonic()
+            with obs.span("lockstep.dispatch", lanes=len(g),
+                          streamed=True):
+                fl = reach_batch.dispatch_prepared(prep)
+            dispatch_s += _time.monotonic() - t0
+            gdiags.append(reach_batch.group_diag(fl.geom, fl.R_lens))
+            idx = np.asarray(g, np.int64)
+            key_W_full[idx] = key_W
+            key_R_full[idx] = key_R
+            inflight.append((g, fl))
+            _drain_inflight(_LOCKSTEP_PIPE_DEPTH)
+        if failure is None:
+            _drain_inflight(0)
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except _queue.Empty:
+            pass
+        th.join(timeout=30.0)
+        if th.is_alive():
+            # producer stuck inside a native pack: it is a daemon and
+            # touches only its own buffers (plus the cumulative
+            # sa.pack_s accounting), so abandoning it is safe — but a
+            # leaked thread racing the synchronous fallback's packing
+            # must never be invisible
+            obs.count("prep.thread_abandoned")
+            obs.decision("stream-prep", "abandoned-thread",
+                         groups=len(groups))
+            logging.getLogger("jepsen.reach").warning(
+                "streaming prep thread still running after 30s join; "
+                "abandoning it (daemon) and continuing")
+    if failure is not None:
+        kind, err = failure
+        cause = type(err).__name__ if kind == "error" else "declined"
+        # the ISSUE-mandated record: a prep-thread failure degrades to
+        # the synchronous path exactly once, never silently
+        obs.engine_fallback("stream-prep", cause, groups=len(groups))
+        if kind == "error":
+            logging.getLogger("jepsen.reach").warning(
+                "streaming prep failed (%r); falling back to the "
+                "synchronous lockstep path", err, exc_info=err)
+        return None
+    hidden_s = max(0.0, prep_wall[0] - stall_s)
+    _lockstep_accounting(gdiags, prep_wall[0], hidden_s, stall_s,
+                         dispatch_s, fetch_s, "stream", queue_hwm[0],
+                         diag)
+    obs.count("prep.streamed_groups", len(gdiags))
+    return dead, key_W_full, key_R_full
+
+
+def _check_lockstep_stream(engine: str, model: Model,
+                           packed_list: Sequence[h.PackedHistory],
+                           live: Sequence[int], sa: "_UnionPrepA",
+                           max_states: int, max_slots: int,
+                           max_dense: int, group: int,
+                           diag: Optional[dict], t0: float
+                           ) -> Optional[List[Dict[str, Any]]]:
+    """Run the streaming lockstep pipeline end to end: plan bucket
+    groups from the per-key return counts (every non-crashed entry
+    returns exactly once, so ``n_ok`` IS the return count — known
+    before any native build), stream prep→dispatch, assemble results.
+    Returns None when there is nothing to overlap (single group) or
+    the pipeline fell back — the caller then runs the synchronous
+    path on the same stage A, so verdicts are bit-identical."""
+    from jepsen_tpu.checkers import reach_batch
+
+    lens = [int(packed_list[i].n_ok) for i in live]
+    # the planner's floor only needs a width HINT (a coarser floor
+    # splits small keys into more groups — suboptimal packing, never
+    # incorrect); the true union W is only known after native packing
+    groups = reach_batch.plan_buckets(lens, max_slots, group=group)
+    if len(groups) < 2:
+        return None         # nothing to hide — synchronous is simpler
+    try:
+        r = _dispatch_lockstep_stream(sa, groups, max_slots, len(live),
+                                      diag)
+    except Exception as e:                              # noqa: BLE001
+        # dispatch-side failure: recorded, then the synchronous path
+        # gets its chance (and takes the existing per-history
+        # fallbacks if it fails the same way)
+        obs.engine_fallback("stream-prep", type(e).__name__,
+                            groups=len(groups))
+        logging.getLogger("jepsen.reach").warning(
+            "streaming lockstep dispatch failed (%r); retrying the "
+            "synchronous path", e)
+        return None
+    if r is None:
+        return None
+    dead, key_W, key_R = r
+    elapsed = _time.monotonic() - t0
+    return _union_results_parts(engine, model, packed_list, live, dead,
+                                sa.memo_u, key_W, key_R,
+                                sa.drop_per_key(), elapsed, max_states,
+                                max_slots, max_dense)
 
 
 def _check_many_lockstep(model: Model,
@@ -1532,6 +2094,18 @@ def _check_many_lockstep(model: Model,
         return None
     if sum(packed_list[i].n_ok for i in live) < _PALLAS_MIN_RETURNS:
         return None
+    if _stream_prep_enabled():
+        sa = _union_stage_a_shared(model, packed_list, live, max_states,
+                                   u_box)
+        if sa is None:
+            if u_box is not None:
+                u_box["u"] = None       # stage A failure implies no u
+            return None
+        out = _check_lockstep_stream(
+            "reach-lockstep", model, packed_list, live, sa, max_states,
+            max_slots, max_dense, group or _BATCH_GROUP, diag, t0)
+        if out is not None:
+            return out
     u = _union_prep_shared(model, packed_list, live, max_states,
                            max_slots, u_box)
     if u is None:
@@ -1541,9 +2115,11 @@ def _check_many_lockstep(model: Model,
      offsets, _opid_cat, _crs_cat, _offs, _noop_op) = u
     groups = reach_batch.plan_buckets(
         [int(r) for r in key_R], W, group=group or _BATCH_GROUP)
+    sa_box = (u_box or {}).get("sa")
     try:
         dead = _dispatch_lockstep_groups(
-            P, ret_flat, ops_flat, offsets, groups, M, len(live), diag)
+            P, ret_flat, ops_flat, offsets, groups, M, len(live), diag,
+            prep_base_s=sa_box.pack_s if sa_box is not None else 0.0)
     except Exception as e:                              # noqa: BLE001
         _warn_pallas_failed(f"lockstep: {e!r}")
         return None
@@ -1673,6 +2249,7 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
     geometry, pack efficiency, and kernel-cache counters."""
     import jax.numpy as jnp
 
+    _ensure_persistent_caches()
     t0 = _time.monotonic()
     if should_abort is not None and should_abort():
         return [{"valid": "unknown", "cause": "aborted",
@@ -1874,6 +2451,7 @@ def check_chunked(model: Model, history: Sequence[Op] = (), *,
     (register-family models). Requires ``D**2 <= max_matrix``."""
     import jax.numpy as jnp
 
+    _ensure_persistent_caches()
     t0 = _time.monotonic()
     if packed is None:
         packed = h.pack(history)

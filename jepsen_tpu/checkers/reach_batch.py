@@ -63,6 +63,12 @@ from jepsen_tpu.checkers.reach_lane import (_BLOCK, _FAST_PASSES,
 # medians equal) and keeps its own 4.
 _PIPE_NSEG = 8
 
+# default for the ``interpret`` flag of the marshal/dispatch entry
+# points when the caller passes None: tests flip this to route EVERY
+# dispatch — including the streaming prep pipeline's, whose scheduler
+# never threads an interpret argument — through interpret mode on CPU
+_INTERPRET_DEFAULT = False
+
 # SMEM byte budget for the double-buffered slot_ops window
 # (B*H*W i32 ×2 buffers). The chip holds 1 MB of SMEM: the H=32,
 # B=1024 geometry needed 1.31 MB and failed to compile while 0.655 MB
@@ -473,20 +479,57 @@ class BatchInflight:
         self.interpret = interpret
 
 
-def dispatch_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
-                           slot_ops: List[np.ndarray], M: int, *,
-                           interpret: bool = False) -> BatchInflight:
-    """Marshal + queue the lockstep walk of H return streams without
-    fetching anything. Pair with :func:`collect_returns_batch`."""
+class BatchPrepared:
+    """Marshalled-but-undispatched lockstep operands for one group:
+    the output of :func:`prepare_returns_batch` (pure host work — numpy
+    interleaving plus geometry; safe to run on the streaming prep
+    thread, no jax calls), consumed by :func:`dispatch_prepared` on the
+    dispatching thread. The prepare/dispatch split is what lets the
+    streaming pipeline pack group g+1 while group g walks on device."""
+    __slots__ = ("P", "geom", "host_args", "R_lens", "interpret")
+
+    def __init__(self, P, geom, host_args, R_lens, interpret):
+        self.P = P
+        self.geom = geom
+        self.host_args = host_args
+        self.R_lens = R_lens
+        self.interpret = interpret
+
+
+def prepare_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
+                          slot_ops: List[np.ndarray], M: int, *,
+                          interpret: Optional[bool] = None
+                          ) -> BatchPrepared:
+    """Host-only half of :func:`dispatch_returns_batch`: marshal H
+    return streams into the lockstep layout without touching jax."""
+    if interpret is None:
+        interpret = _INTERPRET_DEFAULT
     geom, host_args, R_lens = pack_batch_operands(
         P, ret_slots, slot_ops, M, interpret=interpret)
-    W = geom[1]
+    return BatchPrepared(P, geom, host_args, R_lens, interpret)
+
+
+def dispatch_prepared(prep: BatchPrepared) -> BatchInflight:
+    """Queue a prepared group's walk (device puts + compiles +
+    dispatches — all jax work) without fetching anything. Pair with
+    :func:`collect_returns_batch`."""
+    W = prep.geom[1]
     n_fast = min(W, _FAST_PASSES)
     dsegs: dict = {}
-    ckpts, final = _pipe_walk_b(host_args, geom, n_fast, interpret,
-                                dsegs)
-    return BatchInflight(P, geom, host_args, R_lens, dsegs, ckpts,
-                         final, interpret)
+    ckpts, final = _pipe_walk_b(prep.host_args, prep.geom, n_fast,
+                                prep.interpret, dsegs)
+    return BatchInflight(prep.P, prep.geom, prep.host_args, prep.R_lens,
+                         dsegs, ckpts, final, prep.interpret)
+
+
+def dispatch_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
+                           slot_ops: List[np.ndarray], M: int, *,
+                           interpret: Optional[bool] = None
+                           ) -> BatchInflight:
+    """Marshal + queue the lockstep walk of H return streams without
+    fetching anything. Pair with :func:`collect_returns_batch`."""
+    return dispatch_prepared(prepare_returns_batch(
+        P, ret_slots, slot_ops, M, interpret=interpret))
 
 
 def collect_returns_batch(fl: BatchInflight) -> np.ndarray:

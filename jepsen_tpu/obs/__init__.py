@@ -29,13 +29,15 @@ the trace-viewer workflow.
 from jepsen_tpu.obs.core import (Capture, Recorder, capture,
                                  checker_swallowed, count, counters,
                                  decision, enabled, engine_fallback,
-                                 engine_selected, gauge, reset, span)
+                                 engine_selected, gauge, gauges, reset,
+                                 span)
 from jepsen_tpu.obs.trace import (export_jsonl, export_trace, load_any,
                                   snapshot, trace_events)
 
 __all__ = [
     "Capture", "Recorder", "capture", "checker_swallowed", "count",
     "counters", "decision", "enabled", "engine_fallback",
-    "engine_selected", "gauge", "reset", "span", "export_jsonl",
-    "export_trace", "load_any", "snapshot", "trace_events",
+    "engine_selected", "gauge", "gauges", "reset", "span",
+    "export_jsonl", "export_trace", "load_any", "snapshot",
+    "trace_events",
 ]

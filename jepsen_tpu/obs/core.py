@@ -215,6 +215,12 @@ def counters() -> Dict[str, float]:
     return GLOBAL.snapshot()["counters"]
 
 
+def gauges() -> Dict[str, Any]:
+    """Snapshot of the process-global gauges (e.g. the streaming
+    pipeline's ``prep.wall_s`` / ``prep.hidden_s`` overlap figures)."""
+    return GLOBAL.snapshot()["gauges"]
+
+
 # -- engine-decision ledger ---------------------------------------------
 
 def decision(stage: str, event: str, cause: Optional[str] = None,

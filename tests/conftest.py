@@ -4,6 +4,14 @@ Must run before jax is imported anywhere."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Persistent warm-start caches (jax compilation cache + disk memo tier)
+# default OFF for the suite: they would litter ./store/.cache under the
+# repo and couple test timings to disk state. Tests that cover
+# persistence opt back in explicitly (monkeypatch.delenv + a tmp
+# JEPSEN_TPU_CACHE_DIR, or a subprocess with its own env).
+os.environ.setdefault("JEPSEN_TPU_NO_PERSIST", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

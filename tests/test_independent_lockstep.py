@@ -22,15 +22,14 @@ needs_native = pytest.mark.skipif(
 
 def _force_lockstep(monkeypatch):
     """Route check_many's lockstep lane on CPU: pallas gates open,
-    return floor off, batch kernel in interpret mode (the scheduler
-    never passes ``interpret`` itself, so wrapping the dispatch entry
-    forces it everywhere)."""
+    return floor off, batch kernel in interpret mode. The interpret
+    DEFAULT flag covers every marshal/dispatch entry — including the
+    streaming prep pipeline's, whose scheduler never threads an
+    interpret argument — so both the streaming and synchronous
+    schedulers run the interpret kernel here."""
     monkeypatch.setattr(reach, "_use_pallas", lambda: True)
     monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
-    orig = reach_batch.dispatch_returns_batch
-    monkeypatch.setattr(
-        reach_batch, "dispatch_returns_batch",
-        lambda *a, **kw: orig(*a, **{**kw, "interpret": True}))
+    monkeypatch.setattr(reach_batch, "_INTERPRET_DEFAULT", True)
 
 
 def _ragged_packs(lens, corrupt=(), crash_p=0.0):

@@ -110,13 +110,10 @@ def test_batch_rescue_path(monkeypatch):
 
 
 def _force_interpret_dispatch(monkeypatch):
-    """check_batch routes through the dispatch/collect pair (pipelined
-    scheduler); forcing interpret at the dispatch entry covers every
-    group."""
-    orig = reach_batch.dispatch_returns_batch
-    monkeypatch.setattr(
-        reach_batch, "dispatch_returns_batch",
-        lambda *a, **kw: orig(*a, **{**kw, "interpret": True}))
+    """check_batch routes through the prepare/dispatch/collect
+    pipeline (synchronous or streaming scheduler); the interpret
+    DEFAULT flag covers every marshal entry in both."""
+    monkeypatch.setattr(reach_batch, "_INTERPRET_DEFAULT", True)
 
 
 def test_check_batch_end_to_end(monkeypatch):

@@ -73,7 +73,8 @@ def summarize(path: str, top: int = 15) -> Dict[str, Any]:
     data = obs.load_any(path)
     st = self_times(data["spans"])
     ranked = sorted(st.items(), key=lambda kv: -kv[1]["self_us"])[:top]
-    return {
+    gauges = {g["name"]: g["value"] for g in data["gauges"]}
+    out = {
         "file": path,
         "spans": len(data["spans"]),
         "top_spans_by_self_time": [
@@ -83,8 +84,20 @@ def summarize(path: str, top: int = 15) -> Dict[str, Any]:
             for name, a in ranked],
         "decisions": decision_table(data["decisions"]),
         "counters": {c["name"]: c["value"] for c in data["counters"]},
-        "gauges": {g["name"]: g["value"] for g in data["gauges"]},
+        "gauges": gauges,
     }
+    # host/device overlap of the streaming prep pipeline (ISSUE 3):
+    # hidden/wall is the fraction of host prep that cost no wall-clock
+    wall = gauges.get("prep.wall_s")
+    if isinstance(wall, (int, float)) and wall > 0:
+        hidden = float(gauges.get("prep.hidden_s", 0) or 0)
+        out["prep_overlap"] = {
+            "mode": gauges.get("prep.mode"),
+            "wall_s": wall,
+            "hidden_s": hidden,
+            "efficiency": round(hidden / wall, 3),
+        }
+    return out
 
 
 def _print_human(s: Dict[str, Any]) -> None:
@@ -95,6 +108,12 @@ def _print_human(s: Dict[str, Any]) -> None:
         for row in s["top_spans_by_self_time"]:
             print(f"  {row['name']:32} {row['count']:>6} "
                   f"{row['self_ms']:>10.3f} {row['total_ms']:>10.3f}")
+    if s.get("prep_overlap"):
+        po = s["prep_overlap"]
+        print(f"\nprep overlap ({po.get('mode')}): "
+              f"{po['hidden_s']:.4f}s of {po['wall_s']:.4f}s host prep "
+              f"hidden under device walks "
+              f"(efficiency {po['efficiency']:.0%})")
     if s["decisions"]:
         print("\nengine-decision ledger:")
         for event, rows in sorted(s["decisions"].items()):
