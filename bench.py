@@ -29,7 +29,9 @@ per-bucket geometry (``per_bucket``: H/B/W/S/R_pad and real vs padded
 returns per lockstep group), ``pack_efficiency`` (real returns over
 padded lockstep steps — the win of length-bucketed lane packing),
 ``kernel_cache`` (hit/miss counters of the per-geometry compiled-kernel
-cache), and aggregate ops/s. ``--engine batch`` promotes the batch
+cache), the mesh scaling story (``n_devices``, ``per_device_groups``,
+``mesh_pad_lanes`` — 1/None/0 on single-device runs), and aggregate
+ops/s. ``--engine batch`` promotes the batch
 dimension to the HEADLINE: a ragged independent-keys workload
 (BASELINE config #4 shape — ``--ops`` total over ≥8 keys of mixed
 lengths) through ``reach.check_many``'s bucketed lockstep lane,
@@ -235,9 +237,16 @@ def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
         times.append(dt)
     best = min(times)
     prep = best_diag.get("prep", {})
+    mesh = best_diag.get("mesh") or {}
     return {"H": H, "e2e_s": round(best, 3),
             "agg_ops_s": round(H * n_ops / best),
             "engine": sorted(engines),
+            # mesh scaling story (single-device runs report 1 device,
+            # no per-device split): device count, groups walked per
+            # device, and the lane-pad waste of sharding
+            "n_devices": mesh.get("n_devices", 1),
+            "per_device_groups": mesh.get("per_device_groups"),
+            "mesh_pad_lanes": mesh.get("pad_lanes", 0),
             # prep/dispatch/fetch attribution of the best e2e run —
             # prep_hidden_s / prep_s is the streaming overlap win
             "prep_s": prep.get("wall_s"),
@@ -309,6 +318,7 @@ def independent_probe(model, n_ops: int, seed: int,
         seq_times.append(time.monotonic() - t1)
     seq_s = max(min(seq_times), 1e-9)
     prep = best_diag.get("prep", {})
+    mesh = best_diag.get("mesh") or {}
     return {"keys": len(lens), "lens": lens,
             "e2e_s": round(best, 3),
             "agg_ops_s": round(total / best),
@@ -316,6 +326,9 @@ def independent_probe(model, n_ops: int, seed: int,
             "seq_ops_s": round(total / seq_s),
             "speedup_vs_sequential": round(seq_s / best, 2),
             "engine": engines,
+            "n_devices": mesh.get("n_devices", 1),
+            "per_device_groups": mesh.get("per_device_groups"),
+            "mesh_pad_lanes": mesh.get("pad_lanes", 0),
             "prep_s": prep.get("wall_s"),
             "prep_hidden_s": prep.get("hidden_s"),
             "prep_mode": prep.get("mode"),

@@ -893,10 +893,14 @@ def _jitted_walk_sharded(mesh_devs: tuple, axis: str):
     def run(T_flat, n_cols, canon, ret_slot, slot_ops, crashed_slot,
             bitmat, word_idx, shift, C0, count0):
         body = functools.partial(_walk_sharded, n_cols, canon, n_dev, axis)
-        sm = jax.shard_map(
-            body, mesh=m,
+        # check=False: the walk's while_loop mixes replicated and
+        # sharded carries, which the static replication checker cannot
+        # type on either jax generation (0.4 has no replication rule
+        # for `while` at all)
+        sm = par.shard_map(
+            body, m,
             in_specs=(P(), P(), P(), P(), P(), P(), P(), P(axis), P()),
-            out_specs=(P(), P(axis), P(), P()))
+            out_specs=(P(), P(axis), P(), P()), check=False)
         return sm(T_flat, ret_slot, slot_ops, crashed_slot, bitmat,
                   word_idx, shift, C0, count0)
 

@@ -1461,21 +1461,27 @@ def check_batch(model: Model, packed_list: Sequence[h.PackedHistory], *,
 
     With ``devices`` (>1) the HISTORY axis shards over a
     ``jax.sharding.Mesh`` instead: whole histories are as independent
-    as ``independent`` keys, so the batch rides the same data-parallel
-    path as :func:`check_many` (each device walks its share of the
-    vmapped batch; the lockstep kernel is the single-chip form). The
-    graceful-fallback guarantee survives the mesh: if the sharded
-    batch cannot run (e.g. padding every history to the common shape
-    overflows ``max_dense`` even though each fits alone), the call
-    falls through to the single-device route below and its per-history
-    fallbacks, rather than raising where ``devices=None`` would have
-    succeeded."""
+    as ``independent`` keys, so the batch rides the same mesh routes
+    as :func:`check_many` — the MESH-LOCKSTEP lane first (lockstep
+    lane blocks placed per device, groups multi-queued so chips walk
+    concurrently), then the keyed mesh-union walk. The
+    graceful-fallback guarantee survives the mesh: a mesh-lockstep
+    dispatch failure degrades to the single-device lockstep scheduler
+    (exactly one ``mesh-lockstep`` obs fallback — never silently the
+    keyed kernel), and if the sharded batch cannot run at all (e.g.
+    padding every history to the common shape overflows ``max_dense``
+    even though each fits alone), the call falls through to the
+    single-device route below and its per-history fallbacks, rather
+    than raising where ``devices=None`` would have succeeded."""
     _ensure_persistent_caches()
     if devices is not None and len(devices) > 1:
         try:
+            # group and diag ride along: the sharded path's dispatch
+            # width and mesh diagnostics must not vanish just because
+            # a mesh was supplied
             return check_many(model, packed_list, max_states=max_states,
                               max_slots=max_slots, max_dense=max_dense,
-                              devices=devices)
+                              devices=devices, group=group, diag=diag)
         except (DenseOverflow, ev.ConcurrencyOverflow,
                 StateExplosion) as e:
             logging.getLogger("jepsen.reach").warning(
@@ -1745,16 +1751,25 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
                          hidden_s: float, stall_s: float,
                          dispatch_s: float, fetch_s: float, mode: str,
                          queue_hwm: int,
-                         diag: Optional[dict]) -> None:
+                         diag: Optional[dict],
+                         mesh: Optional[dict] = None) -> None:
     """Shared obs/diag accounting tail of the synchronous and streaming
     lockstep schedulers: pack efficiency, kernel-cache counters, and
     the prep/dispatch/fetch wall breakdown. ``prep.hidden_s`` is the
     prep wall time that did NOT extend the critical path (prep minus
     the consumer's queue stalls) — the overlap win as ONE tracked
-    number; on the synchronous path it is 0 by construction."""
+    number; on the synchronous path it is 0 by construction. ``mesh``
+    (device-sharded dispatches only) carries the device count,
+    per-device dispatched-group counts, and the in-flight high-water
+    mark — the stream-overlap evidence of the multi-queue scheduler —
+    emitted as ``lockstep.mesh.*`` and mirrored into ``diag``."""
     from jepsen_tpu.checkers import reach_batch
 
-    real = sum(d["real_returns"] for d in gdiags)
+    # replicated pad lanes (mesh group splitting) are walked but not
+    # real work: their returns are excluded so real_returns and
+    # pack_efficiency don't overstate mesh packing quality
+    real = sum(d["real_returns"] - d.get("pad_lane_returns", 0)
+               for d in gdiags)
     padded = sum(d["padded_returns"] for d in gdiags)
     cache = reach_batch.kernel_cache_info()
     # bucket pack efficiency and kernel-cache counters flow to obs on
@@ -1772,6 +1787,16 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
     obs.gauge("prep.stall_s", round(stall_s, 6))
     obs.gauge("prep.queue_depth_max", queue_hwm)
     obs.gauge("prep.mode", mode)
+    if mesh is not None:
+        obs.gauge("lockstep.mesh.devices", mesh["n_devices"])
+        obs.gauge("lockstep.mesh.inflight_max", mesh["inflight_max"])
+        if mesh.get("pad_lanes"):
+            # counted HERE — once per completed dispatch — so a
+            # stream→sync retry of the same batch can't double-count
+            obs.count("lockstep.mesh.pad_lanes", mesh["pad_lanes"])
+        for k, c in enumerate(mesh["per_device_groups"]):
+            if c:
+                obs.count(f"lockstep.mesh.groups.dev{k}", c)
     if diag is not None:
         diag["groups"] = gdiags
         diag["real_returns"] = real
@@ -1785,12 +1810,91 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
                         "stall_s": round(stall_s, 6),
                         "queue_depth_max": queue_hwm,
                         "groups": len(gdiags)}
+        if mesh is not None:
+            diag["mesh"] = dict(mesh)
+
+
+class _LockstepDispatchState:
+    """Shared per-dispatch bookkeeping of the synchronous and streaming
+    lockstep schedulers: round-robin device placement over the mesh,
+    pad-lane dedup accounting (mesh pad lanes are cross-group
+    duplicates — their returns must not count as real work), the
+    in-flight window, and the FIFO drain. ONE implementation so the two
+    schedulers' diag/obs output — which the stream-vs-sync differential
+    tests treat as equivalent — cannot drift."""
+
+    __slots__ = ("devs", "n_dev", "depth", "dead", "seen", "dev_groups",
+                 "inflight", "inflight_hwm", "fetch_s")
+
+    def __init__(self, devices: Optional[Sequence], dead: np.ndarray):
+        self.devs = list(devices) if devices else None
+        self.n_dev = len(self.devs) if self.devs else 1
+        # one walking plus one queued group per device; FIFO collection
+        # drains the oldest shard while the rest keep walking
+        self.depth = self.n_dev * (_LOCKSTEP_PIPE_DEPTH + 1) - 1
+        self.dead = dead
+        self.seen: set = set()
+        self.dev_groups = [0] * self.n_dev
+        self.inflight: List = []
+        self.inflight_hwm = 0
+        self.fetch_s = 0.0
+
+    def place(self, gi: int, g, prep) -> Tuple[int, Dict[str, Any]]:
+        """Pin group ``gi`` to its round-robin device; returns the
+        device index and the dispatch span args."""
+        di = gi % self.n_dev
+        sp: Dict[str, Any] = {"lanes": len(g)}
+        if self.devs:
+            prep.device = self.devs[di]
+            self.dev_groups[di] += 1
+            sp["device"] = di
+        return di, sp
+
+    def admit(self, g, fl, di: int) -> dict:
+        """Group diag (with pad-lane dedup) + in-flight append."""
+        from jepsen_tpu.checkers import reach_batch
+
+        gd = reach_batch.group_diag(fl.geom, fl.R_lens)
+        if self.devs:
+            gd["device"] = di
+            dup = sum(int(fl.R_lens[j]) for j, k in enumerate(g)
+                      if k in self.seen)
+            self.seen.update(g)
+            if dup:
+                gd["pad_lane_returns"] = dup
+        self.inflight.append((g, fl, di))
+        self.inflight_hwm = max(self.inflight_hwm, len(self.inflight))
+        return gd
+
+    def drain(self, limit: int) -> None:
+        from jepsen_tpu.checkers import reach_batch
+
+        while len(self.inflight) > limit:
+            g0, fl0, di0 = self.inflight.pop(0)
+            t0 = _time.monotonic()
+            sp: Dict[str, Any] = {"lanes": len(g0)}
+            if self.devs:
+                sp["device"] = di0
+            with obs.span("lockstep.collect", **sp):
+                self.dead[np.asarray(g0, np.int64)] = \
+                    reach_batch.collect_returns_batch(fl0)
+            self.fetch_s += _time.monotonic() - t0
+
+    def mesh_info(self, pad_lanes: int) -> Optional[dict]:
+        if not self.devs:
+            return None
+        return {"n_devices": self.n_dev,
+                "per_device_groups": self.dev_groups,
+                "inflight_max": self.inflight_hwm,
+                "pad_lanes": pad_lanes}
 
 
 def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
                               M: int, n_live: int,
                               diag: Optional[dict] = None,
-                              prep_base_s: float = 0.0) -> np.ndarray:
+                              prep_base_s: float = 0.0,
+                              devices: Optional[Sequence] = None,
+                              pad_lanes: int = 0) -> np.ndarray:
     """Bucketed, pipelined lockstep dispatch (the SYNCHRONOUS
     scheduler — the streaming pipeline's fallback and the verdict
     reference of its differential tests): each group in ``groups``
@@ -1799,33 +1903,27 @@ def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
     geometry; group g+1's walk is QUEUED before group g's verdicts are
     fetched, so host marshalling/compiles overlap device walks. The
     per-geometry compiled-kernel cache (``reach_batch._batch_call``)
-    makes repeated geometries free across groups and calls. Fills
-    ``diag`` (when given) with per-group geometry, pack efficiency
-    (real vs padded returns), kernel-cache counters, and the
-    prep/dispatch/fetch wall breakdown. Returns the per-live-key local
-    dead indices."""
+    makes repeated geometries free across groups and calls. With
+    ``devices`` the groups (lane blocks, pre-split by
+    :func:`reach_batch.shard_groups_for_mesh`) are placed round-robin
+    over the mesh and the in-flight window widens to one walking plus
+    one queued group PER DEVICE — device k walks group g while device
+    j walks group g+1, and FIFO collection drains the oldest shard
+    while the rest keep walking. Fills ``diag`` (when given) with
+    per-group geometry, pack efficiency (real vs padded returns),
+    kernel-cache counters, and the prep/dispatch/fetch wall breakdown.
+    Returns the per-live-key local dead indices."""
     from jepsen_tpu.checkers import reach_batch
 
     dead = np.full(n_live, -1, np.int64)
-    inflight: List = []
+    st = _LockstepDispatchState(devices, dead)
     # prep_base_s carries the caller's stage-B packing wall
     # (sa.pack_s) so sync prep.wall_s covers packing + marshalling —
     # the same quantity the streaming scheduler reports
     prep_s = prep_base_s
-    dispatch_s = fetch_s = 0.0
-
-    def _drain(limit: int) -> None:
-        nonlocal fetch_s
-        while len(inflight) > limit:
-            g0, fl0 = inflight.pop(0)
-            t0 = _time.monotonic()
-            with obs.span("lockstep.collect", lanes=len(g0)):
-                dead[np.asarray(g0, np.int64)] = \
-                    reach_batch.collect_returns_batch(fl0)
-            fetch_s += _time.monotonic() - t0
-
+    dispatch_s = 0.0
     gdiags: List[dict] = []
-    for g in groups:
+    for gi, g in enumerate(groups):
         t0 = _time.monotonic()
         with obs.span("lockstep.prep", lanes=len(g)):
             prep = reach_batch.prepare_returns_batch(
@@ -1835,15 +1933,16 @@ def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
                 M)
         t1 = _time.monotonic()
         prep_s += t1 - t0
-        with obs.span("lockstep.dispatch", lanes=len(g)):
+        di, sp = st.place(gi, g, prep)
+        with obs.span("lockstep.dispatch", **sp):
             fl = reach_batch.dispatch_prepared(prep)
         dispatch_s += _time.monotonic() - t1
-        gdiags.append(reach_batch.group_diag(fl.geom, fl.R_lens))
-        inflight.append((g, fl))
-        _drain(_LOCKSTEP_PIPE_DEPTH)
-    _drain(0)
-    _lockstep_accounting(gdiags, prep_s, 0.0, 0.0, dispatch_s, fetch_s,
-                         "sync", 0, diag)
+        gdiags.append(st.admit(g, fl, di))
+        st.drain(st.depth)
+    st.drain(0)
+    _lockstep_accounting(gdiags, prep_s, 0.0, 0.0, dispatch_s,
+                         st.fetch_s, "sync", 0, diag,
+                         st.mesh_info(pad_lanes))
     return dead
 
 
@@ -1862,7 +1961,9 @@ def _stream_prep_enabled() -> bool:
 
 def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
                               max_slots: int, n_live: int,
-                              diag: Optional[dict]):
+                              diag: Optional[dict],
+                              devices: Optional[Sequence] = None,
+                              pad_lanes: int = 0):
     """Streaming producer/consumer lockstep scheduler (the ISSUE 3
     tentpole): a background prep thread runs per-group native packing
     (:func:`_union_pack_group`) and operand marshalling
@@ -1883,7 +1984,17 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
     the queue is drained so the producer can never deadlock on a full
     queue. Overlap efficiency is tracked: ``prep.wall_s`` (total prep
     thread work) vs ``prep.hidden_s`` (prep time that did not extend
-    the critical path — wall minus the consumer's queue stalls)."""
+    the critical path — wall minus the consumer's queue stalls).
+
+    With ``devices`` the consumer becomes the MULTI-QUEUE dispatcher of
+    the mesh lockstep lane: arriving groups (lane blocks) are placed
+    round-robin over the mesh with one walking plus one queued group
+    per device, so the ONE prep thread feeds N concurrently-walking
+    chips — device k walks group g while device j walks group g+1 and
+    the producer packs g+2. FIFO collection drains the oldest shard
+    while the rest keep walking; fallback guarantees are unchanged
+    (the fallback target is the caller's, which for the mesh lane is
+    the single-device lockstep scheduler, never the keyed kernel)."""
     import queue as _queue
 
     from jepsen_tpu.checkers import reach_batch
@@ -1937,20 +2048,10 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
     dead = np.full(n_live, -1, np.int64)
     key_W_full = np.zeros(n_live, np.int32)
     key_R_full = np.zeros(n_live, np.int32)
-    inflight: List = []
+    st = _LockstepDispatchState(devices, dead)
     gdiags: List[dict] = []
-    stall_s = dispatch_s = fetch_s = 0.0
+    stall_s = dispatch_s = 0.0
     failure: Optional[Tuple[str, Any]] = None
-
-    def _drain_inflight(limit: int) -> None:
-        nonlocal fetch_s
-        while len(inflight) > limit:
-            g0, fl0 = inflight.pop(0)
-            t0 = _time.monotonic()
-            with obs.span("lockstep.collect", lanes=len(g0)):
-                dead[np.asarray(g0, np.int64)] = \
-                    reach_batch.collect_returns_batch(fl0)
-            fetch_s += _time.monotonic() - t0
 
     th = threading.Thread(target=_producer, name="jepsen-stream-prep",
                           daemon=True)
@@ -1968,18 +2069,18 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
             prep, key_W, key_R = payload
             g = groups[gi]
             t0 = _time.monotonic()
-            with obs.span("lockstep.dispatch", lanes=len(g),
-                          streamed=True):
+            di, sp = st.place(gi, g, prep)
+            sp["streamed"] = True
+            with obs.span("lockstep.dispatch", **sp):
                 fl = reach_batch.dispatch_prepared(prep)
             dispatch_s += _time.monotonic() - t0
-            gdiags.append(reach_batch.group_diag(fl.geom, fl.R_lens))
+            gdiags.append(st.admit(g, fl, di))
             idx = np.asarray(g, np.int64)
             key_W_full[idx] = key_W
             key_R_full[idx] = key_R
-            inflight.append((g, fl))
-            _drain_inflight(_LOCKSTEP_PIPE_DEPTH)
+            st.drain(st.depth)
         if failure is None:
-            _drain_inflight(0)
+            st.drain(0)
     finally:
         stop.set()
         try:
@@ -2013,8 +2114,8 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
         return None
     hidden_s = max(0.0, prep_wall[0] - stall_s)
     _lockstep_accounting(gdiags, prep_wall[0], hidden_s, stall_s,
-                         dispatch_s, fetch_s, "stream", queue_hwm[0],
-                         diag)
+                         dispatch_s, st.fetch_s, "stream",
+                         queue_hwm[0], diag, st.mesh_info(pad_lanes))
     obs.count("prep.streamed_groups", len(gdiags))
     return dead, key_W_full, key_R_full
 
@@ -2024,15 +2125,19 @@ def _check_lockstep_stream(engine: str, model: Model,
                            live: Sequence[int], sa: "_UnionPrepA",
                            max_states: int, max_slots: int,
                            max_dense: int, group: int,
-                           diag: Optional[dict], t0: float
+                           diag: Optional[dict], t0: float,
+                           devices: Optional[Sequence] = None
                            ) -> Optional[List[Dict[str, Any]]]:
     """Run the streaming lockstep pipeline end to end: plan bucket
     groups from the per-key return counts (every non-crashed entry
     returns exactly once, so ``n_ok`` IS the return count — known
     before any native build), stream prep→dispatch, assemble results.
-    Returns None when there is nothing to overlap (single group) or
-    the pipeline fell back — the caller then runs the synchronous
-    path on the same stage A, so verdicts are bit-identical."""
+    With ``devices`` the planned groups are lane-sharded over the mesh
+    (:func:`reach_batch.shard_groups_for_mesh`) and the dispatcher
+    multi-queues them round-robin across chips. Returns None when
+    there is nothing to overlap (single group) or the pipeline fell
+    back — the caller then runs the synchronous path on the same
+    stage A, so verdicts are bit-identical."""
     from jepsen_tpu.checkers import reach_batch
 
     lens = [int(packed_list[i].n_ok) for i in live]
@@ -2040,11 +2145,16 @@ def _check_lockstep_stream(engine: str, model: Model,
     # splits small keys into more groups — suboptimal packing, never
     # incorrect); the true union W is only known after native packing
     groups = reach_batch.plan_buckets(lens, max_slots, group=group)
+    pad_lanes = 0
+    if devices is not None and len(devices) > 1:
+        groups, pad_lanes = reach_batch.shard_groups_for_mesh(
+            groups, len(devices))
     if len(groups) < 2:
         return None         # nothing to hide — synchronous is simpler
     try:
         r = _dispatch_lockstep_stream(sa, groups, max_slots, len(live),
-                                      diag)
+                                      diag, devices=devices,
+                                      pad_lanes=pad_lanes)
     except Exception as e:                              # noqa: BLE001
         # dispatch-side failure: recorded, then the synchronous path
         # gets its chance (and takes the existing per-history
@@ -2129,6 +2239,94 @@ def _check_many_lockstep(model: Model,
                           max_dense)
 
 
+def _check_many_mesh_lockstep(model: Model,
+                              packed_list: Sequence[h.PackedHistory],
+                              max_states: int, max_slots: int,
+                              max_dense: int, devices: Sequence,
+                              t0: float, group: int = 0,
+                              diag: Optional[dict] = None,
+                              u_box: Optional[dict] = None
+                              ) -> Optional[List[Dict[str, Any]]]:
+    """Device-sharded lockstep lane for the MESH path of
+    :func:`check_many` (the ISSUE 4 tentpole): the same union stage A
+    and bucketed lane packing as the single-chip lockstep lane, with
+    the lockstep LANE axis sharded over ``devices`` — dispatch groups
+    are split into per-device lane blocks until every chip holds one
+    (:func:`reach_batch.shard_groups_for_mesh`; pad lanes replicate a
+    real lane, so verdicts stay exact) and placed round-robin in the
+    canonical mesh order, while the streaming prep thread multi-queues
+    groups so device k walks group g as device j walks group g+1.
+    Returns the results list, or None to fall through to the keyed
+    mesh-union lane (gates closed: ``JEPSEN_TPU_NO_MESH_LOCKSTEP=1``,
+    no Pallas, no native lib, union explosion/budget overflow, too few
+    returns, an unsplittable batch). A dispatch failure ON the mesh
+    (compile failure, padding overflow, device placement) records
+    exactly ONE ``mesh-lockstep`` fallback in the obs ledger and
+    re-runs the batch on the SINGLE-DEVICE lockstep lane — asking for
+    more chips must degrade to fewer chips on the SAME engine, never
+    silently to the keyed kernel."""
+    from jepsen_tpu.checkers import preproc_native, reach_batch
+
+    if not reach_batch.mesh_lockstep_enabled():
+        return None
+    if not (_use_pallas() and preproc_native.available()):
+        return None
+    live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
+    if len(live) < 2:
+        return None
+    if sum(packed_list[i].n_ok for i in live) < _PALLAS_MIN_RETURNS:
+        return None
+    from jepsen_tpu import parallel as par
+
+    # the same 1-D mesh plumbing as the keyed lanes
+    # (_key_axis_shardings): lane blocks land in the mesh's ravel
+    # order, so block k and NamedSharding shard k pick the same chip
+    devs = par.device_order(list(devices), "lanes")
+    sa = _union_stage_a_shared(model, packed_list, live, max_states,
+                               u_box)
+    if sa is None:
+        if u_box is not None:
+            u_box["u"] = None       # stage A failure implies no u
+        return None
+    try:
+        if _stream_prep_enabled():
+            out = _check_lockstep_stream(
+                "reach-lockstep-mesh", model, packed_list, live, sa,
+                max_states, max_slots, max_dense,
+                group or _BATCH_GROUP, diag, t0, devices=devs)
+            if out is not None:
+                return out
+        u = _union_prep_shared(model, packed_list, live, max_states,
+                               max_slots, u_box)
+        if u is None:
+            return None
+        (_memo_u, _S_pad, P, W, M, ret_flat, ops_flat, _key_W, key_R,
+         offsets, _opid_cat, _crs_cat, _offs, _noop_op) = u
+        groups = reach_batch.plan_buckets(
+            [int(r) for r in key_R], W, group=group or _BATCH_GROUP)
+        groups, pad_lanes = reach_batch.shard_groups_for_mesh(
+            groups, len(devs))
+        if len(groups) < 2:
+            return None         # unsplittable: nothing to shard
+        sa_box = (u_box or {}).get("sa")
+        dead = _dispatch_lockstep_groups(
+            P, ret_flat, ops_flat, offsets, groups, M, len(live), diag,
+            prep_base_s=sa_box.pack_s if sa_box is not None else 0.0,
+            devices=devs, pad_lanes=pad_lanes)
+    except Exception as e:                              # noqa: BLE001
+        _warn_pallas_failed(f"mesh-lockstep: {e!r}")
+        obs.engine_fallback("mesh-lockstep", type(e).__name__,
+                            histories=len(live), devices=len(devs))
+        return _check_many_lockstep(model, packed_list, max_states,
+                                    max_slots, max_dense, t0,
+                                    group=group, diag=diag,
+                                    u_box=u_box)
+    elapsed = _time.monotonic() - t0
+    return _union_results("reach-lockstep-mesh", model, packed_list,
+                          live, dead, u, elapsed, max_states,
+                          max_slots, max_dense)
+
+
 def _key_axis_shardings(devices: Sequence, n_keys: int):
     """Mesh + (sharded, replicated) NamedShardings for a leading key
     axis, and the pad count making ``n_keys`` device-divisible —
@@ -2148,7 +2346,8 @@ def _check_many_mesh_native(model: Model,
                             packed_list: Sequence[h.PackedHistory],
                             max_states: int, max_slots: int,
                             max_dense: int, devices: Sequence,
-                            t0: float) -> Optional[List[Dict[str, Any]]]:
+                            t0: float, u_box: Optional[dict] = None
+                            ) -> Optional[List[Dict[str, Any]]]:
     """Union-native fast lane for the MESH path of :func:`check_many`:
     the same ONE-memo + ONE-native-build prep as
     :func:`_check_many_native`, marshaled into the key-padded arrays
@@ -2169,8 +2368,17 @@ def _check_many_mesh_native(model: Model,
     live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
     if len(live) < 2:
         return None
-    u = _union_prep(model, packed_list, live, max_states, max_slots,
-                    need_pallas=False)
+    # reuse the mesh-lockstep attempt's prep: a cached full u is
+    # directly valid (its gates are stricter), and a cached stage A
+    # skips re-paying the union BFS when only the Pallas gate failed
+    u = (u_box or {}).get("u")
+    if u is None:
+        sa = _union_stage_a_shared(model, packed_list, live, max_states,
+                                   u_box)
+        if sa is None:
+            return None
+        u = _union_prep(model, packed_list, live, max_states, max_slots,
+                        need_pallas=False, stage_a=sa)
     if u is None:
         return None
     (memo_u, S_pad, P, W, M, ret_flat, ops_flat, key_W, key_R,
@@ -2229,6 +2437,7 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                max_dense: int = 1 << 22,
                devices: Optional[Sequence] = None,
                should_abort=None,
+               group: int = 0,
                diag: Optional[dict] = None) -> List[Dict[str, Any]]:
     """Batched per-key checking (the ``independent`` checker's hot
     path). Single-chip route order: the bucketed LOCKSTEP lane
@@ -2238,15 +2447,20 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
     shapes. Keys whose history does not fit the dense engine raise;
     callers split those out first via :func:`fits`.
 
-    With ``devices`` (>1), the key axis is sharded over a
+    With ``devices`` (>1), the MESH-LOCKSTEP lane runs first
+    (:func:`_check_many_mesh_lockstep` — the lockstep lane axis
+    sharded over the mesh, dispatch groups multi-queued per device),
+    then the keyed mesh-union lane: the key axis sharded over a
     ``jax.sharding.Mesh`` — the data-parallel axis of SURVEY.md §2.4:
     per-key searches are independent, so the only cross-device traffic is
     the while-loop's all-reduced liveness test. ``should_abort`` is
     consulted once before the batched device dispatch (the batch is one
     call — per-key granularity would defeat its throughput); when it
-    fires, every live key reports ``valid == "unknown"``. ``diag``
-    (a dict, filled in place) receives the lockstep lane's per-group
-    geometry, pack efficiency, and kernel-cache counters."""
+    fires, every live key reports ``valid == "unknown"``. ``group``
+    overrides the lockstep lanes' dispatch-group width (0 = default);
+    ``diag`` (a dict, filled in place) receives the lockstep lane's
+    per-group geometry, pack efficiency, kernel-cache counters, and —
+    on a mesh — the per-device group counts and pad waste."""
     import jax.numpy as jnp
 
     _ensure_persistent_caches()
@@ -2260,7 +2474,7 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                                    max_states=max_states,
                                    max_slots=max_slots,
                                    max_dense=max_dense, t0=t0,
-                                   diag=diag, u_box=u_box)
+                                   group=group, diag=diag, u_box=u_box)
         if out is not None:
             obs.decision("reach-many", "route", cause="lockstep",
                          histories=len(packed_list))
@@ -2275,8 +2489,26 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                          histories=len(packed_list))
             return out
     else:
+        u_box = {}              # stage A shared across the mesh lanes
+        out = _check_many_mesh_lockstep(model, packed_list, max_states,
+                                        max_slots, max_dense, devices,
+                                        t0, group=group, diag=diag,
+                                        u_box=u_box)
+        if out is not None:
+            # a mesh dispatch failure degrades INSIDE the lane to the
+            # single-device lockstep scheduler — name which one
+            # answered so "more chips" never silently means "fewer"
+            engines = {r.get("engine") for r in out}
+            cause = ("mesh-lockstep"
+                     if "reach-lockstep-mesh" in engines else
+                     "lockstep")
+            obs.decision("reach-many", "route", cause=cause,
+                         histories=len(packed_list),
+                         devices=len(devices))
+            return out
         out = _check_many_mesh_native(model, packed_list, max_states,
-                                      max_slots, max_dense, devices, t0)
+                                      max_slots, max_dense, devices, t0,
+                                      u_box=u_box)
         if out is not None:
             obs.decision("reach-many", "route", cause="mesh-union",
                          histories=len(packed_list))

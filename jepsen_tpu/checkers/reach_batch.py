@@ -45,7 +45,8 @@ behavior being reproduced: knossos.wgl per-history semantics
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Tuple
+import os
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -121,6 +122,42 @@ def plan_buckets(R_lens, W: int, *, group: int = 32) -> List[List[int]]:
         for j in range(0, len(idxs), group):
             groups.append(idxs[j:j + group])
     return groups
+
+
+def mesh_lockstep_enabled() -> bool:
+    """The device-sharded lockstep lane (lane blocks placed across a
+    mesh's devices) is on by default wherever a mesh is supplied;
+    ``JEPSEN_TPU_NO_MESH_LOCKSTEP=1`` forces the pre-mesh routes
+    (consulted per call — tests toggle it)."""
+    return not os.environ.get("JEPSEN_TPU_NO_MESH_LOCKSTEP")
+
+
+def shard_groups_for_mesh(groups: List[List[int]], n_dev: int
+                          ) -> Tuple[List[List[int]], int]:
+    """Lane-axis sharding at the planner level: split dispatch groups
+    into per-device lane blocks until at least ``n_dev`` groups exist,
+    so a batch that packs into fewer groups than the mesh has devices
+    still walks on every chip. The widest group splits first, into two
+    equal halves — its lane count padded to even by REPLICATING its
+    first lane, so both halves share one compiled geometry and the pad
+    lane's verdict write is idempotent (it walks the same stream as
+    the lane it copies). Returns ``(groups, pad_lanes)``; every input
+    index still appears in some group, single-lane groups cannot
+    split, so a tiny batch may underfill the mesh."""
+    out = [list(g) for g in groups]
+    pad = 0
+    while len(out) < n_dev:
+        widest = max(range(len(out)), key=lambda i: len(out[i]))
+        g = out.pop(widest)
+        if len(g) < 2:
+            out.insert(widest, g)
+            break
+        if len(g) % 2:
+            g = g + [g[0]]
+            pad += 1
+        half = len(g) // 2
+        out[widest:widest] = [g[:half], g[half:]]
+    return out, pad
 
 
 def group_geom(R_max: int, H: int, W: int, *,
@@ -463,12 +500,13 @@ class BatchInflight:
     :func:`collect_returns_batch` — the split lets a scheduler queue
     the NEXT group's walk (and pay its marshalling/compile host time)
     before fetching the previous group's verdicts, overlapping host
-    work with device walks across bucket groups."""
+    work with device walks across bucket groups. ``device`` (when set)
+    is the mesh device this group's lane block walks on."""
     __slots__ = ("P", "geom", "host_args", "R_lens", "dsegs",
-                 "ckpts", "final", "interpret")
+                 "ckpts", "final", "interpret", "device")
 
     def __init__(self, P, geom, host_args, R_lens, dsegs, ckpts,
-                 final, interpret):
+                 final, interpret, device=None):
         self.P = P
         self.geom = geom
         self.host_args = host_args
@@ -477,6 +515,7 @@ class BatchInflight:
         self.ckpts = ckpts
         self.final = final
         self.interpret = interpret
+        self.device = device
 
 
 class BatchPrepared:
@@ -485,15 +524,20 @@ class BatchPrepared:
     interleaving plus geometry; safe to run on the streaming prep
     thread, no jax calls), consumed by :func:`dispatch_prepared` on the
     dispatching thread. The prepare/dispatch split is what lets the
-    streaming pipeline pack group g+1 while group g walks on device."""
-    __slots__ = ("P", "geom", "host_args", "R_lens", "interpret")
+    streaming pipeline pack group g+1 while group g walks on device.
+    A mesh scheduler sets ``device`` before dispatching to pin this
+    group's lane block to one chip (None = jax's default device)."""
+    __slots__ = ("P", "geom", "host_args", "R_lens", "interpret",
+                 "device")
 
-    def __init__(self, P, geom, host_args, R_lens, interpret):
+    def __init__(self, P, geom, host_args, R_lens, interpret,
+                 device=None):
         self.P = P
         self.geom = geom
         self.host_args = host_args
         self.R_lens = R_lens
         self.interpret = interpret
+        self.device = device
 
 
 def prepare_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
@@ -509,6 +553,20 @@ def prepare_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
     return BatchPrepared(P, geom, host_args, R_lens, interpret)
 
 
+def _pipe_walk_on(device, host_args, geom, n_pass: int, interpret: bool,
+                  dsegs: dict):
+    """:func:`_pipe_walk_b` with every put/compile/dispatch committed to
+    ``device`` (None = default device): the single-chip kernel is the
+    per-shard body of the mesh lockstep lane — jax routes the jitted
+    walk to wherever its operands are committed, so N shards queued on
+    N devices walk concurrently."""
+    if device is None:
+        return _pipe_walk_b(host_args, geom, n_pass, interpret, dsegs)
+    import jax
+    with jax.default_device(device):
+        return _pipe_walk_b(host_args, geom, n_pass, interpret, dsegs)
+
+
 def dispatch_prepared(prep: BatchPrepared) -> BatchInflight:
     """Queue a prepared group's walk (device puts + compiles +
     dispatches — all jax work) without fetching anything. Pair with
@@ -516,10 +574,11 @@ def dispatch_prepared(prep: BatchPrepared) -> BatchInflight:
     W = prep.geom[1]
     n_fast = min(W, _FAST_PASSES)
     dsegs: dict = {}
-    ckpts, final = _pipe_walk_b(prep.host_args, prep.geom, n_fast,
-                                prep.interpret, dsegs)
+    ckpts, final = _pipe_walk_on(prep.device, prep.host_args, prep.geom,
+                                 n_fast, prep.interpret, dsegs)
     return BatchInflight(prep.P, prep.geom, prep.host_args, prep.R_lens,
-                         dsegs, ckpts, final, prep.interpret)
+                         dsegs, ckpts, final, prep.interpret,
+                         device=prep.device)
 
 
 def dispatch_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
@@ -551,8 +610,8 @@ def collect_returns_batch(fl: BatchInflight) -> np.ndarray:
         # capped-ladder deaths may be false: decide with the exact
         # W-pass walk (reuses the uploaded device segments)
         obs.count("lockstep.exact_rescue")
-        ckpts, final = _pipe_walk_b(host_args, geom, W, interpret,
-                                    dsegs)
+        ckpts, final = _pipe_walk_on(fl.device, host_args, geom, W,
+                                     interpret, dsegs)
         final_np = np.asarray(final)
         alive = np.array([final_np[:, h * S:(h + 1) * S].any()
                           for h in range(H)])
@@ -593,3 +652,38 @@ def walk_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
     pair."""
     return collect_returns_batch(dispatch_returns_batch(
         P, ret_slots, slot_ops, M, interpret=interpret))
+
+
+def walk_returns_batch_sharded(P: np.ndarray,
+                               ret_slots: List[np.ndarray],
+                               slot_ops: List[np.ndarray], M: int,
+                               devices: Sequence, *,
+                               interpret: Optional[bool] = None
+                               ) -> np.ndarray:
+    """Walk H return streams in lockstep with the LANE axis sharded
+    over ``devices``: the lane blocks split per device
+    (:func:`shard_groups_for_mesh` — the count padded to even splits
+    by replicating a lane), each block's walk queued on its own chip
+    with the single-chip kernel as the per-shard body, and ALL shards
+    dispatched before any verdict is fetched — so N devices walk
+    concurrently. Verdicts are bit-identical to
+    :func:`walk_returns_batch`: every lane walks exactly the stream it
+    would walk single-chip, just on its own device."""
+    devs = list(devices)
+    H = len(ret_slots)
+    groups, pad = shard_groups_for_mesh([list(range(H))], len(devs))
+    inflight = []
+    for k, g in enumerate(groups):
+        prep = prepare_returns_batch(
+            P, [ret_slots[h] for h in g], [slot_ops[h] for h in g], M,
+            interpret=interpret)
+        prep.device = devs[k % len(devs)]
+        inflight.append((g, dispatch_prepared(prep)))
+    dead = np.full(H, -1, np.int64)
+    for g, fl in inflight:
+        dead[np.asarray(g, np.int64)] = collect_returns_batch(fl)
+    if pad:
+        # counted after the collect loop: once per COMPLETED walk, the
+        # same contract as the schedulers' _lockstep_accounting
+        obs.count("lockstep.mesh.pad_lanes", pad)
+    return dead

@@ -36,6 +36,36 @@ def mesh(axis: str = "shard", devs: Optional[Sequence] = None):
     return Mesh(np.array(devs), (axis,))
 
 
+def shard_map(fn, mesh, in_specs, out_specs,
+              check: Optional[bool] = None):
+    """``jax.shard_map`` across jax versions: the top-level API with
+    ``check_vma`` (jax >= 0.6) or the 0.4 experimental module with its
+    ``check_rep`` spelling — one call site for every sharded engine so
+    a jax upgrade touches only this shim. ``check=None`` keeps the
+    library default; False skips the replication/varying-axes check."""
+    import jax
+    kw = {} if check is None else (
+        {"check_vma": check} if hasattr(jax, "shard_map")
+        else {"check_rep": check})
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def device_order(devs: Optional[Sequence] = None,
+                 axis: str = "shard") -> list:
+    """Canonical device placement order for block-sharded lanes: the
+    ravel order of the 1-D :func:`mesh` over ``devs`` — the same order
+    a ``NamedSharding(mesh, P(axis))`` assigns leading-axis blocks, so
+    per-device dispatches (the mesh lockstep lane's lane blocks) and
+    NamedSharding placements (the keyed mesh lanes) put block k on the
+    same device."""
+    return list(mesh(axis, devs).devices.ravel())
+
+
 def shard_leading_axis(arrays, devs: Optional[Sequence] = None):
     """Place each array with its leading axis sharded across ``devs``
     (padding to a multiple of the device count is the caller's job)."""
@@ -74,13 +104,12 @@ def chunked_transfer(args, devs: Sequence):
         return outer(P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c,
                      basis_c)
 
-    sm = jax.shard_map(
-        local, mesh=m,
+    # replicated operands mix invariant/variant axes inside control
+    # flow; skip the varying-axes check
+    sm = shard_map(
+        local, m,
         in_specs=(P(), P(), P(), P("chunks"), P("chunks"), P("chunks")),
-        out_specs=P("chunks"),
-        # replicated operands mix invariant/variant axes inside control
-        # flow; skip the varying-axes check
-        check_vma=False)
+        out_specs=P("chunks"), check=False)
     R = jax.jit(sm)(P_mats, xor_cols, bitmask, ret_slot_c, slot_ops_c,
                     basis_c)
     # [n_chunks, B, S, M] -> [n_chunks, B, D]; B is the (possibly

@@ -2,8 +2,10 @@
 :mod:`jepsen_tpu.obs` (bench runs, stored run dirs) without opening a
 trace viewer: top spans by SELF time (span duration minus the duration
 of its children — children are spans on the same thread whose interval
-is contained in the parent's), the engine-decision ledger as a
-fallback/selection table, and the counters.
+is contained in the parent's), spans grouped by mesh device (the
+``device`` arg the mesh-lockstep dispatch/collect spans carry), the
+engine-decision ledger as a fallback/selection table, and the
+counters.
 
 Usage:
     python tools/trace_view.py trace.json [--top 15] [--json]
@@ -55,6 +57,28 @@ def self_times(spans: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
     return dict(agg)
 
 
+def device_table(spans: List[Dict[str, Any]]
+                 ) -> Dict[str, Dict[str, Any]]:
+    """Spans grouped by the ``device`` arg the mesh-lockstep
+    dispatch/collect spans carry: per-device span counts and wall, plus
+    a per-name breakdown — how evenly the multi-queue scheduler spread
+    groups over the mesh."""
+    per: Dict[str, Dict[str, Any]] = {}
+    for s in spans:
+        d = (s.get("args") or {}).get("device")
+        if d is None:
+            continue
+        a = per.setdefault(f"dev{d}", {"count": 0, "total_us": 0.0,
+                                       "names": defaultdict(int)})
+        a["count"] += 1
+        a["total_us"] += float(s.get("dur", 0.0))
+        a["names"][s.get("name", "?")] += 1
+    return {k: {"count": int(v["count"]),
+                "total_ms": round(v["total_us"] / 1e3, 3),
+                "names": dict(v["names"])}
+            for k, v in sorted(per.items())}
+
+
 def decision_table(decisions: List[Dict[str, Any]]
                    ) -> Dict[str, Dict[str, int]]:
     """Ledger records grouped ``event -> "stage[/cause]" -> count``."""
@@ -86,6 +110,9 @@ def summarize(path: str, top: int = 15) -> Dict[str, Any]:
         "counters": {c["name"]: c["value"] for c in data["counters"]},
         "gauges": gauges,
     }
+    by_dev = device_table(data["spans"])
+    if by_dev:
+        out["spans_by_device"] = by_dev
     # host/device overlap of the streaming prep pipeline (ISSUE 3):
     # hidden/wall is the fraction of host prep that cost no wall-clock
     wall = gauges.get("prep.wall_s")
@@ -108,6 +135,13 @@ def _print_human(s: Dict[str, Any]) -> None:
         for row in s["top_spans_by_self_time"]:
             print(f"  {row['name']:32} {row['count']:>6} "
                   f"{row['self_ms']:>10.3f} {row['total_ms']:>10.3f}")
+    if s.get("spans_by_device"):
+        print("\nspans by device (mesh-lockstep dispatch/collect):")
+        for dev, a in s["spans_by_device"].items():
+            names = " ".join(f"{n}x{c}"
+                             for n, c in sorted(a["names"].items()))
+            print(f"  {dev:8} {a['count']:>4} spans "
+                  f"{a['total_ms']:>10.3f} ms  {names}")
     if s.get("prep_overlap"):
         po = s["prep_overlap"]
         print(f"\nprep overlap ({po.get('mode')}): "
