@@ -65,18 +65,17 @@ import time
 _PEAK_FLOPS = 197e12
 
 
-def kernel_probe(model, packed) -> dict:
-    """Steady-state device-kernel probe for the single-history lane
-    walk: returns kernel_s (dispatch-slope), transfer_s / bytes, the
-    dispatch+fetch round-trip, and MFU. Raises if the lane path does
-    not admit the history (caller treats the probe as best-effort)."""
+def _lane_operands(model, packed):
+    """The single-history lane operand set every probe measures: memo
+    BFS + union transition tensor + the PRODUCTION packing
+    (``reach_lane.pack_operands``). Shared so one bench run pays this
+    host prep once for both ``transfer_probe`` and ``kernel_probe``.
+    Returns ``(rs, geom, host_args, p_nbytes)``."""
     import numpy as np
 
-    import jax
     from jepsen_tpu.checkers import events as ev
     from jepsen_tpu.checkers import reach, reach_lane
 
-    t_prep = time.monotonic()
     memo, stream, _T, S, M = reach._prep(
         model, packed, max_states=100_000, max_slots=20,
         max_dense=1 << 22)
@@ -84,20 +83,62 @@ def kernel_probe(model, packed) -> dict:
     P_np = reach._build_P(memo, S)
     R0 = np.zeros((S, M), bool)
     R0[0, 0] = True
-    R_real = int(rs.ret_slot.shape[0])
+    geom, _, _, host_args = reach_lane.pack_operands(
+        P_np, rs.ret_slot, rs.slot_ops, R0)
+    return rs, geom, host_args, int(P_np.nbytes)
+
+
+def kernel_probe(model, packed, prep=None, prep_s=None) -> dict:
+    """Steady-state device-kernel probe for the single-history lane
+    walk: returns kernel_s (dispatch-slope), transfer_s / bytes, the
+    dispatch+fetch round-trip, and MFU. Raises if the lane path does
+    not admit the history (caller treats the probe as best-effort).
+    ``prep``/``prep_s`` carry a pre-built :func:`_lane_operands` set
+    (and its measured wall) so a full bench run preps once."""
+    import numpy as np
+
+    import jax
+    from jepsen_tpu.checkers import reach_lane
+
+    if prep is None:
+        t_prep = time.monotonic()
+        prep = _lane_operands(model, packed)
+        prep_s = time.monotonic() - t_prep
     # marshaling AND dispatch shared with the production path — the
     # probe runs reach_lane._pipe_walk itself, so it can never time a
     # kernel or a pipeline production does not execute
-    geom, _, _, host_args = reach_lane.pack_operands(
-        P_np, rs.ret_slot, rs.slot_ops, R0)
-    prep_s = time.monotonic() - t_prep
+    rs, geom, host_args, p_nbytes = prep
+    R_real = int(rs.ret_slot.shape[0])
     B, W, M, S, O1, R_pad = geom
     n_pass = min(W, reach_lane._FAST_PASSES)
-    n_bytes = sum(a.nbytes for a in host_args)
+    from jepsen_tpu.checkers import transfer as xfer
+
+    # the put-observer moves the TRUE production wire: the dominant
+    # slot_ops lane crosses 6-bit packed PER SEGMENT (exactly what
+    # _pipe_walk uploads, ragged-tail pad included), so transfer_sync_s
+    # and the reported bytes describe the same transfer — the diet, not
+    # the pre-pack host staging arrays
+    _rs_w, _so_w, _P_w, _r0_w = host_args
+    if xfer.packed_enabled() and xfer.sextet_ok(O1):
+        wire_args = (_rs_w, reach_lane.pack_ops_wire(geom, _so_w),
+                     _P_w, _r0_w)
+    else:
+        wire_args = host_args
+    n_bytes = reach_lane.wire_bytes(geom, host_args)
+
+    # the probe's verdict fetch matches the production protocol: lazy
+    # (the default) crosses ONE on-device-reduced boolean, eager the
+    # full [M, S] final set — so dispatch_fetch_s reflects the diet
+    if xfer.lazy_fetch_enabled():
+        def verdict_fetch(fin):
+            return bool(np.asarray(reach_lane._jit_any()(fin)))
+    else:
+        def verdict_fetch(fin):
+            return np.asarray(fin)
     dsegs: dict = {}
     _, final = reach_lane._pipe_walk(host_args, geom, n_pass, False,
                                      dsegs)
-    _ = np.asarray(final)                       # warm/compile
+    _ = verdict_fetch(final)                    # warm/compile
     # put-completion observer: a scalar reduction CONSUMING every
     # operand, jitted once. Fetching a put array back is free (jax
     # keeps the committed host copy), so observing transfer completion
@@ -106,7 +147,7 @@ def kernel_probe(model, packed) -> dict:
     observe = jax.jit(lambda a, b, c, d: (
         a.astype(jnp.int32).sum() + b.astype(jnp.int32).sum()
         + c.sum().astype(jnp.int32) + d.sum().astype(jnp.int32)))
-    args2 = jax.device_put(host_args)
+    args2 = jax.device_put(wire_args)
     _ = int(observe(*args2))                    # warm/compile
     # bare dispatch+fetch round trip on RESIDENT operands — the latency
     # floor every sync pays regardless of bytes moved (min of several
@@ -122,9 +163,10 @@ def kernel_probe(model, packed) -> dict:
     # not transfer, so the sampled floor is subtracted. Raw
     # put+observe = transfer_sync_s + rtt_s.
     t0 = time.monotonic()
-    args2 = jax.device_put(host_args)
+    args2 = jax.device_put(wire_args)
     _ = int(observe(*args2))
     transfer_s = max(0.0, time.monotonic() - t0 - rtt_s)
+    put_s = transfer_s
     # steady-state walk split into its pipeline stages: dispatch_s is
     # the host time to queue every device program, fetch_s the
     # verdict round-trip — together with prep_s these attribute the
@@ -134,7 +176,7 @@ def kernel_probe(model, packed) -> dict:
     _, final = reach_lane._pipe_walk(host_args, geom, n_pass, False,
                                      dsegs)
     t1 = time.monotonic()
-    _ = np.asarray(final)
+    _ = verdict_fetch(final)
     t2 = time.monotonic()
     dispatch_only_s = t1 - t0
     fetch_s = t2 - t1
@@ -144,7 +186,7 @@ def kernel_probe(model, packed) -> dict:
     for _i in range(K):
         _, final = reach_lane._pipe_walk(host_args, geom, n_pass, False,
                                          dsegs)
-    _ = np.asarray(final)
+    _ = verdict_fetch(final)
     many_s = time.monotonic() - t0
     kernel_s = max(0.0, (many_s - one_s) / (K - 1))
     # FLOPs: min(c_r, n_pass) fire matmuls [M,S]@[S,W*S] per return —
@@ -153,12 +195,24 @@ def kernel_probe(model, packed) -> dict:
     executed = np.minimum(
         (rs.slot_ops >= 0).sum(axis=1), n_pass).sum()
     flops = 2.0 * M * S * W * S * float(executed)
+    # transfer-diet breakdown: actual bytes on the wire (narrow ints +
+    # bit-packed bools) vs the blanket int32/f32 format, and which
+    # fetch protocol the verdict crossed on — the run-over-run evidence
+    # the CI transfer-guard budgets
+    unpacked_bytes = reach_lane.blanket_bytes(geom, p_nbytes)
     return {
         "kernel_s": round(kernel_s, 4),
         "kernel_ns_per_return": round(kernel_s / max(R_real, 1) * 1e9),
         "returns": R_real,
         "transfer_sync_s": round(transfer_s, 4),
         "transfer_bytes": int(n_bytes),
+        # put_s/packed_bytes alias the two fields above under the
+        # round-6 names the transfer tooling reads; the round-5 names
+        # stay so BENCH_r01-r05 comparisons keep working
+        "put_s": round(put_s, 4),
+        "packed_bytes": int(n_bytes),
+        "unpacked_bytes": int(unpacked_bytes),
+        "fetch_mode": xfer.fetch_mode(),
         "rtt_s": round(rtt_s, 4),
         "dispatch_fetch_s": round(one_s - kernel_s, 4),
         "prep_s": round(prep_s, 4),
@@ -166,6 +220,46 @@ def kernel_probe(model, packed) -> dict:
         "fetch_s": round(fetch_s, 4),
         "mfu_pct": round(flops / max(kernel_s, 1e-9) / _PEAK_FLOPS * 100,
                          4),
+    }
+
+
+def transfer_probe(model, packed, prep=None) -> dict:
+    """Host-only marshalling breakdown of the single-history wire
+    format: runs the PRODUCTION operand packing
+    (``reach_lane.pack_operands`` — no device dispatch, so this works
+    on CPU-only CI) and reports actual vs blanket-int32/f32 bytes.
+    The ``transfer-guard`` CI step budgets these numbers so a wire
+    regression (a re-widened dtype, an unpacked bool tensor) fails the
+    build. ``prep`` reuses a :func:`_lane_operands` set."""
+    from jepsen_tpu.checkers import reach_lane
+    from jepsen_tpu.checkers import transfer as xfer
+
+    if prep is None:
+        prep = _lane_operands(model, packed)
+    rs, geom, host_args, p_nbytes = prep
+    # reach_lane.wire_bytes is the production accounting — it includes
+    # the per-segment 6-bit packing of the dominant slot_ops lane that
+    # _pipe_walk applies at upload time, so the guard budgets what the
+    # link actually carries
+    packed_bytes = int(reach_lane.wire_bytes(geom, host_args))
+    unpacked_bytes = int(reach_lane.blanket_bytes(geom, p_nbytes))
+    round5_bytes = int(reach_lane.round5_bytes(geom, p_nbytes))
+    return {
+        "returns": int(rs.n_returns),
+        "packed_bytes": packed_bytes,
+        "unpacked_bytes": unpacked_bytes,
+        # ratio is vs the dtype-blind blanket reference the guard
+        # budgets; vs_round5 is vs the narrow wire round 5 actually
+        # shipped (upload side only — the fetch-side win is separate)
+        "ratio": round(unpacked_bytes / max(packed_bytes, 1), 2),
+        "round5_bytes": round5_bytes,
+        "vs_round5": round(round5_bytes / max(packed_bytes, 1), 2),
+        "bytes_per_return": round(
+            packed_bytes / max(int(rs.n_returns), 1), 2),
+        "fetch_mode": xfer.fetch_mode(),
+        "gates": {"packed": xfer.packed_enabled(),
+                  "lazy_fetch": xfer.lazy_fetch_enabled(),
+                  "donate": xfer.donate_enabled()},
     }
 
 
@@ -254,6 +348,9 @@ def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
             "prep_mode": prep.get("mode"),
             "dispatch_s": best_diag.get("dispatch_s"),
             "fetch_s": best_diag.get("fetch_s"),
+            # transfer-diet evidence: wire bytes under the diet vs the
+            # blanket format, and the verdict fetch protocol
+            "transfer": best_diag.get("transfer"),
             "pack_efficiency": best_diag.get("pack_efficiency"),
             "real_returns": best_diag.get("real_returns"),
             "padded_returns": best_diag.get("padded_returns"),
@@ -334,6 +431,7 @@ def independent_probe(model, n_ops: int, seed: int,
             "prep_mode": prep.get("mode"),
             "dispatch_s": best_diag.get("dispatch_s"),
             "fetch_s": best_diag.get("fetch_s"),
+            "transfer": best_diag.get("transfer"),
             "pack_efficiency": best_diag.get("pack_efficiency"),
             "real_returns": best_diag.get("real_returns"),
             "padded_returns": best_diag.get("padded_returns"),
@@ -358,7 +456,15 @@ def main() -> int:
     ap.add_argument("--trace", metavar="PATH", default="trace.json",
                     help="write the obs span trace (Chrome trace_event "
                          "JSON; '' disables)")
+    ap.add_argument("--quick", action="store_true",
+                    help="small/CI run: caps --ops at 20k, one repeat, "
+                         "skips the batch probe — the transfer-guard "
+                         "CI step's configuration")
     args = ap.parse_args()
+    if args.quick:
+        args.ops = min(args.ops, 20_000)
+        args.repeat = 1
+        args.no_batch = True
 
     from jepsen_tpu import fixtures, models, obs, store
     from jepsen_tpu.checkers import reach, wgl_ref
@@ -479,8 +585,25 @@ def main() -> int:
         "slots": res.get("slots"),
     }
     if args.engine == "reach":
+        # both probes measure the same lane operand set: prep it once
+        probe_prep, probe_prep_s = None, None
         try:
-            out["kernel"] = kernel_probe(model, packed)
+            t_pp = time.monotonic()
+            probe_prep = _lane_operands(model, packed)
+            probe_prep_s = time.monotonic() - t_pp
+        except Exception:                               # noqa: BLE001
+            pass        # each probe reports its own failure below
+        try:
+            # host-only marshalling breakdown — works on CPU-only CI,
+            # where the device probes below skip; the transfer-guard
+            # step budgets these numbers
+            out["transfer"] = transfer_probe(model, packed,
+                                             prep=probe_prep)
+        except Exception as e:                          # noqa: BLE001
+            out["transfer"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            out["kernel"] = kernel_probe(model, packed, prep=probe_prep,
+                                         prep_s=probe_prep_s)
         except Exception as e:                          # noqa: BLE001
             # probe is diagnostics, not the metric: histories the lane
             # kernel does not admit (or CPU-only runs) skip it

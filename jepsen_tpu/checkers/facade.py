@@ -221,10 +221,15 @@ def auto_check_packed(model: Model, packed, kw: Mapping) -> Dict[str, Any]:
     assert "no silent fallback occurred"."""
     import time as _time
 
-    from jepsen_tpu.checkers import frontier, reach, wgl_native, wgl_ref
+    from jepsen_tpu.checkers import frontier, reach, transfer, wgl_native, \
+        wgl_ref
     from jepsen_tpu.checkers.events import ConcurrencyOverflow
     from jepsen_tpu.models.memo import StateExplosion
 
+    # name the wire format this chain's verdicts cross on (the
+    # transfer-diet gates are env-consulted per call; run artifacts
+    # must record which configuration was measured)
+    transfer.record_mode()
     geom = {"ops": packed.n, "ok-ops": packed.n_ok}
     t_stage = _time.monotonic()
 
@@ -373,10 +378,11 @@ def auto_check_many_packed(model: Model, packed_list,
     route cannot hold every history (dense/union overflow, or a
     too-concurrent key). Mirrors how :func:`auto_check_packed` is the
     one-history chain; results align with ``packed_list``."""
-    from jepsen_tpu.checkers import reach
+    from jepsen_tpu.checkers import reach, transfer
     from jepsen_tpu.checkers.events import ConcurrencyOverflow
     from jepsen_tpu.models.memo import StateExplosion
 
+    transfer.record_mode()
     try:
         with obs.span("facade.check-many", histories=len(packed_list)):
             out = reach.check_many(model, packed_list,

@@ -1752,7 +1752,8 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
                          dispatch_s: float, fetch_s: float, mode: str,
                          queue_hwm: int,
                          diag: Optional[dict],
-                         mesh: Optional[dict] = None) -> None:
+                         mesh: Optional[dict] = None,
+                         fetch_degraded: bool = False) -> None:
     """Shared obs/diag accounting tail of the synchronous and streaming
     lockstep schedulers: pack efficiency, kernel-cache counters, and
     the prep/dispatch/fetch wall breakdown. ``prep.hidden_s`` is the
@@ -1764,6 +1765,7 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
     mark — the stream-overlap evidence of the multi-queue scheduler —
     emitted as ``lockstep.mesh.*`` and mirrored into ``diag``."""
     from jepsen_tpu.checkers import reach_batch
+    from jepsen_tpu.checkers import transfer as _xfer
 
     # replicated pad lanes (mesh group splitting) are walked but not
     # real work: their returns are excluded so real_returns and
@@ -1787,6 +1789,15 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
     obs.gauge("prep.stall_s", round(stall_s, 6))
     obs.gauge("prep.queue_depth_max", queue_hwm)
     obs.gauge("prep.mode", mode)
+    # transfer-diet evidence per dispatch: actual wire bytes vs the
+    # blanket int32/f32 format, and which fetch protocol answered
+    put_b = sum(d.get("put_bytes", 0) for d in gdiags)
+    put_u = sum(d.get("put_bytes_unpacked", 0) for d in gdiags)
+    # the PROTOCOL THE VERDICTS ACTUALLY CROSSED ON, not the env gate:
+    # a lazy-fetch fallback mid-run degraded at least one collect to
+    # eager full-array fetches
+    fmode = "degraded-eager" if fetch_degraded else _xfer.fetch_mode()
+    obs.gauge("transfer.fetch_mode", fmode)
     if mesh is not None:
         obs.gauge("lockstep.mesh.devices", mesh["n_devices"])
         obs.gauge("lockstep.mesh.inflight_max", mesh["inflight_max"])
@@ -1810,6 +1821,9 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
                         "stall_s": round(stall_s, 6),
                         "queue_depth_max": queue_hwm,
                         "groups": len(gdiags)}
+        diag["transfer"] = {"packed_bytes": put_b,
+                            "unpacked_bytes": put_u,
+                            "fetch_mode": fmode}
         if mesh is not None:
             diag["mesh"] = dict(mesh)
 
@@ -1824,7 +1838,8 @@ class _LockstepDispatchState:
     tests treat as equivalent — cannot drift."""
 
     __slots__ = ("devs", "n_dev", "depth", "dead", "seen", "dev_groups",
-                 "inflight", "inflight_hwm", "fetch_s")
+                 "inflight", "inflight_hwm", "fetch_s",
+                 "fetch_degraded")
 
     def __init__(self, devices: Optional[Sequence], dead: np.ndarray):
         self.devs = list(devices) if devices else None
@@ -1838,6 +1853,7 @@ class _LockstepDispatchState:
         self.inflight: List = []
         self.inflight_hwm = 0
         self.fetch_s = 0.0
+        self.fetch_degraded = False
 
     def place(self, gi: int, g, prep) -> Tuple[int, Dict[str, Any]]:
         """Pin group ``gi`` to its round-robin device; returns the
@@ -1855,6 +1871,11 @@ class _LockstepDispatchState:
         from jepsen_tpu.checkers import reach_batch
 
         gd = reach_batch.group_diag(fl.geom, fl.R_lens)
+        x = fl.dsegs.get("xfer")
+        if x is not None:
+            # wire bytes this group actually moved vs the blanket
+            # int32/f32 format — summed by _lockstep_accounting
+            gd["put_bytes"], gd["put_bytes_unpacked"] = x
         if self.devs:
             gd["device"] = di
             dup = sum(int(fl.R_lens[j]) for j, k in enumerate(g)
@@ -1878,6 +1899,8 @@ class _LockstepDispatchState:
             with obs.span("lockstep.collect", **sp):
                 self.dead[np.asarray(g0, np.int64)] = \
                     reach_batch.collect_returns_batch(fl0)
+            if getattr(fl0, "degraded", False):
+                self.fetch_degraded = True
             self.fetch_s += _time.monotonic() - t0
 
     def mesh_info(self, pad_lanes: int) -> Optional[dict]:
@@ -1942,7 +1965,7 @@ def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
     st.drain(0)
     _lockstep_accounting(gdiags, prep_s, 0.0, 0.0, dispatch_s,
                          st.fetch_s, "sync", 0, diag,
-                         st.mesh_info(pad_lanes))
+                         st.mesh_info(pad_lanes), st.fetch_degraded)
     return dead
 
 
@@ -2115,7 +2138,8 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
     hidden_s = max(0.0, prep_wall[0] - stall_s)
     _lockstep_accounting(gdiags, prep_wall[0], hidden_s, stall_s,
                          dispatch_s, st.fetch_s, "stream",
-                         queue_hwm[0], diag, st.mesh_info(pad_lanes))
+                         queue_hwm[0], diag, st.mesh_info(pad_lanes),
+                         st.fetch_degraded)
     obs.count("prep.streamed_groups", len(gdiags))
     return dead, key_W_full, key_R_full
 

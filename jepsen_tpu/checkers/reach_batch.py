@@ -51,6 +51,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from jepsen_tpu import obs
+from jepsen_tpu.checkers import transfer
 from jepsen_tpu.checkers.reach_lane import (_BLOCK, _FAST_PASSES,
                                             _idx_dtype, _refine_dead)
 
@@ -333,7 +334,8 @@ _COMPUTE_DTYPE = "bfloat16"
 
 @functools.cache
 def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
-                R_pad: int, n_pass: int, interpret: bool, dtype: str):
+                R_pad: int, n_pass: int, interpret: bool, dtype: str,
+                donate: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -387,16 +389,28 @@ def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
     )
 
     def run(slot_ops, ret_slot_rh, P, R0):
-        # device-side derivations (the wire carries only narrow ints):
-        # batch-max pending count per return gates the ladder; the
-        # projection lane row expands each history's returning slot
-        # over its S lanes
+        # device-side derivations (the wire carries only narrow ints
+        # and bit-packed bools): batch-max pending count per return
+        # gates the ladder; the projection lane row expands each
+        # history's returning slot over its S lanes
         P = P.astype(cdt)
-        R0 = R0.astype(cdt)
-        ops32 = slot_ops.astype(jnp.int32)
-        pend = jnp.sum((ops32.reshape(-1, H, W) >= 0).astype(jnp.int32),
-                       axis=2)
+        if R0.dtype == jnp.uint8:
+            # bit-packed config seeds (8 per wire byte), unpacked where
+            # bandwidth is free
+            R0 = jnp.unpackbits(R0, count=M * HS).reshape(M, HS) \
+                    .astype(cdt)
+        else:
+            R0 = R0.astype(cdt)
+        if slot_ops.dtype == jnp.uint8:
+            # 6-bit packed ops lane (4 values per 3 wire bytes): the
+            # dense narrow format is SIGNED, so uint8 unambiguously
+            # marks the packed lane
+            slot_ops = transfer.unpack_sextet_jnp(slot_ops,
+                                                  R_pad * H * W)
+        pend = jnp.sum((slot_ops.reshape(-1, H, W) >= 0)
+                       .astype(jnp.int32), axis=2)
         pendmax = jnp.max(pend, axis=1)
+        ops32 = slot_ops.astype(jnp.int32)
         if PB != B:                     # pad each B-block to the SMEM tile
             pendmax = jnp.pad(pendmax.reshape(-1, B),
                               ((0, 0), (0, PB - B))).reshape(-1)
@@ -407,7 +421,10 @@ def _batch_call(B: int, W: int, M: int, S: int, H: int, O1: int,
         jv = jnp.repeat(ret_slot_rh.astype(jnp.float32), S, axis=1)
         return call(ops32, pendmax, jv, P, R0)
 
-    return jax.jit(run)
+    # donated carried config set: XLA recycles the [M, HS] f32 buffer
+    # for the segment's `final` output instead of reallocating per
+    # dispatch (pipeline-intermediate carries only — see _pipe_walk_b)
+    return jax.jit(run, donate_argnums=(3,)) if donate else jax.jit(run)
 
 
 def pack_batch_operands(P: np.ndarray, ret_slots: List[np.ndarray],
@@ -433,62 +450,187 @@ def pack_batch_operands(P: np.ndarray, ret_slots: List[np.ndarray],
     R0 = np.zeros((M, H * S), np.float32)
     for h in range(H):
         R0[0, h * S] = 1.0                   # mask 0, state 0 per block
+    # the per-lane config seeds cross bit-packed (8 configs per wire
+    # byte, unpacked on device — see _batch_call.run) unless opted out
+    r0_wire = transfer.pack_bool(R0) if transfer.packed_enabled() \
+        else R0
     host_args = (np.ascontiguousarray(ops_rhw.reshape(-1), idx_dt),
                  np.ascontiguousarray(rs_rh),
                  np.ascontiguousarray(P, np.float32),
-                 R0)
+                 r0_wire)
     geom = (B, W, M, S, H, O1, R_pad)
     return geom, host_args, [int(r.shape[0]) for r in ret_slots]
 
 
 def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
-                 dsegs: dict):
+                 dsegs: dict, device=None):
     """Segmented put+dispatch pipeline for the batch walk (same shape
     as ``reach_lane._pipe_walk``): no intermediate fetch, cached device
-    segments for rescue reuse."""
+    segments for rescue reuse. Transfer diet: the transition tensor is
+    cached device-resident across the group sequence
+    (:func:`transfer.cached_put` — one upload per batch, not per
+    group), the config seeds cross bit-packed, and segments after the
+    first donate the carried config set so XLA recycles its HBM buffer
+    per dispatch. ``device`` (mesh dispatches) keys the operand cache;
+    a diet-path failure records exactly one obs fallback and the walk
+    degrades to the round-5 dispatch."""
     import jax
+    import jax.numpy as jnp
 
     from jepsen_tpu.checkers.reach_lane import _pipe_geom
 
     B, W, M, S, H, O1, R_pad = geom
     ops_flat, rs_rh, P, R0 = host_args
+    HS = H * S
     seg, nseg = _pipe_geom(B, R_pad, _PIPE_NSEG)
     # bf16 only at full-lane widths: with H*S below the 128-lane tile
     # the bf16 (16,128) tiling degenerates (measured: 8 × cas-100k at
     # HS=64 runs ~2.0 s in bf16 vs 0.47 s in f32, while HS ≥ 128
     # geometries are 6-8% FASTER in bf16)
-    cdt = _COMPUTE_DTYPE if H * S >= 128 else "float32"
+    cdt = _COMPUTE_DTYPE if HS >= 128 else "float32"
     run = _batch_call(B, W, M, S, H, O1, seg, n_pass, interpret, cdt)
+    run_d = None
+    donate = transfer.donate_enabled()
+    sextet = transfer.packed_enabled() and transfer.sextet_ok(O1)
+    HW = H * W
+
+    def _seg_host(k: int):
+        """Segment ``k``'s host operands in the dense narrow format."""
+        lo, hi = k * seg, min((k + 1) * seg, R_pad)
+        o_seg = ops_flat[lo * HW:hi * HW]
+        r_seg = rs_rh[lo:hi]
+        if hi - lo < seg:                # ragged tail: identity pad
+            o_seg = np.pad(o_seg, (0, (seg - (hi - lo)) * HW),
+                           constant_values=-1)
+            r_seg = np.pad(r_seg, ((0, seg - (hi - lo)), (0, 0)),
+                           constant_values=-1)
+        return (np.ascontiguousarray(o_seg),
+                np.ascontiguousarray(r_seg))
+
     fresh = "segs" not in dsegs
     if fresh:
         # cast to the compute dtype BEFORE the wire: bf16 halves the
         # transfer and the in-jit astype then no-ops (leaving it f32
         # here would re-materialize a converted copy on every segment
         # dispatch)
-        import jax.numpy as jnp
-        dsegs["dP"] = jnp.asarray(P, dtype=cdt)
-        dsegs["dR0"] = jnp.asarray(R0, dtype=cdt)
+        dsegs["dP"], p_hit = transfer.cached_put(
+            P, (cdt, str(device)), lambda: jnp.asarray(P, dtype=cdt))
+        if getattr(R0, "dtype", None) == np.uint8:
+            dsegs["dR0"] = jax.device_put(R0)     # bit-packed seeds
+        else:
+            dsegs["dR0"] = jnp.asarray(R0, dtype=cdt)
         dsegs["segs"] = []
-        obs.count("lockstep.transfer_bytes",
-                  sum(int(a.nbytes) for a in host_args))
+        p_bytes = P.size * (2 if cdt == "bfloat16" else 4)
+        # the ops lane crosses 6-bit packed per segment when the
+        # alphabet fits the sextet (see the upload loop below)
+        ops_wire_b = (nseg * transfer.sextet_bytes(seg * HW)
+                      if sextet else int(ops_flat.nbytes))
+        # a seed that arrived as a DEVICE array (chunklock phase B
+        # hands over _glue_call's output) never crosses the link —
+        # count it on neither side of the actual/baseline pair
+        r0_host = isinstance(R0, np.ndarray)
+        actual = (ops_wire_b + int(rs_rh.nbytes)
+                  + (int(dsegs["dR0"].nbytes) if r0_host else 0)
+                  + (0 if p_hit else p_bytes))
+        baseline = (R_pad * H * W * 4 + R_pad * H * 4 + int(P.nbytes)
+                    + (M * HS * 4 if r0_host else 0))
+        dsegs["xfer"] = (actual, baseline)
+        obs.count("lockstep.transfer_bytes", actual)
+        transfer.count_put(actual, baseline)
     R_cur = dsegs["dR0"]
     ckpts = []
-    HW = H * W
     for i in range(nseg):
         if fresh:
-            lo, hi = i * seg, min((i + 1) * seg, R_pad)
-            o_seg = ops_flat[lo * HW:hi * HW]
-            r_seg = rs_rh[lo:hi]
-            if hi - lo < seg:                # ragged tail: identity pad
-                o_seg = np.pad(o_seg, (0, (seg - (hi - lo)) * HW),
-                               constant_values=-1)
-                r_seg = np.pad(r_seg, ((0, seg - (hi - lo)), (0, 0)),
-                               constant_values=-1)
+            o_seg, r_seg = _seg_host(i)
             dsegs["segs"].append(jax.device_put(
-                (np.ascontiguousarray(o_seg),
-                 np.ascontiguousarray(r_seg))))
+                (transfer.pack_sextet(o_seg) if sextet else o_seg,
+                 r_seg)))
         a, b = dsegs["segs"][i]
-        ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+        # dR0 is never donated (the rescue walk re-reads it); only the
+        # pipeline-intermediate carried sets are
+        use_donate = donate and i > 0
+        try:
+            if use_donate:
+                if run_d is None:
+                    run_d = _batch_call(B, W, M, S, H, O1, seg, n_pass,
+                                        interpret, cdt, True)
+                ck, R_cur = run_d(a, b, dsegs["dP"], R_cur)
+                obs.count("donate.reuse")
+            else:
+                ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+        except Exception as e:                          # noqa: BLE001
+            # packedness of what's actually resident, not the env gate:
+            # a rescue re-entry may carry dense segments from a prior
+            # call's fallback while the gate still reads open
+            packed_wire = (
+                getattr(dsegs["dR0"], "dtype", None) == np.uint8
+                or getattr(a, "dtype", None) == np.uint8)
+
+            def _dense_recover(exc):
+                """ONE `packed-xfer` record: re-materialize the round-5
+                dense format host-side (f32 seed, signed narrow ops —
+                every built segment too, so the record covers the rest
+                of the walk), account the re-uploads, and re-walk
+                segments 0..i undonated from the seed."""
+                nonlocal sextet
+                obs.engine_fallback("packed-xfer", type(exc).__name__)
+                extra = 0
+                if getattr(dsegs["dR0"], "dtype", None) == np.uint8:
+                    dense = transfer.unpack_bool_host(
+                        np.asarray(dsegs["dR0"]), M * HS)
+                    dsegs["dR0"] = jnp.asarray(
+                        dense.reshape(M, HS).astype(np.float32),
+                        dtype=cdt)
+                    extra += M * HS * (2 if cdt == "bfloat16" else 4)
+                if getattr(dsegs["segs"][i][0], "dtype",
+                           None) == np.uint8:
+                    n_built = len(dsegs["segs"])
+                    dsegs["segs"] = [jax.device_put(_seg_host(k))
+                                     for k in range(n_built)]
+                    # dense rebuilds of the built segments re-cross the
+                    # link, and the segments still to come now cross
+                    # dense instead of sextet-packed
+                    o_b = seg * HW * ops_flat.dtype.itemsize
+                    extra += n_built * (o_b + seg * H
+                                        * rs_rh.dtype.itemsize)
+                    extra += (nseg - n_built) * (
+                        o_b - transfer.sextet_bytes(seg * HW))
+                sextet = False
+                # the counters AND this walk's diag must see what the
+                # link actually carried, or the fallback run would
+                # report a diet it did not get
+                transfer.count_put(extra, 0)
+                obs.count("lockstep.transfer_bytes", extra)
+                a0, b0 = dsegs["xfer"]
+                dsegs["xfer"] = (a0 + extra, b0)
+                R = dsegs["dR0"]
+                for k in range(i):
+                    _c, R = run(*dsegs["segs"][k], dsegs["dP"], R)
+                return run(*dsegs["segs"][i], dsegs["dP"], R)
+
+            if use_donate:
+                # exactly one `donate` record; the donated carry may
+                # already have been consumed by the failed dispatch:
+                # recompute it from the never-donated seed through the
+                # undonated jit
+                obs.engine_fallback("donate", type(e).__name__)
+                donate = False
+                try:
+                    R_cur = dsegs["dR0"]
+                    for k in range(i):
+                        _ck, R_cur = run(*dsegs["segs"][k],
+                                         dsegs["dP"], R_cur)
+                    ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+                except Exception as e2:                 # noqa: BLE001
+                    # not donation after all: the packed wire itself
+                    # fails on this backend — degrade it to dense
+                    if not packed_wire:
+                        raise
+                    ck, R_cur = _dense_recover(e2)
+            elif packed_wire:
+                ck, R_cur = _dense_recover(e)
+            else:
+                raise
         ckpts.append(ck)
     return ckpts, R_cur
 
@@ -503,7 +645,7 @@ class BatchInflight:
     work with device walks across bucket groups. ``device`` (when set)
     is the mesh device this group's lane block walks on."""
     __slots__ = ("P", "geom", "host_args", "R_lens", "dsegs",
-                 "ckpts", "final", "interpret", "device")
+                 "ckpts", "final", "interpret", "device", "degraded")
 
     def __init__(self, P, geom, host_args, R_lens, dsegs, ckpts,
                  final, interpret, device=None):
@@ -516,6 +658,9 @@ class BatchInflight:
         self.final = final
         self.interpret = interpret
         self.device = device
+        # set by collect_returns_batch when a lazy-fetch fallback
+        # degraded this walk's collect to eager full-array fetches
+        self.degraded = False
 
 
 class BatchPrepared:
@@ -564,7 +709,8 @@ def _pipe_walk_on(device, host_args, geom, n_pass: int, interpret: bool,
         return _pipe_walk_b(host_args, geom, n_pass, interpret, dsegs)
     import jax
     with jax.default_device(device):
-        return _pipe_walk_b(host_args, geom, n_pass, interpret, dsegs)
+        return _pipe_walk_b(host_args, geom, n_pass, interpret, dsegs,
+                            device=device)
 
 
 def dispatch_prepared(prep: BatchPrepared) -> BatchInflight:
@@ -591,30 +737,73 @@ def dispatch_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
         P, ret_slots, slot_ops, M, interpret=interpret))
 
 
+@functools.cache
+def _alive_lanes_call(H: int, S: int):
+    """On-device verdict reduction for the lockstep walk: H alive bits
+    cross the wire instead of the full [M, H*S] f32 config set — the
+    fixed few-byte summary the valid-history path fetches; the full
+    arrays (final set, block checkpoints) cross only when a lane is
+    invalid and witness localization needs them."""
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(
+        lambda f: jnp.max(f.reshape(f.shape[0], H, S), axis=(0, 2))
+        > 0.5)
+
+
 def collect_returns_batch(fl: BatchInflight) -> np.ndarray:
     """Fetch an in-flight lockstep walk's verdicts: ``dead[H]`` — per
     history, the first return index at which its config set emptied,
     or -1 if linearizable (exact rescue + localization as
-    :func:`walk_returns_batch`)."""
+    :func:`walk_returns_batch`). With lazy fetch (the default) the
+    valid path fetches only H on-device-reduced alive bits; eager
+    (``JEPSEN_TPU_NO_LAZY_FETCH=1``) fetches the full final set as in
+    round 5 — verdicts are bit-identical either way."""
     P, interpret = fl.P, fl.interpret
     geom, host_args, R_lens, dsegs = (fl.geom, fl.host_args, fl.R_lens,
                                       fl.dsegs)
     B, W, M, S, H, O1, R_pad = geom
     n_fast = min(W, _FAST_PASSES)
     ckpts, final = fl.ckpts, fl.final
-    final_np = np.asarray(final)                 # the ONE round-trip
     HS = H * S
-    alive = np.array([final_np[:, h * S:(h + 1) * S].any()
-                      for h in range(H)])
+    lazy = transfer.lazy_fetch_enabled()
+
+    def _alive_of(fin) -> np.ndarray:
+        nonlocal lazy
+
+        def _eager(fn):
+            obs.count("fetch.eager")
+            return np.array([fn[:, h * S:(h + 1) * S].any()
+                             for h in range(H)])
+
+        if lazy:
+            try:
+                a = np.asarray(_alive_lanes_call(H, S)(fin))
+                obs.count("fetch.lazy")
+                return a
+            except Exception as e:                      # noqa: BLE001
+                # fetch the final set FIRST: jax dispatch is async, so
+                # a walk error also surfaces at first consumption — a
+                # poisoned result propagates here and is NOT recorded
+                # as a lazy-fetch failure. Otherwise exactly one
+                # fallback; this collect degrades to eager
+                fn = np.asarray(fin)
+                obs.engine_fallback("lazy-fetch", type(e).__name__)
+                lazy = False
+                # the schedulers' diag reports the protocol the
+                # verdicts ACTUALLY crossed on, not the env gate
+                fl.degraded = True
+                return _eager(fn)
+        return _eager(np.asarray(fin))
+
+    alive = _alive_of(final)                     # the ONE round-trip
     if not alive.all() and n_fast < W:
         # capped-ladder deaths may be false: decide with the exact
         # W-pass walk (reuses the uploaded device segments)
         obs.count("lockstep.exact_rescue")
         ckpts, final = _pipe_walk_on(fl.device, host_args, geom, W,
                                      interpret, dsegs)
-        final_np = np.asarray(final)
-        alive = np.array([final_np[:, h * S:(h + 1) * S].any()
-                          for h in range(H)])
+        alive = _alive_of(final)
     dead = np.full(H, -1, np.int64)
     if alive.all():
         return dead

@@ -64,6 +64,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from jepsen_tpu import obs
+from jepsen_tpu.checkers import transfer
 from jepsen_tpu.checkers.reach_lane import _BLOCK, _FAST_PASSES, _idx_dtype
 
 # default chunk count: C*S lanes must stay within the batch kernel's
@@ -314,8 +316,30 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
     n_pass = W                      # exact closure — both phases need
     run_a = reach_batch._batch_call(  # soundness, not an under-approx
         b_a, W, M, S, C, O1, L_pad, n_pass, interpret, cdt)
-    _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
-                           jnp.asarray(r0_a))
+    # phase-A seeds are 0/1 exactly: they cross the wire bit-packed
+    # (8 per byte, unpacked on device by _batch_call.run); a packed
+    # dispatch failure records one fallback and retries dense
+    a_base = (ops_a.size * 4 + rs_a.size * 4 + P32.nbytes
+              + r0_a.nbytes)
+    if transfer.packed_enabled():
+        seed_a = transfer.pack_bool(r0_a)
+        transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
+                           + seed_a.nbytes, a_base)
+        try:
+            _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
+                                   seed_a)
+        except Exception as e:                          # noqa: BLE001
+            obs.engine_fallback("packed-xfer", type(e).__name__)
+            # the dense retry re-crosses the whole phase-A operand set
+            transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
+                               + r0_a.nbytes, 0)
+            _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
+                                   jnp.asarray(r0_a))
+    else:
+        transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
+                           + r0_a.nbytes, a_base)
+        _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
+                               jnp.asarray(r0_a))
     seeds_d, r0_b, cnt_d = _glue_call(C, M, S, e_pad)(final_a)
     # phase B through the batch engine's segmented put+dispatch
     # pipeline: segment i+1's operand upload streams while the device

@@ -54,6 +54,9 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from jepsen_tpu import obs
+from jepsen_tpu.checkers import transfer
+
 _BLOCK = 1024
 # ladder cap for the fast walk. Gates above a return's pending count
 # are untaken (~free), so a higher cap costs W<=5 histories nothing at
@@ -86,12 +89,10 @@ def _idx_dtype(O1: int):
     cast happens inside the jitted program, so the wire carries only
     these bytes — ``slot_ops`` is the dominant operand (R_pad*W
     entries), and at the headline config (O1=36) int8 halves total
-    host->device transfer vs the former int16."""
-    if O1 <= np.iinfo(np.int8).max:
-        return np.int8
-    if O1 <= np.iinfo(np.int16).max:
-        return np.int16
-    return np.int32
+    host->device transfer vs the former int16. Delegates to
+    :func:`transfer.idx_dtype`, whose int32 overflow fallback bumps
+    ``transfer.narrow_fallback``."""
+    return transfer.idx_dtype(O1)
 
 
 def _project(R, j, W: int, M: int, S: int):
@@ -198,7 +199,7 @@ def _make_kernel(B: int, W: int, M: int, S: int, O1: int,
 
 @functools.cache
 def _lane_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
-               n_pass: int, interpret: bool):
+               n_pass: int, interpret: bool, donate: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -238,15 +239,31 @@ def _lane_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
     )
 
     def run(ret_slot, slot_ops, P, R0):
+        if R0.dtype == jnp.uint8:
+            # bit-packed config seed: 8 configs per wire byte, unpacked
+            # on device where bandwidth is free (the transfer diet)
+            R0 = jnp.unpackbits(R0, count=M * S).reshape(M, S) \
+                    .astype(jnp.float32)
+        if slot_ops.dtype == jnp.uint8:
+            # 6-bit packed ops lane (4 values per 3 wire bytes): the
+            # dense narrow format is SIGNED, so uint8 unambiguously
+            # marks the packed lane
+            slot_ops = transfer.unpack_sextet_jnp(slot_ops, R_pad * W)
         # pending count per return — the gate ladder's exact per-return
         # pass bound (fire chains set distinct pending slots, so c_r
-        # passes close). Derived on device so the wire never carries it.
-        ops32 = slot_ops.astype(jnp.int32)
-        pend = jnp.sum((ops32.reshape(-1, W) >= 0).astype(jnp.int32),
+        # passes close). Derived on device FROM THE NARROW wire array
+        # (no eager int32 materialization before the reduce); the int32
+        # upcast exists only as the kernel's SMEM operand.
+        pend = jnp.sum((slot_ops.reshape(-1, W) >= 0).astype(jnp.int32),
                        axis=1)
-        return call(ret_slot.astype(jnp.int32), ops32, pend, P, R0)
+        return call(ret_slot.astype(jnp.int32),
+                    slot_ops.astype(jnp.int32), pend, P, R0)
 
-    return jax.jit(run)
+    # donating the carried config set lets XLA recycle its HBM buffer
+    # for the segment's `final` output (same [M, S] f32 geometry)
+    # instead of reallocating per dispatch; only pipeline-intermediate
+    # carries are donated (see _pipe_walk — dR0 must survive rescues)
+    return jax.jit(run, donate_argnums=(3,)) if donate else jax.jit(run)
 
 
 # -- keyed batch: many independent keys in one kernel ------------------------
@@ -355,11 +372,15 @@ def _keyed_call(B: int, W: int, M: int, S: int, O1: int, N_pad: int,
     )
 
     def run(ret_slot, slot_ops, key_id, P):
-        # pending counts derived on device (see _lane_call.run)
-        ops32 = slot_ops.astype(jnp.int32)
-        pend = jnp.sum((ops32.reshape(-1, W) >= 0).astype(jnp.int32),
+        if slot_ops.dtype == jnp.uint8:
+            # 6-bit packed ops lane — see _lane_call.run
+            slot_ops = transfer.unpack_sextet_jnp(slot_ops, N_pad * W)
+        # pending counts derived on device from the narrow wire arrays
+        # (see _lane_call.run)
+        pend = jnp.sum((slot_ops.reshape(-1, W) >= 0).astype(jnp.int32),
                        axis=1)
-        return call(ret_slot.astype(jnp.int32), ops32, pend,
+        return call(ret_slot.astype(jnp.int32),
+                    slot_ops.astype(jnp.int32), pend,
                     key_id.astype(jnp.int32), P)
 
     return jax.jit(run)
@@ -389,12 +410,37 @@ def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
         key_id = np.pad(key_id, (0, N_pad - N), constant_values=-1)
     run = _keyed_call(B, W, M, S, O1, N_pad, K_pad, W, interpret)
     idx_dt = _idx_dtype(O1)
-    args = jax.device_put((
-        np.ascontiguousarray(ret_slot, np.int8),
-        np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
-        np.ascontiguousarray(key_id, np.int32),
-        np.ascontiguousarray(P, np.float32)))
-    (dead,) = run(*args)
+    # key ids ride the narrowest signed dtype holding [-1, K_pad) —
+    # the in-jit upcast to the kernel's i32 SMEM operand is free
+    key_dt = transfer.idx_dtype(K_pad) if transfer.packed_enabled() \
+        else np.int32
+    so_dense = np.ascontiguousarray(slot_ops.reshape(-1), idx_dt)
+    so_flat = so_dense
+    packed = transfer.packed_enabled() and transfer.sextet_ok(O1)
+    if packed:
+        # the dominant operand crosses 6-bit packed (4 ops / 3 bytes),
+        # unpacked in-jit where bandwidth is free
+        so_flat = transfer.pack_sextet(so_dense)
+    host_args = (np.ascontiguousarray(ret_slot, np.int8),
+                 so_flat,
+                 np.ascontiguousarray(key_id, key_dt),
+                 np.ascontiguousarray(P, np.float32))
+    transfer.count_put(sum(a.nbytes for a in host_args),
+                       N_pad * 4 + N_pad * W * 4 + N_pad * 4 + P.nbytes)
+    args = jax.device_put(host_args)
+    try:
+        (dead,) = run(*args)
+    except Exception as e:                              # noqa: BLE001
+        if not (packed or key_dt != np.int32):
+            raise
+        # same packed-wire contract as the pipe walk: ONE fallback
+        # record, retry the round-5 dense format, count the re-upload
+        obs.engine_fallback("packed-xfer", type(e).__name__)
+        host_args = (host_args[0], so_dense,
+                     np.ascontiguousarray(key_id, np.int32),
+                     host_args[3])
+        transfer.count_put(sum(a.nbytes for a in host_args), 0)
+        (dead,) = run(*jax.device_put(host_args))
     return np.asarray(dead)[:n_keys]
 
 
@@ -449,11 +495,16 @@ def pack_operands(P: np.ndarray, ret_slot: np.ndarray,
     # the pending count per return (the gate ladder's exact per-return
     # pass bound) is NOT shipped: it is derived from slot_ops by a
     # trivial XLA reduce on device (see _lane_call.run), saving R_pad
-    # wire bytes per check
+    # wire bytes per check. The config seed crosses bit-packed
+    # (8 configs/byte, unpacked on device) unless the diet is off.
+    if transfer.packed_enabled():
+        r0_wire = transfer.pack_bool(R0_sm.T)
+    else:
+        r0_wire = np.ascontiguousarray(R0_sm.T, np.float32)
     host_args = (np.ascontiguousarray(ret_slot, np.int8),
                  np.ascontiguousarray(slot_ops.reshape(-1), idx_dt),
                  np.ascontiguousarray(P, np.float32),
-                 np.ascontiguousarray(R0_sm.T, np.float32))
+                 r0_wire)
     geom = (B, W, M, S, O1, R_pad)
     return geom, ret_slot, slot_ops, host_args
 
@@ -471,15 +522,34 @@ def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
     ret_slot, slot_ops_flat, P, R0 = host_args
     dP = jax.device_put(P)
     R_cur = jax.device_put(R0)
+    transfer.count_put(
+        int(ret_slot.nbytes) + int(slot_ops_flat.nbytes)
+        + int(P.nbytes) + int(R0.nbytes),
+        blanket_bytes(geom, P.nbytes))
     base = 0
     while base < R_pad:
         if should_abort():
             raise Aborted()
         seg = min(_ABORT_SEG, R_pad - base)
         run = _lane_call(B, W, M, S, O1, seg, n_pass, interpret)
-        ckpt, final = run(ret_slot[base:base + seg],
-                          slot_ops_flat[base * W:(base + seg) * W],
-                          dP, R_cur)
+        try:
+            ckpt, final = run(ret_slot[base:base + seg],
+                              slot_ops_flat[base * W:(base + seg) * W],
+                              dP, R_cur)
+        except Exception as e:                          # noqa: BLE001
+            # only the first dispatch consumes the bit-packed seed;
+            # same packed-wire contract as the pipe walk: ONE fallback
+            # record, dense retry, re-upload counted
+            if getattr(R_cur, "dtype", None) != np.uint8:
+                raise
+            obs.engine_fallback("packed-xfer", type(e).__name__)
+            dense = transfer.unpack_bool_host(np.asarray(R_cur), M * S)
+            R_cur = jax.device_put(
+                dense.reshape(M, S).astype(np.float32))
+            transfer.count_put(M * S * 4, 0)
+            ckpt, final = run(ret_slot[base:base + seg],
+                              slot_ops_flat[base * W:(base + seg) * W],
+                              dP, R_cur)
         final_np = np.asarray(final)
         if not final_np.any():
             # dead in this segment: locate the first empty checkpoint
@@ -522,6 +592,66 @@ def _pipe_geom(B: int, R_pad: int,
     return segb * B, -(-n_blocks // segb)
 
 
+def blanket_bytes(geom, p_nbytes: int) -> int:
+    """Bytes of the dtype-blind blanket int32/f32 single-history
+    operand set — the upper bound a format-unaware marshaller would
+    ship, and the unpacked side of every :func:`transfer.count_put`
+    pair (shared with ``bench.py``'s probes so the baseline cannot
+    drift). NOTE: round 5 already shipped the integer lanes narrow
+    (int8 ``ret_slot``, ``_idx_dtype`` ops); the shipped-wire
+    comparison is :func:`round5_bytes`, and run-over-run bench
+    ``transfer_bytes`` values compare actual wire to actual wire."""
+    _B, W, M, S, _O1, R_pad = geom
+    return R_pad * 4 + R_pad * W * 4 + int(p_nbytes) + M * S * 4
+
+
+def round5_bytes(geom, p_nbytes: int) -> int:
+    """Bytes the ROUND-5 wire actually shipped for this operand set
+    (narrow ints, f32 seed, f32 P) — the honest upload-side baseline
+    for \"how much did round 6 save\": the diet's upload wins over it
+    are the 6-bit ops lane and the bit-packed seed; the larger round-6
+    win is on the fetch side (one reduced verdict byte instead of the
+    [M, S] f32 final set)."""
+    _B, W, M, S, O1, R_pad = geom
+    idx_sz = np.dtype(transfer.idx_dtype(O1, count=False)).itemsize
+    return R_pad * 1 + R_pad * W * idx_sz + int(p_nbytes) + M * S * 4
+
+
+def pack_ops_wire(geom, slot_ops_flat) -> np.ndarray:
+    """The ops lane exactly as :func:`_pipe_walk` uploads it: 6-bit
+    packed per segment, ragged tail identity-padded, concatenated.
+    ``bench.py``'s put-observer moves this so the bytes it times are
+    the bytes :func:`wire_bytes` accounts."""
+    B, W, _M, _S, _O1, R_pad = geom
+    seg, _nseg = _pipe_geom(B, R_pad)
+    parts = []
+    for lo in range(0, R_pad, seg):
+        hi = min(lo + seg, R_pad)
+        so = slot_ops_flat[lo * W:hi * W]
+        if hi - lo < seg:
+            so = np.pad(so, (0, (seg - (hi - lo)) * W),
+                        constant_values=-1)
+        parts.append(transfer.pack_sextet(so))
+    return np.concatenate(parts)
+
+
+def wire_bytes(geom, host_args) -> int:
+    """Actual host→device bytes :func:`_pipe_walk` moves for this
+    operand set: the 6-bit ops lane packs per segment (so the segment
+    slices stay byte-aligned), everything else crosses as marshalled
+    by :func:`pack_operands`. Shared with ``bench.py``'s probes so the
+    measurement can never drift from production accounting."""
+    B, W, M, S, O1, R_pad = geom
+    ret_slot, slot_ops_flat, P, R0 = host_args
+    if transfer.packed_enabled() and transfer.sextet_ok(O1):
+        seg, nseg = _pipe_geom(B, R_pad)
+        ops_b = nseg * transfer.sextet_bytes(seg * W)
+    else:
+        ops_b = int(slot_ops_flat.nbytes)
+    return int(ret_slot.nbytes) + ops_b + int(P.nbytes) \
+        + int(R0.nbytes)
+
+
 def _pipe_walk(host_args, geom, n_pass: int, interpret: bool,
                dsegs: dict):
     """Put + dispatch the walk in :data:`_PIPE_NSEG` segments with the
@@ -529,41 +659,151 @@ def _pipe_walk(host_args, geom, n_pass: int, interpret: bool,
     device walks segment *i*, segment *i+1*'s operands stream over the
     otherwise-idle link. ``dsegs`` caches the per-segment device arrays
     so a rescue walk (different pass count, same operands) re-dispatches
-    without re-uploading. Returns ``(ckpts, final)`` — a list of
-    per-segment device checkpoint arrays (block starts, concatenation
-    equals the single-dispatch checkpoint stream) and the final device
-    config set. Nothing here blocks; the caller fetches."""
+    without re-uploading. The dominant ``slot_ops`` operand crosses
+    6-bit packed (4 ops per 3 wire bytes, per segment) whenever the
+    alphabet fits the sextet lane. Returns ``(ckpts, final)`` — a list
+    of per-segment device checkpoint arrays (block starts,
+    concatenation equals the single-dispatch checkpoint stream) and the
+    final device config set. Nothing here blocks; the caller fetches."""
     import jax
 
     B, W, M, S, O1, R_pad = geom
     ret_slot, slot_ops_flat, P, R0 = host_args
     seg, nseg = _pipe_geom(B, R_pad)
     run = _lane_call(B, W, M, S, O1, seg, n_pass, interpret)
+    run_d = None
+    donate = transfer.donate_enabled()
+    sextet = transfer.packed_enabled() and transfer.sextet_ok(O1)
+
+    def _seg_host(k: int):
+        """Segment ``k``'s host operands in the dense narrow format."""
+        lo, hi = k * seg, min((k + 1) * seg, R_pad)
+        rs_seg = ret_slot[lo:hi]
+        so_seg = slot_ops_flat[lo * W:hi * W]
+        if hi - lo < seg:                # ragged tail: identity pad rows
+            rs_seg = np.pad(rs_seg, (0, seg - (hi - lo)),
+                            constant_values=-1)
+            so_seg = np.pad(so_seg, (0, (seg - (hi - lo)) * W),
+                            constant_values=-1)
+        return (np.ascontiguousarray(rs_seg),
+                np.ascontiguousarray(so_seg))
+
     fresh = "segs" not in dsegs
     if fresh:
+        # plain put, not transfer.cached_put: every check_packed builds
+        # a fresh P so an identity-keyed hit never happens here, while
+        # the cache would pin dead (host, device) P pairs across checks
+        # — only the lockstep path (one P per group sequence) caches
         dsegs["dP"] = jax.device_put(P)
         dsegs["segs"] = []
-    R_cur = jax.device_put(R0) if fresh else dsegs["dR0"]
-    if fresh:
-        dsegs["dR0"] = R_cur
+        dsegs["dR0"] = jax.device_put(R0)
+        # wire accounting: bytes this upload actually moves vs the
+        # blanket int32/f32 format the diet replaced
+        transfer.count_put(wire_bytes(geom, host_args),
+                           blanket_bytes(geom, P.nbytes))
+    R_cur = dsegs["dR0"]
     ckpts = []
     for i in range(nseg):
         if fresh:
-            lo, hi = i * seg, min((i + 1) * seg, R_pad)
-            rs_seg = ret_slot[lo:hi]
-            so_seg = slot_ops_flat[lo * W:hi * W]
-            if hi - lo < seg:            # ragged tail: identity pad rows
-                rs_seg = np.pad(rs_seg, (0, seg - (hi - lo)),
-                                constant_values=-1)
-                so_seg = np.pad(so_seg, (0, (seg - (hi - lo)) * W),
-                                constant_values=-1)
+            rs_seg, so_seg = _seg_host(i)
             dsegs["segs"].append(jax.device_put(
-                (np.ascontiguousarray(rs_seg),
-                 np.ascontiguousarray(so_seg))))
+                (rs_seg,
+                 transfer.pack_sextet(so_seg) if sextet else so_seg)))
         a, b = dsegs["segs"][i]
-        ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+        # only pipeline-INTERMEDIATE carries are donated: dR0 must
+        # survive for the rescue walk's re-dispatch, and segment i>0's
+        # input is the previous segment's final, referenced nowhere
+        # else once consumed
+        use_donate = donate and i > 0
+        try:
+            if use_donate:
+                if run_d is None:
+                    run_d = _lane_call(B, W, M, S, O1, seg, n_pass,
+                                       interpret, True)
+                ck, R_cur = run_d(a, b, dsegs["dP"], R_cur)
+                obs.count("donate.reuse")
+            else:
+                ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+        except Exception as e:                          # noqa: BLE001
+            # packedness of what's actually resident, not the env gate:
+            # a rescue re-entry may carry dense segments from a prior
+            # call's fallback while the gate still reads open
+            packed_wire = (
+                getattr(dsegs["dR0"], "dtype", None) == np.uint8
+                or getattr(b, "dtype", None) == np.uint8)
+
+            def _dense_recover(exc):
+                """ONE `packed-xfer` record: re-materialize the round-5
+                dense format host-side (f32 seed, signed narrow ops —
+                every built segment too, so the record covers the rest
+                of the walk), account the re-uploads, and re-walk
+                segments 0..i undonated from the seed."""
+                nonlocal sextet
+                obs.engine_fallback("packed-xfer", type(exc).__name__)
+                extra = 0
+                if getattr(dsegs["dR0"], "dtype", None) == np.uint8:
+                    dense = transfer.unpack_bool_host(
+                        np.asarray(dsegs["dR0"]), M * S)
+                    dsegs["dR0"] = jax.device_put(
+                        dense.reshape(M, S).astype(np.float32))
+                    extra += M * S * 4
+                if getattr(dsegs["segs"][i][1], "dtype",
+                           None) == np.uint8:
+                    n_built = len(dsegs["segs"])
+                    dsegs["segs"] = [jax.device_put(_seg_host(k))
+                                     for k in range(n_built)]
+                    # dense rebuilds of the built segments re-cross the
+                    # link, and the segments still to come now cross
+                    # dense instead of sextet-packed
+                    so_b = seg * W * slot_ops_flat.dtype.itemsize
+                    extra += n_built * (seg * ret_slot.dtype.itemsize
+                                        + so_b)
+                    extra += (nseg - n_built) * (
+                        so_b - transfer.sextet_bytes(seg * W))
+                sextet = False
+                transfer.count_put(extra, 0)
+                R = dsegs["dR0"]
+                for k in range(i):
+                    _c, R = run(*dsegs["segs"][k], dsegs["dP"], R)
+                return run(*dsegs["segs"][i], dsegs["dP"], R)
+
+            if use_donate:
+                # exactly one `donate` record; the rest of the walk
+                # degrades to the undonated round-5 dispatch. The
+                # donated carry may already have been consumed by the
+                # failed dispatch, so recompute it from the never-
+                # donated seed through the undonated jit
+                obs.engine_fallback("donate", type(e).__name__)
+                donate = False
+                try:
+                    R_cur = dsegs["dR0"]
+                    for k in range(i):
+                        _ck, R_cur = run(*dsegs["segs"][k],
+                                         dsegs["dP"], R_cur)
+                    ck, R_cur = run(a, b, dsegs["dP"], R_cur)
+                except Exception as e2:                 # noqa: BLE001
+                    # not donation after all: the packed wire itself
+                    # fails on this backend — degrade it to dense
+                    if not packed_wire:
+                        raise
+                    ck, R_cur = _dense_recover(e2)
+            elif packed_wire:
+                ck, R_cur = _dense_recover(e)
+            else:
+                raise
         ckpts.append(ck)
     return ckpts, R_cur
+
+
+@functools.cache
+def _jit_any():
+    """On-device verdict reduction: ONE boolean crosses the wire
+    instead of the full [M, S] config set (the lazy-fetch half of the
+    transfer diet; the full set is fetched only when a consumer —
+    witness decode, ``fetch_R`` — actually needs it)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda f: jnp.any(f > 0.5))
 
 
 def _pipe_ckpt_np(ckpts, n_blocks: int) -> np.ndarray:
@@ -616,9 +856,37 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
                                           should_abort, R_real)
         return -1, (final_np > 0.5).T if fetch_R else None
     dsegs: dict = {}                     # device operands, upload once
+    lazy = transfer.lazy_fetch_enabled()
+
+    def _alive(fin) -> Tuple[bool, Optional[np.ndarray]]:
+        """Verdict of a completed walk: with lazy fetch ONE boolean
+        crosses the wire (the round trip the valid path pays); eager
+        fetches the full set. Returns ``(alive, final_np_or_None)``;
+        a summary-reduction failure records one obs fallback and the
+        call degrades to eager for the rest of this walk."""
+        nonlocal lazy
+        if lazy:
+            try:
+                a = bool(np.asarray(_jit_any()(fin)))
+                obs.count("fetch.lazy")
+                return a, None
+            except Exception as e:                      # noqa: BLE001
+                # fetch the final set FIRST: jax dispatch is async, so
+                # a walk error also surfaces at first consumption — a
+                # poisoned result propagates here and is NOT recorded
+                # as a lazy-fetch failure
+                fn = np.asarray(fin)
+                obs.engine_fallback("lazy-fetch", type(e).__name__)
+                lazy = False
+                obs.count("fetch.eager")
+                return bool(fn.any()), fn
+        fn = np.asarray(fin)
+        obs.count("fetch.eager")
+        return bool(fn.any()), fn
+
     ckpts, final = _pipe_walk(host_args, geom, n_fast, interpret, dsegs)
-    final_np = np.asarray(final)                 # the ONE round-trip
-    if final_np.any():
+    alive, final_np = _alive(final)              # the ONE round-trip
+    if alive:
         # sound: fewer-than-W passes only UNDER-approximate the config
         # set, and emptiness is monotone, so a surviving set certifies
         # linearizability exactly
@@ -627,16 +895,24 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
             # ladder was capped below W; consumers of R_final (evidence
             # decoding) get the exact set from the W-pass kernel
             _, final = _pipe_walk(host_args, geom, W, interpret, dsegs)
-            final_np = np.asarray(final)
-        return -1, (final_np > 0.5).T if fetch_R else None
+            final_np = None
+        if not fetch_R:
+            return -1, None
+        if final_np is None:
+            final_np = np.asarray(final)         # lazy: R consumers pay
+        return -1, (final_np > 0.5).T
     if n_fast < W:
         # the fast kernel's verdict may be a false death: decide with
         # the exact W-pass kernel (rare — invalid histories and the
         # occasional deep-chain-dependent valid one)
         ckpts, final = _pipe_walk(host_args, geom, W, interpret, dsegs)
-        final_np = np.asarray(final)
-        if final_np.any():
-            return -1, (final_np > 0.5).T if fetch_R else None
+        alive, final_np = _alive(final)
+        if alive:
+            if not fetch_R:
+                return -1, None
+            if final_np is None:
+                final_np = np.asarray(final)
+            return -1, (final_np > 0.5).T
     # dead for real: locate the first empty checkpoint (block starts),
     # then re-walk the preceding block exactly for the knossos-style
     # failing return index

@@ -37,6 +37,9 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from jepsen_tpu import obs
+from jepsen_tpu.checkers import transfer
+
 
 def _gather_G(slot_ops_ref, P_ref, k: int, W: int, O1: int):
     """Concatenate the W pending ops' transition matrices for return ``k``
@@ -204,7 +207,18 @@ def _walk_call(B: int, W: int, M: int, S: int, O1: int, R_pad: int,
         ],
         interpret=interpret,
     )
-    return jax.jit(call)
+
+    def run(rlim, ret_slot, slot_ops, R0, P):
+        # narrow wire, int32 on device: the upcasts live inside the
+        # jitted program so the link carries only the narrow bytes;
+        # the R0 seed may arrive bit-packed (8 configs per byte)
+        if R0.dtype == jnp.uint8:
+            R0 = jnp.unpackbits(R0, count=M * S).reshape(M, S) \
+                    .astype(jnp.float32)
+        return call(rlim, ret_slot.astype(jnp.int32),
+                    slot_ops.astype(jnp.int32), R0, P)
+
+    return jax.jit(run)
 
 
 _BLOCK = 1024     # XLA tiles 1-D s32 SMEM operands at T(1024); the block
@@ -244,14 +258,44 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
         slot_ops = np.pad(slot_ops, ((0, R_pad - R_real), (0, 0)),
                           constant_values=-1)
     call = _walk_call(B, W, M, S, O1, R_pad, interpret)
-    # one batched host->device transfer, not five round-trips
-    args = jax.device_put((
-        np.array([R_real], np.int32),
-        np.ascontiguousarray(ret_slot, np.int32),
-        np.ascontiguousarray(slot_ops.reshape(-1), np.int32),
-        np.ascontiguousarray(R0_sm.T, np.float32),
-        np.ascontiguousarray(P, np.float32)))
-    R_out, dead = call(*args)
+    # one batched host->device transfer, not five round-trips — on the
+    # narrow/bit-packed wire format (in-jit upcasts; round-5 int32/f32
+    # with the diet opted out)
+    def _dense_args():
+        return (
+            np.array([R_real], np.int32),
+            np.ascontiguousarray(ret_slot, np.int32),
+            np.ascontiguousarray(slot_ops.reshape(-1), np.int32),
+            np.ascontiguousarray(R0_sm.T, np.float32),
+            np.ascontiguousarray(P, np.float32))
+
+    packed = transfer.packed_enabled()
+    if packed:
+        host_args = (
+            np.array([R_real], np.int32),
+            np.ascontiguousarray(ret_slot, transfer.idx_dtype(W)),
+            np.ascontiguousarray(slot_ops.reshape(-1),
+                                 transfer.idx_dtype(O1)),
+            transfer.pack_bool(R0_sm.T),
+            np.ascontiguousarray(P, np.float32))
+    else:
+        host_args = _dense_args()
+    transfer.count_put(sum(a.nbytes for a in host_args),
+                       4 + R_pad * 4 + R_pad * W * 4 + M * S * 4
+                       + P.nbytes)
+    args = jax.device_put(host_args)
+    try:
+        R_out, dead = call(*args)
+    except Exception as e:                              # noqa: BLE001
+        if not packed:
+            raise
+        # a packed-wire dispatch failed: ONE fallback record, retry the
+        # dense round-5 format (same contract as the other engines);
+        # the re-upload's bytes are counted — they really crossed
+        obs.engine_fallback("packed-xfer", type(e).__name__)
+        host_args = _dense_args()
+        transfer.count_put(sum(a.nbytes for a in host_args), 0)
+        R_out, dead = call(*jax.device_put(host_args))
     return int(dead[0]), (np.asarray(R_out, bool).T if fetch_R else None)
 
 
@@ -358,7 +402,14 @@ def _keyed_call(B: int, W: int, M: int, S: int, O1: int, N_pad: int,
         ],
         interpret=interpret,
     )
-    return jax.jit(call)
+
+    def run(ret_slot, slot_ops, key_id, P):
+        # in-jit upcasts off the narrow wire (see _walk_call.run)
+        return call(ret_slot.astype(jnp.int32),
+                    slot_ops.astype(jnp.int32),
+                    key_id.astype(jnp.int32), P)
+
+    return jax.jit(run)
 
 
 def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
@@ -389,10 +440,35 @@ def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
                           constant_values=-1)
         key_id = np.pad(key_id, (0, N_pad - N), constant_values=-1)
     call = _keyed_call(B, W, M, S, O1, N_pad, K_pad, interpret)
-    args = jax.device_put((
-        np.ascontiguousarray(ret_slot, np.int32),
-        np.ascontiguousarray(slot_ops.reshape(-1), np.int32),
-        np.ascontiguousarray(key_id, np.int32),
-        np.ascontiguousarray(P, np.float32)))
-    (dead,) = call(*args)
+    def _dense_args():
+        return (
+            np.ascontiguousarray(ret_slot, np.int32),
+            np.ascontiguousarray(slot_ops.reshape(-1), np.int32),
+            np.ascontiguousarray(key_id, np.int32),
+            np.ascontiguousarray(P, np.float32))
+
+    packed = transfer.packed_enabled()
+    if packed:
+        host_args = (
+            np.ascontiguousarray(ret_slot, transfer.idx_dtype(W)),
+            np.ascontiguousarray(slot_ops.reshape(-1),
+                                 transfer.idx_dtype(O1)),
+            np.ascontiguousarray(key_id, transfer.idx_dtype(K_pad)),
+            np.ascontiguousarray(P, np.float32))
+    else:
+        host_args = _dense_args()
+    transfer.count_put(sum(a.nbytes for a in host_args),
+                       N_pad * 4 + N_pad * W * 4 + N_pad * 4 + P.nbytes)
+    args = jax.device_put(host_args)
+    try:
+        (dead,) = call(*args)
+    except Exception as e:                              # noqa: BLE001
+        if not packed:
+            raise
+        # same packed-wire contract as walk_returns: one fallback
+        # record, dense retry, re-upload bytes counted
+        obs.engine_fallback("packed-xfer", type(e).__name__)
+        host_args = _dense_args()
+        transfer.count_put(sum(a.nbytes for a in host_args), 0)
+        (dead,) = call(*jax.device_put(host_args))
     return np.asarray(dead)[:n_keys]

@@ -1,0 +1,222 @@
+"""Wire-format transfer diet shared by the dense-walk engines (the
+round-6 tentpole): the BENCH_r05 kernel probe showed the single-history
+walk compute-UNbound — ``kernel_s 0.0593`` against
+``dispatch_fetch_s 0.1136``, with ``transfer_sync_s 0.037`` (bytes on
+the wire) eating more than half of the bare round-trip — so the
+remaining hot-path wall is host↔device marshaling, not the kernel.
+This module centralizes the three independently opt-out responses:
+
+1. **Narrow + bit-packed wire format** (``JEPSEN_TPU_NO_PACKED_XFER``):
+   integer operands cross the link as the narrowest dtype that fits
+   the geometry (:func:`idx_dtype`, with an explicit int32 overflow
+   fallback that bumps ``transfer.narrow_fallback``), and boolean
+   tensors (config-set seeds, R0 blocks) cross packed 8-per-byte
+   (:func:`pack_bool`) and are unpacked ON DEVICE where bandwidth is
+   free (``jnp.unpackbits`` inside the jitted program) — a 32×
+   reduction on each f32-bool tensor.
+2. **On-device verdict reduction / lazy fetch**
+   (``JEPSEN_TPU_NO_LAZY_FETCH``): each dispatch's verdict is fetched
+   as a fixed few-byte summary (a per-lane alive bit), and the full
+   config-set / checkpoint arrays cross the wire only when a lane is
+   invalid and witness reconstruction needs them. Callers count each
+   decision (``fetch.lazy`` / ``fetch.eager``).
+3. **Donated, reused device buffers** (``JEPSEN_TPU_NO_DONATE``): the
+   carried config set is donated (``donate_argnums``) across pipeline
+   segments so XLA recycles the HBM buffer instead of reallocating per
+   dispatch, and per-geometry read-only operands (the transition
+   tensor P) are cached device-resident across the group sequence
+   (:func:`cached_put`) — both count ``donate.reuse``.
+
+Every optimization degrades, never lies: a failure on any of the three
+paths records exactly ONE obs fallback (stage ``packed-xfer`` /
+``lazy-fetch`` / ``donate``) at its call site and re-runs on the
+round-5 path with bit-identical verdicts (differentially tested in
+``tests/test_transfer_diet.py``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu import obs
+
+
+def packed_enabled() -> bool:
+    """Bit-packed bools + the NEW narrow int lanes (key ids, the
+    first-generation kernel's operands) are on by default;
+    ``JEPSEN_TPU_NO_PACKED_XFER=1`` restores the round-5 wire format
+    (consulted per call — tests toggle it)."""
+    return not os.environ.get("JEPSEN_TPU_NO_PACKED_XFER")
+
+
+def lazy_fetch_enabled() -> bool:
+    """Verdict-summary fetches (per-lane alive bits; full arrays only
+    on death/witness demand) are on by default;
+    ``JEPSEN_TPU_NO_LAZY_FETCH=1`` restores eager full-array fetches."""
+    return not os.environ.get("JEPSEN_TPU_NO_LAZY_FETCH")
+
+
+def donate_enabled() -> bool:
+    """Donated carried-config-set buffers across pipeline segments.
+    On by default — jax ≥ 0.4.31 donates on every backend including
+    CPU — ``JEPSEN_TPU_NO_DONATE=1`` opts out (and also disables the
+    device-resident operand reuse of :func:`cached_put`)."""
+    return not os.environ.get("JEPSEN_TPU_NO_DONATE")
+
+
+def reuse_enabled() -> bool:
+    """Device-resident operand reuse shares the donation opt-out: both
+    are the 'stop re-allocating/re-uploading per dispatch' half of the
+    diet."""
+    return donate_enabled()
+
+
+def fetch_mode() -> str:
+    return "lazy" if lazy_fetch_enabled() else "eager"
+
+
+def record_mode() -> None:
+    """Gauge the diet configuration once per facade entry so run
+    artifacts (obs.jsonl, bench output) name which wire format the
+    verdicts crossed on."""
+    obs.gauge("transfer.mode", {"packed": packed_enabled(),
+                                "lazy_fetch": lazy_fetch_enabled(),
+                                "donate": donate_enabled()})
+
+
+def idx_dtype(n1: int, count: bool = True):
+    """Narrowest SIGNED dtype holding indices in [-1, ``n1``): the
+    int32 upcast happens inside the jitted program, so the wire
+    carries only these bytes. The explicit overflow guard falls back
+    to int32 and bumps ``transfer.narrow_fallback`` — a geometry too
+    wide for the diet is visible, never silently mis-marshalled.
+    Accounting-only callers (byte math, probes) pass ``count=False``
+    so the counter stays a count of WIRE decisions."""
+    if n1 <= np.iinfo(np.int8).max:
+        return np.int8
+    if n1 <= np.iinfo(np.int16).max:
+        return np.int16
+    if count:
+        obs.count("transfer.narrow_fallback")
+    return np.int32
+
+
+def sextet_ok(O1: int) -> bool:
+    """Whether ``slot_ops``-style index arrays with values in
+    ``[-1, O1)`` fit the 6-bit wire lane (``v + 1`` must fit in
+    ``[0, 63]``). The dense walks' dominant operand is ``slot_ops`` —
+    R_pad*W entries already at int8 — so sub-byte packing is the only
+    lever left on it; at the headline alphabet (O1=36) this takes the
+    whole operand set another 1.25x down."""
+    return 0 < O1 <= 63
+
+
+def sextet_bytes(n: int) -> int:
+    """Wire bytes of ``n`` sextet-packed values (for accounting)."""
+    return (n * 6 + 7) // 8
+
+
+def pack_sextet(a: np.ndarray) -> np.ndarray:
+    """Host half of the 6-bit pair: values in ``[-1, 62]`` as ``v+1``
+    sextets, big-endian bits, 4 values per 3 bytes — exactly what
+    :func:`unpack_sextet_jnp` inverts on device."""
+    v = (np.asarray(a, np.int16).reshape(-1) + 1).astype(np.uint8)
+    bits = np.unpackbits(v[:, None], axis=1)[:, 2:]      # 6 LSBs
+    return np.packbits(bits.reshape(-1))
+
+
+def unpack_sextet_host(packed: np.ndarray, n: int) -> np.ndarray:
+    """Host-side inverse of :func:`pack_sextet` (the packed-transfer
+    fallback path and tests)."""
+    bits = np.unpackbits(np.asarray(packed, np.uint8), count=n * 6)
+    w = np.array([32, 16, 8, 4, 2, 1], np.int32)
+    return (bits.reshape(n, 6).astype(np.int32) * w).sum(axis=1) - 1
+
+
+def unpack_sextet_jnp(packed, n: int):
+    """Device half of the 6-bit pair: called INSIDE the kernels' jit
+    wrappers so the unpack runs where bandwidth is free (elementwise
+    ops only — safe on every backend)."""
+    import jax.numpy as jnp
+    bits = jnp.unpackbits(packed, count=n * 6).reshape(n, 6) \
+              .astype(jnp.int32)
+    w = jnp.array([32, 16, 8, 4, 2, 1], jnp.int32)
+    return jnp.sum(bits * w, axis=1) - 1
+
+
+def pack_bool(a: np.ndarray) -> np.ndarray:
+    """Host half of the packbits/unpackbits pair: a boolean (or 0/1)
+    tensor as uint8, 8 elements per byte, C-order big-endian bits —
+    exactly what ``jnp.unpackbits(..., count=n)`` inverts on device."""
+    return np.packbits(np.ascontiguousarray(a).astype(bool).reshape(-1))
+
+
+def unpack_bool_host(packed: np.ndarray, n: int) -> np.ndarray:
+    """Host-side inverse of :func:`pack_bool` (the packed-transfer
+    FALLBACK path: re-materialize the dense operand and re-dispatch)."""
+    return np.unpackbits(np.asarray(packed, np.uint8), count=n)
+
+
+def count_put(actual: int, baseline: int) -> None:
+    """Account one host→device operand upload: ``actual`` bytes on the
+    wire under the diet vs the ``baseline`` blanket int32/f32 format —
+    the run-over-run evidence that the diet holds (bench.py surfaces
+    the pair; the CI transfer-guard budgets it)."""
+    obs.count("transfer.packed_bytes", int(actual))
+    obs.count("transfer.unpacked_bytes", int(baseline))
+
+
+# -- device-resident operand cache ---------------------------------------
+#
+# The batched schedulers upload the SAME union transition tensor P once
+# per dispatch group (and bench re-uploads per probe iteration). Read-
+# only operands are cached device-resident keyed by (host array
+# identity, cast tag, device) so group g+1 reuses group g's HBM buffer.
+# The host array object is held in the entry both to keep id() valid
+# and to verify identity on hit; bounded FIFO so a long soak cannot pin
+# unbounded HBM.
+
+_CACHE_LOCK = threading.Lock()
+_DEV_CACHE: "Dict[Tuple, Tuple[np.ndarray, Any]]" = {}
+_DEV_CACHE_MAX = 16
+# byte bound on the PINNED HOST COPIES (the device copies are about
+# the same size in HBM): a soak across many distinct models must not
+# accumulate tens-of-MB transition tensors indefinitely
+_DEV_CACHE_MAX_BYTES = 64 << 20
+
+
+def cached_put(host: np.ndarray, tag: Any,
+               build: Callable[[], Any]) -> Tuple[Any, bool]:
+    """Device-resident copy of the read-only operand ``host`` under the
+    cast/device ``tag``; ``build()`` creates it on a miss. Returns
+    ``(device_array, hit)`` and bumps ``donate.reuse`` on a hit. With
+    reuse opted out every call is a miss and nothing is cached."""
+    if not reuse_enabled():
+        return build(), False
+    key = (id(host), host.shape, str(host.dtype), tag)
+    with _CACHE_LOCK:
+        ent = _DEV_CACHE.get(key)
+        if ent is not None and ent[0] is host:
+            obs.count("donate.reuse")
+            return ent[1], True
+    dev = build()
+    if host.nbytes > _DEV_CACHE_MAX_BYTES:
+        return dev, False            # never cacheable; don't churn
+    with _CACHE_LOCK:
+        while _DEV_CACHE and (
+                len(_DEV_CACHE) >= _DEV_CACHE_MAX
+                or sum(e[0].nbytes for e in _DEV_CACHE.values())
+                + host.nbytes > _DEV_CACHE_MAX_BYTES):
+            _DEV_CACHE.pop(next(iter(_DEV_CACHE)), None)
+        _DEV_CACHE[key] = (host, dev)
+    return dev, False
+
+
+def clear_device_cache() -> None:
+    """Drop every cached device operand (tests, and tools that churn
+    many alphabets)."""
+    with _CACHE_LOCK:
+        _DEV_CACHE.clear()

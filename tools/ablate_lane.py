@@ -394,6 +394,13 @@ def main():
     # pend is derived on device. Insert the projection table the
     # matmul ablation variants expect between slot_ops and P.
     ret_slot_h, slot_ops_h, P_h, R0_h = host_args
+    if R0_h.dtype == np.uint8:
+        # round-6 diet: pack_operands bit-packs the seed by default;
+        # the ablation kernels predate the in-jit unpack, so
+        # re-materialize the dense f32 seed they expect
+        from jepsen_tpu.checkers import transfer
+        R0_h = transfer.unpack_bool_host(R0_h, M * S) \
+            .reshape(M, S).astype(np.float32)
     host_args = (ret_slot_h, slot_ops_h, P_h,
                  _proj_table_np(W, M), R0_h)
     dargs = jax.device_put(host_args)

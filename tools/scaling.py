@@ -117,8 +117,16 @@ def _worker(n_dev: int, keys: int, key_ops: int, chunk_ops: int,
     reach._use_pallas = lambda: True
     reach._PALLAS_MIN_RETURNS = 0
     reach_batch._INTERPRET_DEFAULT = True
-    for k in ("JEPSEN_TPU_NO_MESH_LOCKSTEP", "JEPSEN_TPU_NO_STREAM_PREP"):
-        os.environ.pop(k, None)         # the rung measures the mesh lane
+    for k in ("JEPSEN_TPU_NO_MESH_LOCKSTEP", "JEPSEN_TPU_NO_STREAM_PREP",
+              "JEPSEN_TPU_NO_PACKED_XFER", "JEPSEN_TPU_NO_LAZY_FETCH",
+              "JEPSEN_TPU_NO_DONATE"):
+        os.environ.pop(k, None)   # the rung measures the mesh lane on
+    #                               the full transfer diet (ISSUE 5)
+    from jepsen_tpu.checkers import transfer
+    # covers all three gates, and catches an env-var rename drifting
+    # from the pop list above (which would silently re-close a gate)
+    assert (transfer.packed_enabled() and transfer.lazy_fetch_enabled()
+            and transfer.donate_enabled()), "diet gates must be open"
     packs_l = []
     for s in range(lockstep_keys):
         h = fixtures.gen_history("cas", n_ops=lockstep_ops, processes=3,
@@ -135,6 +143,16 @@ def _worker(n_dev: int, keys: int, key_ops: int, chunk_ops: int,
         assert res[1]["valid"] is False and all(
             r["valid"] is True for i, r in enumerate(res) if i != 1), \
             "lockstep verdicts drifted under sharding"
+        # the lazy-fetch rescue (ISSUE 5): with verdicts fetched as
+        # per-lane summaries, the full arrays cross the wire only when
+        # a lane dies and witness reconstruction needs them — assert
+        # per rung that the injected violation still surfaces its
+        # knossos-style witness, so the rescue path is covered at
+        # every mesh width
+        assert res[1].get("final-configs"), \
+            "lazy-fetch rescue lost the violation witness"
+        assert res[1].get("op") is not None, \
+            "lazy-fetch rescue lost the failing op"
         return res
 
     dt = best_of(_lockstep)

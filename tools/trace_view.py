@@ -69,12 +69,24 @@ def device_table(spans: List[Dict[str, Any]]
         if d is None:
             continue
         a = per.setdefault(f"dev{d}", {"count": 0, "total_us": 0.0,
+                                       "put_us": 0.0, "fetch_us": 0.0,
                                        "names": defaultdict(int)})
         a["count"] += 1
-        a["total_us"] += float(s.get("dur", 0.0))
-        a["names"][s.get("name", "?")] += 1
+        dur = float(s.get("dur", 0.0))
+        a["total_us"] += dur
+        name = s.get("name", "?")
+        # put/fetch wall per device (the transfer-diet evidence): the
+        # dispatch spans cover operand marshalling + program queueing,
+        # the collect spans the verdict round-trip
+        if "dispatch" in name:
+            a["put_us"] += dur
+        elif "collect" in name:
+            a["fetch_us"] += dur
+        a["names"][name] += 1
     return {k: {"count": int(v["count"]),
                 "total_ms": round(v["total_us"] / 1e3, 3),
+                "put_ms": round(v["put_us"] / 1e3, 3),
+                "fetch_ms": round(v["fetch_us"] / 1e3, 3),
                 "names": dict(v["names"])}
             for k, v in sorted(per.items())}
 
@@ -113,6 +125,20 @@ def summarize(path: str, top: int = 15) -> Dict[str, Any]:
     by_dev = device_table(data["spans"])
     if by_dev:
         out["spans_by_device"] = by_dev
+    # transfer diet (ISSUE 5): wire bytes actually moved vs the
+    # blanket int32/f32 format, and which fetch protocol answered
+    counters = out["counters"]
+    packed = counters.get("transfer.packed_bytes")
+    if packed:
+        unpacked = counters.get("transfer.unpacked_bytes", 0)
+        out["transfer_diet"] = {
+            "packed_bytes": int(packed),
+            "unpacked_bytes": int(unpacked),
+            "ratio": round(unpacked / max(packed, 1), 2),
+            "fetch_lazy": int(counters.get("fetch.lazy", 0)),
+            "fetch_eager": int(counters.get("fetch.eager", 0)),
+            "donate_reuse": int(counters.get("donate.reuse", 0)),
+        }
     # host/device overlap of the streaming prep pipeline (ISSUE 3):
     # hidden/wall is the fraction of host prep that cost no wall-clock
     wall = gauges.get("prep.wall_s")
@@ -137,11 +163,21 @@ def _print_human(s: Dict[str, Any]) -> None:
                   f"{row['self_ms']:>10.3f} {row['total_ms']:>10.3f}")
     if s.get("spans_by_device"):
         print("\nspans by device (mesh-lockstep dispatch/collect):")
+        print(f"  {'device':8} {'spans':>5} {'total ms':>10} "
+              f"{'put ms':>10} {'fetch ms':>10}")
         for dev, a in s["spans_by_device"].items():
             names = " ".join(f"{n}x{c}"
                              for n, c in sorted(a["names"].items()))
-            print(f"  {dev:8} {a['count']:>4} spans "
-                  f"{a['total_ms']:>10.3f} ms  {names}")
+            print(f"  {dev:8} {a['count']:>5} {a['total_ms']:>10.3f} "
+                  f"{a['put_ms']:>10.3f} {a['fetch_ms']:>10.3f}  "
+                  f"{names}")
+    if s.get("transfer_diet"):
+        td = s["transfer_diet"]
+        print(f"\ntransfer diet: {td['packed_bytes']} wire bytes "
+              f"({td['ratio']}x under the blanket "
+              f"{td['unpacked_bytes']}), fetches "
+              f"lazy x{td['fetch_lazy']} / eager x{td['fetch_eager']}, "
+              f"donated dispatches x{td['donate_reuse']}")
     if s.get("prep_overlap"):
         po = s["prep_overlap"]
         print(f"\nprep overlap ({po.get('mode')}): "

@@ -1,0 +1,122 @@
+"""CI transfer-guard: fail the build when the wire format regresses.
+
+The round-6 transfer diet (narrow dtypes + bit-packed bools, see
+``jepsen_tpu/checkers/transfer.py`` and ENGINE.md §"The transfer
+diet") is easy to lose silently — one re-widened ``.astype(np.int32)``
+or an unpacked bool tensor restores the blanket format and nothing
+crashes, the link just carries 4-8x the bytes again. This guard pins
+the diet with a checked-in budget (``data/transfer_budget.json``):
+
+- runs ``bench.py --quick`` (deterministic seeded history; the
+  ``transfer`` sub-object is the HOST-ONLY marshalling breakdown of
+  the production operand packing, so the guard works on CPU-only CI
+  without a device dispatch), or reads a pre-captured bench JSON via
+  ``--bench-json``;
+- fails (exit 1) when ``packed_bytes`` exceeds ``max_packed_bytes``
+  or the unpacked/packed ``ratio`` drops below ``min_ratio``;
+- exits 3 when the probe itself is missing/broken — a guard that
+  cannot measure must not pass.
+
+Usage:
+    python tools/transfer_guard.py [--budget data/transfer_budget.json]
+                                   [--bench-json PATH] [--ops 20000]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+
+def run_quick_bench(ops: int) -> Dict[str, Any]:
+    """Run ``bench.py --quick`` in a subprocess (its own backend init)
+    and parse the final JSON line — bench prints progress lines first,
+    the result object last."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, os.path.join(_REPO, "bench.py"),
+           "--quick", "--ops", str(ops), "--trace", ""]
+    p = subprocess.run(cmd, cwd=_REPO, env=env, text=True,
+                       stdout=subprocess.PIPE)
+    if p.returncode != 0:
+        raise RuntimeError(f"bench.py --quick exited {p.returncode}")
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    raise RuntimeError("no JSON object in bench.py output")
+
+
+def check(bench: Dict[str, Any], budget: Dict[str, Any]) -> Dict[str, Any]:
+    """Compare the bench ``transfer`` sub-object against the budget.
+    Returns a verdict dict with ``ok`` plus per-check detail."""
+    xfer = bench.get("transfer")
+    if (not isinstance(xfer, dict) or "error" in xfer
+            or "packed_bytes" not in xfer or "ratio" not in xfer):
+        return {"ok": False, "probe_missing": True,
+                "detail": xfer if xfer else "no 'transfer' sub-object"}
+    packed = int(xfer["packed_bytes"])
+    ratio = float(xfer["ratio"])
+    max_packed = int(budget["max_packed_bytes"])
+    min_ratio = float(budget["min_ratio"])
+    checks = {
+        "packed_bytes": {"measured": packed, "max": max_packed,
+                         "ok": packed <= max_packed},
+        "ratio": {"measured": ratio, "min": min_ratio,
+                  "ok": ratio >= min_ratio},
+    }
+    # gates must be at their shipping defaults when the budget is
+    # enforced — a CI env var that opts the diet out would let a real
+    # regression hide behind an artificially-exempt measurement
+    gates = xfer.get("gates", {})
+    checks["gates_default"] = {"measured": gates,
+                              "ok": all(gates.values()) if gates
+                              else False}
+    return {"ok": all(c["ok"] for c in checks.values()),
+            "checks": checks,
+            "fetch_mode": xfer.get("fetch_mode"),
+            "bytes_per_return": xfer.get("bytes_per_return")}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget",
+                    default=os.path.join(_REPO, "data",
+                                         "transfer_budget.json"))
+    ap.add_argument("--bench-json", default=None,
+                    help="pre-captured bench output (skips running "
+                         "bench.py --quick)")
+    ap.add_argument("--ops", type=int, default=20_000,
+                    help="history size for the quick bench run")
+    args = ap.parse_args()
+
+    with open(args.budget) as f:
+        budget = json.load(f)
+    try:
+        if args.bench_json:
+            with open(args.bench_json) as f:
+                bench = json.load(f)
+        else:
+            bench = run_quick_bench(args.ops)
+    except (OSError, RuntimeError, json.JSONDecodeError) as e:
+        print(json.dumps({"ok": False, "probe_missing": True,
+                          "detail": f"{type(e).__name__}: {e}"}))
+        return 3
+
+    verdict = check(bench, budget)
+    print(json.dumps(verdict, indent=2))
+    if verdict.get("probe_missing"):
+        return 3
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
