@@ -47,9 +47,16 @@ efficiency), and the span count — and writes a Chrome/Perfetto
 ``trace.json`` (``--trace PATH``, empty string disables) that
 ``tools/trace_view.py`` summarizes.
 
+``--serve`` appends a ``"serve"`` sub-object: an in-process
+checker-as-a-service daemon (ISSUE 6) driven by the open-loop load
+generator (``tools/loadgen.py``) — sustained req/s, p50/p99 verdict
+latency across two measurement windows (the second runs entirely on
+warm caches), backpressure/timeout counts, and the daemon's final
+``serve.*`` counter snapshot.
+
 Usage: python bench.py [--ops N] [--repeat K]
        [--engine reach|chunked|batch|wgl-cpu|wgl-native]
-       [--trace trace.json]
+       [--trace trace.json] [--serve]
 """
 from __future__ import annotations
 
@@ -358,6 +365,37 @@ def batch_probe(model, n_ops: int, seed: int, processes: int) -> dict:
             "per_bucket": best_diag.get("groups", [])}
 
 
+def serve_probe(quick: bool = True) -> dict:
+    """The serving-layer rung: self-host a daemon on an ephemeral
+    port, replay a mixed-geometry multi-tenant workload at a target
+    arrival rate through ``tools/loadgen.py``, and report sustained
+    req/s + p50/p99 verdict latency (two windows: the second is the
+    steady state a long-lived daemon lives in)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "loadgen.py")
+    spec = importlib.util.spec_from_file_location("bench_loadgen",
+                                                  path)
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+    report = loadgen.run_loadgen({"quick": quick})
+    # the full per-request record set is loadgen's business; keep the
+    # bench artifact to the headline numbers + the daemon's counters
+    keep = ("warmup", "target_rate", "duration_s", "submitted",
+            "completed", "rejected_429", "timeouts",
+            "verdict_mismatches", "sustained_req_s", "p50_s",
+            "p99_s", "windows", "fallbacks", "drained", "error")
+    out = {k: report[k] for k in keep if k in report}
+    stats = report.get("stats", {})
+    out["counters"] = {k: v
+                       for k, v in stats.get("counters", {}).items()
+                       if k.startswith("serve.")}
+    out["dispatch"] = stats.get("dispatch", {})
+    return out
+
+
 def _ragged_lengths(total: int, keys: int = 12,
                     ratio: float = 1.45) -> list:
     """Deterministic mixed-length key split (BASELINE config #4 shape):
@@ -460,6 +498,11 @@ def main() -> int:
                     help="small/CI run: caps --ops at 20k, one repeat, "
                          "skips the batch probe — the transfer-guard "
                          "CI step's configuration")
+    ap.add_argument("--serve", action="store_true",
+                    help="append the 'serve' sub-object: an "
+                         "in-process check daemon driven by the "
+                         "open-loop load generator (req/s, p50/p99 "
+                         "verdict latency)")
     args = ap.parse_args()
     if args.quick:
         args.ops = min(args.ops, 20_000)
@@ -618,6 +661,12 @@ def main() -> int:
                                            args.processes)
             except Exception as e:                      # noqa: BLE001
                 out["batch"] = {"error": f"{type(e).__name__}: {e}"}
+    if args.serve:
+        try:
+            with obs.span("bench.serve_probe"):
+                out["serve"] = serve_probe(quick=args.quick)
+        except Exception as e:                          # noqa: BLE001
+            out["serve"] = {"error": f"{type(e).__name__}: {e}"}
     _finish(out, res.get("engine"))
     print(json.dumps(out))
     return 0

@@ -422,8 +422,11 @@ def auto_check_many_packed(model: Model, packed_list,
 # keyword subsets understood by each engine; user opts are filtered so one
 # checker config can carry opts for every algorithm it may route to.
 _REACH_KW = ("max_states", "max_slots", "max_dense", "should_abort")
-# check_many additionally shards the key axis over a mesh
-_REACH_MANY_KW = _REACH_KW + ("devices",)
+# check_many additionally shards the key axis over a mesh and admits
+# a dispatch-group width override (the serving layer's admission
+# coalescer planned the batch at its own --group width; the engine-side
+# re-plan must agree with it)
+_REACH_MANY_KW = _REACH_KW + ("devices", "group")
 _CHUNKED_KW = _REACH_KW + ("n_chunks", "max_matrix", "devices")
 _CHUNKLOCK_KW = ("max_states", "max_slots", "max_dense", "n_chunks",
                  "e_pad", "suffix", "interpret")

@@ -120,8 +120,54 @@ def _cmd_run(args) -> int:
 
 
 def _cmd_serve(args) -> int:
+    """``serve`` — the RESULTS BROWSER: a read-only HTTP view over the
+    store directory (runs, artifacts, verdict badges, the ``/engine``
+    live-daemon stats page). It never checks anything. The checking
+    daemon — device-resident engines serving ``POST /check`` traffic —
+    is the separate ``check-serve`` subcommand."""
     from jepsen_tpu import web
     web.serve(root=args.store_root, port=args.port)
+    return 0
+
+
+def _cmd_check_serve(args) -> int:
+    """``check-serve`` — the CHECKER-AS-A-SERVICE daemon (ISSUE 6):
+    long-lived process holding compiled kernel geometries, union
+    transition tensors, and the memo/compile caches hot, serving
+    concurrent linearizability checks over HTTP with continuous
+    multi-tenant batching. See docs/SERVING.md for the protocol."""
+    import signal
+
+    from jepsen_tpu import serve
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    engine_kw = {}
+    if args.max_states:
+        engine_kw["max_states"] = args.max_states
+    daemon = serve.Daemon(
+        port=args.port,
+        host=args.host,
+        queue_depth=args.queue_depth,
+        max_inflight_per_tenant=args.tenant_inflight,
+        group=args.group,
+        engine_kw=engine_kw,
+        store_root=args.store_root,
+        persist=not args.no_persist_runs)
+
+    def _term(signum, frame):
+        # SIGTERM == the orchestrator's polite stop: drain, then exit
+        # cleanly (the CI serve-smoke job asserts this path)
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _term)
+    print(f"jepsen-tpu check daemon: http://localhost:{daemon.port}/ "
+          f"(POST /check, GET /check/<id>, GET /stats; "
+          f"store root {args.store_root})")
+    daemon.serve_forever()
+    print(json.dumps({"shutdown": "clean", **daemon.stats()},
+                     default=str))
     return 0
 
 
@@ -217,10 +263,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                            "violation")
     runp.set_defaults(fn=_cmd_run)
 
-    servep = sub.add_parser("serve", help="browse results over HTTP")
+    servep = sub.add_parser(
+        "serve", help="browse stored results over HTTP (read-only; "
+                      "the checking daemon is 'check-serve')")
     servep.add_argument("--store-root", default="store")
     servep.add_argument("--port", type=int, default=8080)
     servep.set_defaults(fn=_cmd_serve)
+
+    csp = sub.add_parser(
+        "check-serve",
+        help="run the checker-as-a-service daemon: device-resident "
+             "engines serving POST /check with continuous "
+             "multi-tenant batching")
+    csp.add_argument("--port", type=int, default=8642)
+    csp.add_argument("--host", default="127.0.0.1",
+                     help="bind address — loopback by default: this "
+                          "endpoint ACCEPTS WORK (unauthenticated "
+                          "compute + store writes), unlike the "
+                          "read-only results browser; set 0.0.0.0 "
+                          "deliberately to expose it")
+    csp.add_argument("--store-root", default="store",
+                     help="persistence root: completed checks land as "
+                          "browsable runs, daemon stats under "
+                          "<root>/serve/stats.json")
+    csp.add_argument("--queue-depth", type=int, default=256,
+                     help="admission bound; past it POST /check "
+                          "returns 429")
+    csp.add_argument("--tenant-inflight", type=int, default=8,
+                     help="max in-flight requests per tenant "
+                          "(fairness cap)")
+    csp.add_argument("--group", type=int, default=32,
+                     help="max lanes per coalesced dispatch group")
+    csp.add_argument("--max-states", type=int, default=0,
+                     help="engine max_states override (0 = default)")
+    csp.add_argument("--no-persist-runs", action="store_true",
+                     help="do not write completed checks into the "
+                          "store")
+    csp.set_defaults(fn=_cmd_check_serve)
 
     rp = sub.add_parser("recheck",
                         help="re-analyze stored histories offline "

@@ -1,0 +1,50 @@
+"""``jepsen_tpu.serve`` — the checker as a service (ISSUE 6 tentpole).
+
+Every CLI check pays cold-process cost: kernel compiles, memo BFS,
+operand uploads — then tears it all down. This package keeps the
+engine hot: a long-lived daemon holds the compiled kernel geometries,
+union transition tensors, and the persistent memo/compile caches
+device-resident and serves concurrent linearizability checks over
+HTTP with inference-server-style continuous batching — a request
+never waits for a "full" batch, it rides the next lockstep dispatch
+group whose geometry it fits.
+
+Layers (one module each):
+
+- :mod:`request` — request state machine + registry + per-tenant
+  serve ledgers.
+- :mod:`coalesce` — bounded admission queue, ``plan_buckets``-based
+  geometry coalescing, oldest-tenant-first fairness, per-tenant
+  in-flight caps, queue-side deadline expiry. Pure host-side.
+- :mod:`engine` — the dispatcher thread feeding
+  ``facade.auto_check_packed`` / ``auto_check_many_packed`` (whose
+  batch route is the streaming lockstep scheduler), deadline/cancel
+  abort hooks, optional store persistence, stats.
+- :mod:`http` — the stdlib HTTP protocol (``POST /check``,
+  ``GET /check/<id>``, ``GET /stats``) and the :class:`Daemon`
+  composition root.
+
+Quick start::
+
+    from jepsen_tpu import serve
+    d = serve.Daemon(port=8642, store_root="store").start()
+    # ... POST /check ...
+    d.shutdown()
+
+or ``python -m jepsen_tpu check-serve --port 8642``. Load/latency
+measurement: ``python tools/loadgen.py --url http://localhost:8642``.
+See ``docs/SERVING.md``.
+"""
+from jepsen_tpu.serve.coalesce import (AdmissionQueue, Backpressure,
+                                       plan_admission)
+from jepsen_tpu.serve.engine import Dispatcher
+from jepsen_tpu.serve.http import Daemon, parse_check_body, resolve_model
+from jepsen_tpu.serve.request import (CANCELLED, DISPATCHED, DONE,
+                                      QUEUED, TIMEOUT, CheckRequest,
+                                      Registry)
+
+__all__ = [
+    "AdmissionQueue", "Backpressure", "plan_admission", "Dispatcher",
+    "Daemon", "parse_check_body", "resolve_model", "CheckRequest",
+    "Registry", "QUEUED", "DISPATCHED", "DONE", "TIMEOUT", "CANCELLED",
+]

@@ -1,0 +1,245 @@
+"""Admission queue + coalescer: continuous multi-tenant batching.
+
+The serving layer's scheduling problem is the inference-server one
+(Orca/vLLM continuous batching): requests arrive one at a time, the
+device engine wants lockstep groups of compatible lanes, and nobody
+may wait for a "full" batch — a request rides the NEXT dispatch group
+whose geometry it fits. The pieces:
+
+- **Admission** (:meth:`AdmissionQueue.submit`): bounded queue.
+  Admission past the bound raises :class:`Backpressure` — the HTTP
+  layer turns that into a 429 instead of letting the host queue (and
+  every packed history on it) grow without bound.
+- **Coalescing** (:meth:`AdmissionQueue.next_batch`): the dispatcher
+  thread asks for one dispatch group at a time. Queued requests are
+  grouped by model signature (only same-model histories share a
+  union transition tensor), the oldest signature goes first, and the
+  selected requests are bucketed by history length with
+  :func:`jepsen_tpu.checkers.reach_batch.plan_buckets` — the SAME
+  packer the lockstep batch engine uses — so a 10k-op history never
+  drags 50-op co-tenants through its padded walk. One plan group is
+  returned per call; the rest stay queued and coalesce with whatever
+  arrives while the device walks (that is the continuous part).
+- **Fairness**: within a dispatch group tenants are served
+  oldest-first (by each tenant's oldest queued request), and a
+  configurable per-tenant in-flight cap keeps one chatty tenant from
+  occupying every lane of every group while others starve.
+- **Deadlines**: requests whose deadline passes while queued are
+  completed as ``timeout`` right here (fallback stage
+  ``serve-timeout`` in the obs ledger) — they never waste a lane.
+
+Everything in this module is pure host-side bookkeeping — no jax, no
+device — so the scheduling policy is unit-testable in microseconds
+(``tests/test_serve.py``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from jepsen_tpu import obs
+from jepsen_tpu.serve import request as rq
+
+# nominal slot width used for plan_buckets' padding floor: the packer
+# only consults W for its SMEM-budget floor bucket, and 5 concurrent
+# processes is the repo-wide default workload shape. A wrong hint
+# costs pack efficiency, never correctness.
+_W_HINT = 5
+
+
+class Backpressure(RuntimeError):
+    """The admission queue is at its bound; the client should retry
+    later (HTTP 429)."""
+
+
+def plan_admission(requests: Sequence["rq.CheckRequest"], *,
+                   group: int = 32,
+                   w_hint: int = _W_HINT) -> List[List[int]]:
+    """Partition compatible requests into dispatch groups: length
+    buckets via :func:`reach_batch.plan_buckets` (longest bucket
+    first), then oldest-tenant-first WITHIN each group.
+
+    Returns index lists into ``requests``. Fairness ordering: tenants
+    are ranked by their oldest member request's submit time, requests
+    within a tenant by their own submit time — so the tenant who has
+    waited longest heads every group it appears in."""
+    from jepsen_tpu.checkers import reach_batch
+
+    if not requests:
+        return []
+    lens = [max(1, int(r.packed.n)) for r in requests]
+    groups = reach_batch.plan_buckets(lens, w_hint, group=group)
+    oldest_of: Dict[str, float] = {}
+    for r in requests:
+        t = oldest_of.get(r.tenant)
+        if t is None or r.t_submit < t:
+            oldest_of[r.tenant] = r.t_submit
+    out: List[List[int]] = []
+    for g in groups:
+        out.append(sorted(
+            g, key=lambda i: (oldest_of[requests[i].tenant],
+                              requests[i].tenant,
+                              requests[i].t_submit, i)))
+    return out
+
+
+class AdmissionQueue:
+    """Bounded multi-tenant admission queue feeding one dispatcher.
+
+    ``max_depth`` bounds QUEUED requests (dispatched ones no longer
+    count — they are bounded by ``group`` times the dispatch
+    pipelining, not by this queue). ``max_inflight_per_tenant`` caps
+    how many of one tenant's requests may be walking on the device at
+    once; requests over the cap simply stay queued for a later group.
+    """
+
+    def __init__(self, max_depth: int = 256,
+                 max_inflight_per_tenant: int = 8,
+                 group: int = 32) -> None:
+        self.max_depth = int(max_depth)
+        self.max_inflight_per_tenant = int(max_inflight_per_tenant)
+        self.group = int(group)
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._queued: List[rq.CheckRequest] = []
+        self._inflight: Dict[str, int] = {}     # tenant -> walking now
+        self.on_timeout: Optional[Callable[[rq.CheckRequest], None]] = None
+
+    # -- admission -------------------------------------------------------
+    def submit(self, req: "rq.CheckRequest") -> None:
+        with self._nonempty:
+            if len(self._queued) >= self.max_depth:
+                obs.count("serve.rejected.backpressure")
+                obs.engine_fallback("serve-admit", "Backpressure",
+                                    tenant=req.tenant, ops=req.packed.n,
+                                    depth=len(self._queued))
+                raise Backpressure(
+                    f"admission queue at bound ({self.max_depth})")
+            self._queued.append(req)
+            obs.count("serve.admitted")
+            obs.gauge("serve.queue_depth", len(self._queued))
+            self._nonempty.notify()
+
+    def cancel(self, req_id: str) -> Optional["rq.CheckRequest"]:
+        """Remove a still-queued request (client cancellation).
+        Returns it, or None when it is not queued (already dispatched
+        or unknown — dispatched requests cancel via their
+        ``cancel_requested`` flag, observed by the group's abort
+        hook)."""
+        with self._lock:
+            for i, r in enumerate(self._queued):
+                if r.id == req_id:
+                    del self._queued[i]
+                    obs.gauge("serve.queue_depth", len(self._queued))
+                    return r
+        return None
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queued)
+
+    def inflight(self) -> Dict[str, int]:
+        with self._lock:
+            return {t: n for t, n in self._inflight.items() if n > 0}
+
+    # -- dispatch side ---------------------------------------------------
+    def _expire_queued_locked(self, now: float
+                              ) -> List["rq.CheckRequest"]:
+        expired = [r for r in self._queued if r.expired(now)]
+        if expired:
+            self._queued = [r for r in self._queued
+                            if not r.expired(now)]
+        return expired
+
+    def next_batch(self, timeout: Optional[float] = None
+                   ) -> List["rq.CheckRequest"]:
+        """Block until work is available (or ``timeout`` elapses: empty
+        list) and return ONE dispatch group, marked in-flight.
+
+        Selection: expire dead requests, pick the model signature with
+        the oldest queued request, take its requests up to each
+        tenant's remaining in-flight allowance, and return the first
+        :func:`plan_admission` group (longest length bucket first —
+        matching the lockstep scheduler's big-walk-first pipelining).
+        Callers MUST pair every returned batch with
+        :meth:`mark_done`."""
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        with self._nonempty:
+            while True:
+                now = time.monotonic()
+                for r in self._expire_queued_locked(now):
+                    self._timeout_queued(r)
+                batch = self._select_locked()
+                if batch:
+                    for r in batch:
+                        self._inflight[r.tenant] = \
+                            self._inflight.get(r.tenant, 0) + 1
+                        r.t_dispatch = now
+                        r.status = rq.DISPATCHED
+                    obs.gauge("serve.queue_depth", len(self._queued))
+                    if len(batch) > 1:
+                        obs.count("serve.coalesced", len(batch))
+                    return batch
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return []
+                self._nonempty.wait(remaining)
+
+    def _select_locked(self) -> List["rq.CheckRequest"]:
+        if not self._queued:
+            return []
+        # eligibility: per-tenant in-flight allowance, oldest first
+        allowance: Dict[str, int] = {}
+        eligible: List[rq.CheckRequest] = []
+        for r in sorted(self._queued, key=lambda r: r.t_submit):
+            a = allowance.get(r.tenant)
+            if a is None:
+                a = max(0, self.max_inflight_per_tenant
+                        - self._inflight.get(r.tenant, 0))
+            if a <= 0:
+                allowance[r.tenant] = 0
+                continue
+            allowance[r.tenant] = a - 1
+            eligible.append(r)
+        if not eligible:
+            return []
+        # one model signature per dispatch group: the one whose oldest
+        # eligible request has waited longest
+        sig = eligible[0].model_sig
+        same = [r for r in eligible if r.model_sig == sig]
+        groups = plan_admission(same, group=self.group)
+        # anti-starvation: dispatch the group holding the OLDEST
+        # request (same[0]), not unconditionally the longest bucket —
+        # a stream of fresh long histories must not preempt a short
+        # one forever
+        pick = next(g for g in groups if 0 in g)
+        batch = [same[i] for i in pick]
+        chosen = {id(r) for r in batch}
+        self._queued = [r for r in self._queued
+                        if id(r) not in chosen]
+        return batch
+
+    def mark_done(self, batch: Sequence["rq.CheckRequest"]) -> None:
+        """Release the batch's tenants' in-flight slots and wake the
+        dispatcher's next selection."""
+        with self._nonempty:
+            for r in batch:
+                n = self._inflight.get(r.tenant, 0) - 1
+                if n > 0:
+                    self._inflight[r.tenant] = n
+                else:
+                    self._inflight.pop(r.tenant, None)
+            self._nonempty.notify()
+
+    def _timeout_queued(self, req: "rq.CheckRequest") -> None:
+        obs.count("serve.timeout")
+        obs.engine_fallback("serve-timeout", "DeadlineExpired",
+                            tenant=req.tenant, ops=req.packed.n,
+                            queued_s=round(
+                                time.monotonic() - req.t_submit, 6))
+        cb = self.on_timeout
+        if cb is not None:
+            cb(req)

@@ -1,0 +1,297 @@
+"""The dispatcher: one long-lived thread between the admission queue
+and the device engines.
+
+Each iteration takes ONE coalesced dispatch group from the queue and
+runs it through the same checker chains the CLI uses — so daemon
+verdicts are the standalone verdicts:
+
+- a group of one goes through :func:`facade.auto_check_packed` (the
+  single-history auto chain, abortable through the segmented walk);
+- a group of many goes through :func:`facade.auto_check_many_packed`,
+  whose first route is the streaming lockstep batch scheduler
+  (``reach._dispatch_lockstep_stream``) — the admission coalescer
+  sized the group with the same ``plan_buckets`` packer, so the
+  engine-side re-plan reproduces the group geometry.
+
+Because the thread — and the process — lives across requests, the
+engine-side caches stay hot: compiled kernel geometries (jax in-proc
++ persistent compilation cache), the memo/disk-memo tiers, and the
+device-resident operand cache (``transfer.cached_put``). That is the
+entire point of the daemon: request N+1 pays marshalling, not
+compilation.
+
+Deadlines and cancellation compose into the chain's ``should_abort``
+hook: the group aborts (cleanly, at a segment boundary) once EVERY
+live member is expired or cancelled; an individual member whose
+deadline passes mid-walk keeps the group running for its co-tenants
+but reports ``timeout`` itself. A dispatch exception never kills the
+daemon — every member gets a contained ``"unknown"`` verdict and the
+crash lands in the obs ledger (``serve-dispatch`` fallback).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import obs
+from jepsen_tpu.serve import request as rq
+from jepsen_tpu.serve.coalesce import AdmissionQueue
+
+log = logging.getLogger("jepsen.serve")
+
+
+class Dispatcher:
+    """Owns the dispatch thread. ``start()``/``stop()`` bracket the
+    daemon's life; ``drain()`` waits for the queue to empty (tests,
+    graceful shutdown)."""
+
+    def __init__(self, queue: AdmissionQueue, registry: "rq.Registry",
+                 *, engine_kw: Optional[Dict[str, Any]] = None,
+                 store_root: Optional[str] = None,
+                 persist: bool = False) -> None:
+        self.queue = queue
+        self.registry = registry
+        self.engine_kw = dict(engine_kw or {})
+        self.store_root = store_root
+        self.persist = persist and store_root is not None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.dispatch_counts: Dict[str, int] = {}
+        self._counts_lock = threading.Lock()
+        queue.on_timeout = self._finish_timeout_queued
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Dispatcher":
+        # warm the persistent caches once, before the first request
+        from jepsen_tpu.checkers import reach
+        reach._ensure_persistent_caches()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Wait until no request is queued or walking. Judged from
+        the QUEUE's state alone: a batch moves queued → in-flight
+        atomically under the queue lock inside ``next_batch`` and
+        leaves in-flight only in ``mark_done`` (after its results
+        published), so depth==0 ∧ inflight=={} has no window where a
+        batch is about to dispatch — a dispatcher-side idle flag
+        would."""
+        if self._thread is None:        # never started: nothing will
+            return self.queue.depth() == 0  # ever drain the queue
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            if self.queue.depth() == 0 and not self.queue.inflight():
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- the loop --------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.queue.next_batch(timeout=0.1)
+            if not batch:
+                continue
+            try:
+                self._dispatch(batch)
+            finally:
+                self.queue.mark_done(batch)
+                obs.gauge("serve.inflight", 0)
+                self._write_stats_file()
+
+    def _dispatch(self, batch: List["rq.CheckRequest"]) -> None:
+        req0 = batch[0]
+        model = req0.model
+        sig = f"{req0.model_name}/H{len(batch)}"
+        with self._counts_lock:
+            self.dispatch_counts[sig] = \
+                self.dispatch_counts.get(sig, 0) + 1
+        obs.count("serve.dispatched", len(batch))
+        obs.gauge("serve.inflight", len(batch))
+        for r in batch:
+            self.registry.ledger_record(
+                r.tenant, "dispatched", id=r.id, group=len(batch),
+                ops=int(r.packed.n))
+
+        def _aborted() -> bool:
+            # clean group cancellation: fires only when NO member
+            # still wants the verdict (composed into the segmented
+            # walk's abort polling by the facade chain)
+            if self._stop.is_set():
+                return True
+            now = time.monotonic()
+            return all(r.cancel_requested or r.expired(now)
+                       for r in batch)
+
+        # per-request engine options apply to the whole dispatch: the
+        # coalescer only groups requests whose options are IDENTICAL
+        # (they are part of the compatibility signature), so batch[0]
+        # speaks for every member
+        kw = dict(self.engine_kw)
+        kw.update(req0.opts)
+        kw["should_abort"] = _aborted
+        t0 = time.monotonic()
+        try:
+            from jepsen_tpu.checkers import facade
+            with obs.span("serve.dispatch", model=req0.model_name,
+                          lanes=len(batch)):
+                if len(batch) == 1:
+                    results = [facade.auto_check_packed(
+                        model, req0.packed, kw)]
+                else:
+                    # quantize the lane count to a power of two by
+                    # replicating the LONGEST member (its verdict is
+                    # recomputed and discarded; padding with the
+                    # longest keeps the group's padded step count
+                    # unchanged): a serving daemon sees every group
+                    # width 1..group over its life, and each distinct
+                    # H is a distinct compiled kernel geometry — the
+                    # pad bounds that churn to log2(group) geometries
+                    # a warmup can prime. JEPSEN_TPU_SERVE_NO_PAD=1
+                    # dispatches raw widths.
+                    packed_list = [r.packed for r in batch]
+                    n_real = len(packed_list)
+                    if not os.environ.get("JEPSEN_TPU_SERVE_NO_PAD"):
+                        Hq = 1 << (n_real - 1).bit_length()
+                        # never pad past the configured group width:
+                        # the engine-side re-plan splits oversized
+                        # groups, which would both defeat the pad and
+                        # break the admission/engine plan agreement
+                        cap = int(self.engine_kw.get("group") or 0) \
+                            or 32
+                        Hq = min(Hq, max(cap, n_real))
+                        longest = max(packed_list, key=lambda p: p.n)
+                        pad = Hq - n_real
+                        if pad > 0:
+                            packed_list = packed_list + [longest] * pad
+                            obs.count("serve.pad_lanes", pad)
+                    results = facade.auto_check_many_packed(
+                        model, packed_list, kw)[:n_real]
+        except Exception as e:                          # noqa: BLE001
+            log.warning("serve dispatch crashed: %r", e, exc_info=e)
+            obs.engine_fallback("serve-dispatch", type(e).__name__,
+                                lanes=len(batch))
+            err = {"valid": "unknown",
+                   "error": f"{type(e).__name__}: {e}"}
+            results = [dict(err) for _ in batch]
+        elapsed = time.monotonic() - t0
+        now = time.monotonic()
+        for req, res in zip(batch, results):
+            self._finish(req, res, elapsed, now)
+
+    # -- completion ------------------------------------------------------
+    def _finish(self, req: "rq.CheckRequest", res: Dict[str, Any],
+                elapsed: float, now: float) -> None:
+        if req.cancel_requested:
+            status = rq.CANCELLED
+            obs.count("serve.cancelled")
+        elif req.expired(now) and res.get("valid") not in (True, False):
+            # the walk was aborted (or still unknown) past the
+            # deadline: a timeout, not a verdict
+            status = rq.TIMEOUT
+            res = {"valid": "unknown", "cause": "deadline",
+                   **{k: v for k, v in res.items() if k != "valid"}}
+            obs.count("serve.timeout")
+            obs.engine_fallback("serve-timeout", "DeadlineExpired",
+                                tenant=req.tenant, ops=req.packed.n,
+                                dispatched=True)
+        else:
+            # a conclusive verdict that merely finished late is still
+            # the verdict — deadline enforcement is about not burning
+            # device time, not about discarding finished work
+            status = rq.DONE
+            obs.count("serve.completed")
+        if self.persist and status == rq.DONE:
+            try:
+                req.run_dir = self._persist(req, res)
+            except Exception as e:                      # noqa: BLE001
+                log.warning("serve persist failed for %s: %s",
+                            req.id, e)
+        self.registry.finish(req, status, res)
+        self.registry.ledger_record(
+            req.tenant, status, id=req.id,
+            valid=res.get("valid"), engine=res.get("engine"),
+            dispatch_s=round(elapsed, 6),
+            latency_s=round(now - req.t_submit, 6))
+        obs.count(
+            f"serve.tenant.{self.registry.bucket_tenant(req.tenant)}"
+            f".{status}")
+
+    def _finish_timeout_queued(self, req: "rq.CheckRequest") -> None:
+        """Queue-side deadline expiry (never dispatched)."""
+        self.registry.finish(req, rq.TIMEOUT,
+                             {"valid": "unknown", "cause": "deadline",
+                              "queued-only": True})
+        self.registry.ledger_record(req.tenant, rq.TIMEOUT, id=req.id,
+                                    queued_only=True)
+        obs.count(
+            f"serve.tenant.{self.registry.bucket_tenant(req.tenant)}"
+            f".timeout")
+
+    # -- persistence -----------------------------------------------------
+    def _persist(self, req: "rq.CheckRequest",
+                 res: Dict[str, Any]) -> str:
+        """Write the request as a browsable store run
+        (:func:`jepsen_tpu.store.save_check` —
+        ``<root>/serve-<model>/<ts>-<id>/``) so the existing
+        ``web.py`` results browser renders daemon traffic exactly
+        like CLI runs."""
+        from jepsen_tpu import store
+        assert self.store_root is not None
+        out = dict(res)
+        out["serve"] = {"id": req.id, "tenant": req.tenant,
+                        "latency-s": round(
+                            time.monotonic() - req.t_submit, 6)}
+        return store.save_check(self.store_root,
+                                f"serve-{req.model_name}", req.id,
+                                list(req.history), out)
+
+    # -- stats -----------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        counters = {k: v for k, v in obs.counters().items()
+                    if k.startswith(("serve.", "engine.", "lockstep.",
+                                     "compile_cache.", "memo_cache.",
+                                     "transfer."))}
+        with self._counts_lock:
+            dispatch = dict(self.dispatch_counts)
+        out = {
+            "queue": {"depth": self.queue.depth(),
+                      "max_depth": self.queue.max_depth,
+                      "inflight": self.queue.inflight(),
+                      "group": self.queue.group,
+                      "max_inflight_per_tenant":
+                          self.queue.max_inflight_per_tenant},
+            "dispatch": dispatch,
+            "counters": counters,
+        }
+        out.update(self.registry.stats())
+        return out
+
+    def _write_stats_file(self) -> None:
+        """Drop the latest stats snapshot under the store root
+        (best-effort) so the results browser's ``/engine`` page can
+        render a daemon it does not share a process with."""
+        if not self.store_root:
+            return
+        try:
+            d = os.path.join(self.store_root, "serve")
+            os.makedirs(d, exist_ok=True)
+            tmp = os.path.join(d, ".stats.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"ts": time.time(), **self.stats()}, f,
+                          default=str)
+            os.replace(tmp, os.path.join(d, "stats.json"))
+        except Exception:                               # noqa: BLE001
+            pass                # stats are advisory, never fatal

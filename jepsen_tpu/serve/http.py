@@ -1,0 +1,297 @@
+"""The wire protocol: stdlib ``ThreadingHTTPServer``, no dependencies
+(matching ``web.py``'s style). Three routes:
+
+- ``POST /check`` — submit a history. JSON body::
+
+      {"model": "cas-register",            # models.<name> constructor
+       "history": [{"process":0,"type":"invoke","f":"read"}, ...],
+       "tenant": "team-a",                 # or X-Tenant header
+       "timeout-s": 30.0,                  # optional deadline
+       "options": {"max_states": 100000}}  # engine kw (allow-listed)
+
+  ``Content-Type: application/edn`` parses the SAME shape from EDN
+  (an upstream Jepsen ``history.edn`` pasted as the ``:history``
+  value works). Replies ``202 {"id": ..., "status": "queued"}``,
+  ``400`` on malformed input, ``429`` + ``Retry-After`` under
+  backpressure.
+- ``GET /check/<id>`` — status/result. ``result`` carries the full
+  checker verdict (witness included) once ``status`` is terminal.
+  ``DELETE /check/<id>`` cancels a queued request.
+- ``GET /stats`` — queue depths, per-tenant ledger counts, cache
+  counters, per-geometry dispatch counts. ``GET /healthz`` — liveness.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from jepsen_tpu import edn
+from jepsen_tpu import history as h
+from jepsen_tpu.op import Op
+from jepsen_tpu.serve import request as rq
+from jepsen_tpu.serve.coalesce import AdmissionQueue, Backpressure
+from jepsen_tpu.serve.engine import Dispatcher
+
+# engine options a client may set per request — bounded to the knobs
+# that cannot destabilize co-tenants (no devices=, no interpret=)
+_CLIENT_OPTS = ("max_states", "max_slots", "max_dense", "time_limit")
+
+_MODEL_NAMES = ("register", "cas-register", "mutex", "multi-register",
+                "set-model", "fifo-queue", "unordered-queue",
+                "noop-model")
+
+
+def resolve_model(name: str):
+    """Model name -> fresh model instance (the CLI's vocabulary:
+    ``cas-register`` -> ``models.cas_register()``)."""
+    from jepsen_tpu import models
+    if name not in _MODEL_NAMES:
+        raise ValueError(f"unknown model {name!r}; "
+                         f"have {list(_MODEL_NAMES)}")
+    return getattr(models, name.replace("-", "_"))()
+
+
+def parse_check_body(body: bytes, content_type: str,
+                     default_tenant: str = "anonymous"
+                     ) -> Tuple[str, str, list, Dict[str, Any],
+                                Optional[float]]:
+    """Decode a POST /check body -> (tenant, model_name, ops,
+    options, timeout_s). Raises ValueError on malformed input."""
+    text = body.decode("utf-8")
+    if "edn" in (content_type or ""):
+        vals = edn.loads_all(text)
+        if len(vals) != 1:
+            raise ValueError("expected one EDN map")
+        data = edn.to_plain(vals[0])
+    else:
+        data = json.loads(text)
+    if not isinstance(data, dict):
+        raise ValueError("body must be a map")
+    raw_hist = data.get("history")
+    if not isinstance(raw_hist, list) or not raw_hist:
+        raise ValueError("'history' must be a non-empty list of ops")
+    ops = [Op.from_dict(edn.to_plain(d) if not isinstance(d, dict)
+                        else d) for d in raw_hist]
+    if ops and ops[0].index < 0:
+        ops = h.index(ops)
+    model_name = str(data.get("model", "cas-register"))
+    # tenant names are client-controlled and key bounded per-tenant
+    # state: cap the length here, cardinality in the registry
+    tenant = str(data.get("tenant") or default_tenant)[:64]
+    options = {k: v for k, v in (data.get("options") or {}).items()
+               if k in _CLIENT_OPTS}
+    timeout_s = data.get("timeout-s", data.get("timeout_s"))
+    if timeout_s is not None:
+        timeout_s = float(timeout_s)
+        if timeout_s <= 0:
+            raise ValueError("'timeout-s' must be positive")
+    return tenant, model_name, ops, options, timeout_s
+
+
+class Daemon:
+    """Everything the serving layer owns: registry, admission queue,
+    dispatcher thread, HTTP server. ``start()`` returns after the
+    socket is listening; ``shutdown()`` is graceful — stops admitting,
+    drains in-flight work, then stops the dispatcher.
+
+    Binds LOOPBACK by default: unlike the read-only results browser,
+    this endpoint accepts work (unauthenticated compute + store
+    writes) — exposing it (``host="0.0.0.0"``) is a deliberate act."""
+
+    def __init__(self, *, port: int = 8642, host: str = "127.0.0.1",
+                 queue_depth: int = 256,
+                 max_inflight_per_tenant: int = 8,
+                 group: int = 32,
+                 engine_kw: Optional[Dict[str, Any]] = None,
+                 store_root: Optional[str] = None,
+                 persist: bool = False,
+                 max_body_bytes: int = 32 << 20) -> None:
+        # the queue bounds request COUNT; this bounds request BYTES —
+        # both are needed for "backpressure, never OOM": worst-case
+        # queued history memory is queue_depth * max_body_bytes-ish
+        self.max_body_bytes = int(max_body_bytes)
+        self.registry = rq.Registry()
+        self.queue = AdmissionQueue(
+            max_depth=queue_depth,
+            max_inflight_per_tenant=max_inflight_per_tenant,
+            group=group)
+        # the coalescer's group width rides into the engine-side
+        # re-plan (facade filters it to check_many's `group=`): both
+        # planners must agree on the dispatch width or the admission
+        # bucketing would be re-split downstream
+        ekw = {"group": group}
+        ekw.update(engine_kw or {})
+        self.dispatcher = Dispatcher(self.queue, self.registry,
+                                     engine_kw=ekw,
+                                     store_root=store_root,
+                                     persist=persist)
+        handler = type("Handler", (_Handler,), {"daemon_ref": self})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._serve_thread: Optional[threading.Thread] = None
+        self.accepting = True
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self, *, dispatch: bool = True) -> "Daemon":
+        """``dispatch=False`` starts only the HTTP side — protocol
+        tests exercise admission/backpressure without a device
+        engine behind the queue."""
+        if dispatch:
+            self.dispatcher.start()
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="serve-http",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground mode (the CLI): blocks until interrupted, then
+        shuts down gracefully."""
+        self.dispatcher.start()
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self, drain_timeout: float = 30.0) -> bool:
+        self.accepting = False
+        drained = self.dispatcher.drain(timeout=drain_timeout)
+        self.dispatcher.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(5.0)
+        self.dispatcher._write_stats_file()
+        return drained
+
+    # -- request handling (called from HTTP worker threads) -------------
+    def submit(self, body: bytes, content_type: str,
+               header_tenant: Optional[str]) -> Tuple[int, Dict]:
+        import time as _time
+        if not self.accepting:
+            return 503, {"error": "shutting down"}
+        try:
+            tenant, model_name, ops, options, timeout_s = \
+                parse_check_body(body, content_type,
+                                 default_tenant=header_tenant
+                                 or "anonymous")
+            model = resolve_model(model_name)
+            packed = h.pack(ops)
+        except Exception as e:                          # noqa: BLE001
+            return 400, {"error": f"{type(e).__name__}: {e}"}
+        req = rq.CheckRequest(
+            id=rq.new_request_id(), tenant=tenant,
+            model_name=model_name, model=model, packed=packed,
+            history=ops, n_ops=int(packed.n), opts=options,
+            deadline=(_time.monotonic() + timeout_s
+                      if timeout_s else None))
+        try:
+            self.registry.add(req)
+            self.queue.submit(req)
+        except Backpressure as e:
+            # the id was never returned to the client: retract it so
+            # rejected requests cannot accumulate in the registry
+            self.registry.remove(req.id)
+            self.registry.ledger_record(tenant, "rejected",
+                                        cause="backpressure")
+            return 429, {"error": str(e), "retry-after-s": 1.0}
+        self.registry.ledger_record(tenant, "admitted", id=req.id,
+                                    ops=int(packed.n))
+        return 202, {"id": req.id, "status": req.status,
+                     "tenant": tenant, "ops": int(packed.n)}
+
+    def lookup(self, req_id: str) -> Tuple[int, Dict]:
+        req = self.registry.get(req_id)
+        if req is None:
+            return 404, {"error": f"unknown request {req_id!r}"}
+        return 200, req.to_json()
+
+    def cancel(self, req_id: str) -> Tuple[int, Dict]:
+        req = self.registry.get(req_id)
+        if req is None:
+            return 404, {"error": f"unknown request {req_id!r}"}
+        queued = self.queue.cancel(req_id)
+        if queued is not None:
+            from jepsen_tpu import obs
+            obs.count("serve.cancelled")
+            obs.count(f"serve.tenant."
+                      f"{self.registry.bucket_tenant(req.tenant)}"
+                      f".cancelled")
+            self.registry.finish(queued, rq.CANCELLED,
+                                 {"valid": "unknown",
+                                  "cause": "cancelled"})
+            self.registry.ledger_record(req.tenant, "cancelled",
+                                        id=req_id)
+        else:
+            # already walking: flag it; the dispatch abort hook and
+            # completion path observe the flag
+            req.cancel_requested = True
+        return 200, req.to_json()
+
+    def stats(self) -> Dict[str, Any]:
+        return self.dispatcher.stats()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    daemon_ref: Daemon = None           # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+
+    def _reply(self, code: int, payload: Dict) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if code == 429:
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:                          # noqa: N802
+        if self.path.rstrip("/") != "/check":
+            self._reply(404, {"error": "POST /check only"})
+            return
+        n = int(self.headers.get("Content-Length") or 0)
+        if n > self.daemon_ref.max_body_bytes:
+            # refuse BEFORE reading: a body cap enforced after
+            # rfile.read would already have paid the memory
+            self._reply(413, {"error": f"body {n} bytes exceeds "
+                              f"{self.daemon_ref.max_body_bytes}"})
+            return
+        body = self.rfile.read(n) if n else b""
+        code, payload = self.daemon_ref.submit(
+            body, self.headers.get("Content-Type", ""),
+            self.headers.get("X-Tenant"))
+        self._reply(code, payload)
+
+    def do_GET(self) -> None:                           # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/check/"):
+            code, payload = self.daemon_ref.lookup(
+                path[len("/check/"):].strip("/"))
+            self._reply(code, payload)
+            return
+        if path.rstrip("/") == "/stats":
+            self._reply(200, self.daemon_ref.stats())
+            return
+        if path.rstrip("/") == "/healthz":
+            self._reply(200, {"ok": True})
+            return
+        self._reply(404, {"error": f"no route {path!r}"})
+
+    def do_DELETE(self) -> None:                        # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/check/"):
+            code, payload = self.daemon_ref.cancel(
+                path[len("/check/"):].strip("/"))
+            self._reply(code, payload)
+            return
+        self._reply(404, {"error": "DELETE /check/<id> only"})
+
+    def log_message(self, *args) -> None:               # quiet
+        pass

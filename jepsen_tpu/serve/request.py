@@ -1,0 +1,221 @@
+"""Request objects and the daemon-wide registry.
+
+A :class:`CheckRequest` is one client-submitted linearizability check:
+the packed history, the resolved model, per-request options, the
+tenant it belongs to, and an optional deadline. The request moves
+through a small state machine::
+
+    queued -> dispatched -> done
+       |          |-> timeout   (deadline passed; verdict "unknown")
+       |-> timeout              (deadline passed while still queued)
+    queued -> cancelled         (client DELETE before dispatch)
+    queued -> rejected          (never stored: backpressure is a 429
+                                 at admission, the request never
+                                 enters the registry)
+
+The :class:`Registry` is the daemon's single source of truth for
+request lookup (``GET /check/<id>``), per-tenant serve ledgers, and
+per-status counts. Completed requests are retained FIFO-bounded so a
+long-lived daemon cannot leak memory one verdict at a time.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu import history as h
+from jepsen_tpu.models import Model
+from jepsen_tpu.op import Op
+
+# request lifecycle states (strings: they go straight into JSON)
+QUEUED = "queued"
+DISPATCHED = "dispatched"
+DONE = "done"
+TIMEOUT = "timeout"
+CANCELLED = "cancelled"
+
+_TERMINAL = (DONE, TIMEOUT, CANCELLED)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class CheckRequest:
+    """One admitted check. Mutable fields are only written under the
+    registry/queue locks or by the single dispatcher thread."""
+    id: str
+    tenant: str
+    model_name: str
+    model: Model
+    packed: Optional[h.PackedHistory]
+    history: Sequence[Op]
+    n_ops: int = 0              # survives the terminal payload drop
+    opts: Dict[str, Any] = field(default_factory=dict)
+    deadline: Optional[float] = None        # time.monotonic() instant
+    t_submit: float = field(default_factory=time.monotonic)
+    t_dispatch: Optional[float] = None
+    t_done: Optional[float] = None
+    status: str = QUEUED
+    result: Optional[Dict[str, Any]] = None
+    run_dir: Optional[str] = None           # when persisted via store
+    done_event: threading.Event = field(default_factory=threading.Event)
+    cancel_requested: bool = False
+
+    @property
+    def model_sig(self) -> tuple:
+        """Coalescing compatibility key: requests sharing this
+        signature may ride one dispatch group — same model (the
+        union-alphabet stage A is built per model identity) AND same
+        engine options (a group shares one walk, so differing caps
+        cannot both be honored; clients who set none share freely)."""
+        return (type(self.model).__name__, repr(self.model),
+                tuple(sorted(self.opts.items())))
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.monotonic()) \
+            >= self.deadline
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in _TERMINAL
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "id": self.id, "tenant": self.tenant,
+            "model": self.model_name, "status": self.status,
+            "ops": int(self.n_ops),
+        }
+        if self.t_dispatch is not None:
+            out["queued-s"] = round(self.t_dispatch - self.t_submit, 6)
+        if self.t_done is not None:
+            out["latency-s"] = round(self.t_done - self.t_submit, 6)
+        if self.result is not None:
+            out["result"] = self.result
+        if self.run_dir is not None:
+            out["run-dir"] = self.run_dir
+        return out
+
+
+class Registry:
+    """id -> request lookup plus per-tenant serve ledgers.
+
+    Terminal requests are retained FIFO-bounded (``keep_done``): the
+    oldest completed request is evicted when a new one completes past
+    the bound, so ``GET /check/<id>`` works for recently-finished ids
+    without unbounded growth. Per-tenant ledgers are bounded deques of
+    structured records (admitted / dispatched / done / timeout /
+    cancelled / rejected) — the serve-layer analogue of the
+    engine-decision ledger, isolated per tenant."""
+
+    def __init__(self, keep_done: int = 4096,
+                 ledger_depth: int = 512,
+                 max_tenants: int = 1024) -> None:
+        self._lock = threading.Lock()
+        self._by_id: "OrderedDict[str, CheckRequest]" = OrderedDict()
+        self._done_order: "deque[str]" = deque()
+        self._keep_done = keep_done
+        self._ledger_depth = ledger_depth
+        self._max_tenants = max_tenants
+        self._tenant_ledgers: Dict[str, deque] = {}
+        # nested, NOT "tenant.event" flat keys: tenant names are
+        # client-controlled and may themselves contain dots
+        self._event_counts: Dict[str, Dict[str, int]] = {}
+
+    def add(self, req: CheckRequest) -> None:
+        with self._lock:
+            self._by_id[req.id] = req
+
+    def get(self, req_id: str) -> Optional[CheckRequest]:
+        with self._lock:
+            return self._by_id.get(req_id)
+
+    def remove(self, req_id: str) -> None:
+        """Retract a request that never really entered the system
+        (admission rejected after the registry add)."""
+        with self._lock:
+            self._by_id.pop(req_id, None)
+
+    def finish(self, req: CheckRequest, status: str,
+               result: Optional[Dict[str, Any]] = None) -> None:
+        """Transition a request to a terminal state (idempotent: the
+        first terminal transition wins — a deadline firing while the
+        dispatcher publishes a verdict must not flap the status)."""
+        with self._lock:
+            if req.terminal:
+                return
+            req.status = status
+            if result is not None:
+                req.result = result
+            req.t_done = time.monotonic()
+            # the lookup contract only needs the verdict from here on:
+            # drop the packed arrays and the Op list (persistence, if
+            # any, already happened) so keep_done retained verdicts
+            # cost bytes, not histories
+            req.packed = None
+            req.history = ()
+            self._done_order.append(req.id)
+            while len(self._done_order) > self._keep_done:
+                old = self._done_order.popleft()
+                self._by_id.pop(old, None)
+        req.done_event.set()
+
+    def bucket_tenant(self, tenant: str) -> str:
+        """Tenant key for ledger/counter purposes. Tenant names are
+        client-controlled, so distinct-tenant state must be bounded:
+        past ``max_tenants`` known tenants, new names share one
+        ``(overflow)`` bucket (and the overflow is itself counted)."""
+        with self._lock:
+            return self._bucket_tenant_locked(tenant)
+
+    def _bucket_tenant_locked(self, tenant: str) -> str:
+        if tenant in self._tenant_ledgers \
+                or len(self._tenant_ledgers) < self._max_tenants:
+            return tenant
+        return "(overflow)"
+
+    def ledger_record(self, tenant: str, event: str,
+                      **fields: Any) -> None:
+        rec = {"ts": round(time.time(), 6), "event": event}
+        rec.update(fields)
+        overflowed = False
+        with self._lock:
+            bucketed = self._bucket_tenant_locked(tenant)
+            # one overflow count per overflowed REQUEST (admission is
+            # the once-per-request event), not per ledger consult
+            overflowed = bucketed != tenant and event == "admitted"
+            led = self._tenant_ledgers.get(bucketed)
+            if led is None:
+                led = deque(maxlen=self._ledger_depth)
+                self._tenant_ledgers[bucketed] = led
+            led.append(rec)
+            ev = self._event_counts.setdefault(bucketed, {})
+            ev[event] = ev.get(event, 0) + 1
+        if overflowed:
+            from jepsen_tpu import obs
+            obs.count("serve.tenant_overflow")
+
+    def tenant_ledger(self, tenant: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._tenant_ledgers.get(tenant, ())]
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenant_ledgers)
+
+    def stats(self) -> Dict[str, Any]:
+        """Per-tenant event counts + live request-status census."""
+        with self._lock:
+            census: Dict[str, int] = {}
+            for req in self._by_id.values():
+                census[req.status] = census.get(req.status, 0) + 1
+            tenants = {t: dict(ev)
+                       for t, ev in self._event_counts.items()}
+            return {"requests": census, "tenants": tenants}

@@ -234,6 +234,22 @@ def save_obs(run_dir: str, capture: Optional[Any] = None) -> None:
         log.warning("obs persistence failed: %s", e)
 
 
+def save_check(root: str, name: str, run_id: str, history: List[Op],
+               results: Mapping) -> str:
+    """Persist one standalone check (the check-serve daemon's unit of
+    work) as a browsable run dir — ``<root>/<name>/<ts>-<run_id>/``.
+    Delegates to :func:`save` so daemon runs carry the exact artifact
+    set CLI runs do (``results.json``/``.edn``, ``history.jsonl``/
+    ``.edn``/``.txt``, ``test.json``) and cannot drift from it."""
+    import time as _time
+    ts = _time.strftime("%Y%m%dT%H%M%S", _time.gmtime())
+    d = os.path.join(root, str(name).replace("/", "_"),
+                     f"{ts}-{run_id}")
+    os.makedirs(d, exist_ok=True)
+    return save({"name": name, "history": list(history),
+                 "results": results}, run_dir=d)
+
+
 def load_history(run_dir: str) -> List[Op]:
     """Load a stored history for offline re-analysis (the upstream
     re-check path; SURVEY.md §5 checkpoint/resume)."""

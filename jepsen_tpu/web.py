@@ -24,11 +24,15 @@ _ARTIFACTS = ("results.json", "history.txt", "timeline.html",
 
 def _badge(valid: str) -> str:
     """Upstream-style verdict badge: green valid, red invalid, amber
-    unknown/indeterminate."""
+    for the checker's own ``"unknown"`` verdict, grey for anything
+    else (a malformed results.json, an error string) — an
+    indeterminate-but-well-formed verdict must not look the same as
+    garbage."""
     color, label = {
         "True": ("#2e7d32", "valid"),
         "False": ("#c62828", "INVALID"),
-    }.get(valid, ("#b07d2b", valid or "?"))
+        "unknown": ("#b07d2b", "unknown"),
+    }.get(valid, ("#616161", valid or "?"))
     return (f"<span class='badge' style='background:{color}'>"
             f"{html.escape(label)}</span>")
 
@@ -54,34 +58,121 @@ def _run_row(root: str, name: str, run: str) -> str:
             f"<td class='artifacts'>{links}</td></tr>")
 
 
+def _live_row(root: str) -> str:
+    """When a check-serve daemon persists into this store (its stats
+    snapshot exists), surface it: a 'live' row on top of the index
+    linking the daemon's stats page and its persisted runs (the
+    ``serve-<model>`` test groups below are those runs)."""
+    stats_path = os.path.join(root, "serve", "stats.json")
+    if not os.path.exists(stats_path):
+        return ""
+    n_done = ""
+    try:
+        with open(stats_path) as f:
+            st = json.load(f)
+        n = st.get("counters", {}).get("serve.completed")
+        if n is not None:
+            n_done = f" ({int(n)} checks served)"
+    except Exception:                                   # noqa: BLE001
+        pass
+    return (f"<tr><td><a href='/engine'>live</a></td>"
+            f"<td>check-serve daemon{html.escape(n_done)}</td>"
+            f"<td>{_badge('live')}</td>"
+            f"<td class='artifacts'><a href='/engine'>engine stats"
+            f"</a></td></tr>")
+
+
+_STYLE = ("<style>body{font-family:sans-serif;margin:2em}"
+          "table{border-collapse:collapse}td,th{padding:4px 12px;"
+          "border-bottom:1px solid #eee;text-align:left}"
+          ".badge{color:#fff;border-radius:3px;padding:1px 7px;"
+          "font-size:85%}"
+          ".artifacts a{margin-right:.6em;font-size:90%}"
+          "pre{background:#f6f6f6;padding:1em;overflow:auto}</style>")
+
+
 def _index_html(root: str) -> str:
     rows = [_run_row(root, name, run)
             for name, runs in store.tests(root).items()
             for run in reversed(runs)]
     return ("<!doctype html><title>jepsen-tpu results</title>"
-            "<style>body{font-family:sans-serif;margin:2em}"
-            "table{border-collapse:collapse}td,th{padding:4px 12px;"
-            "border-bottom:1px solid #eee;text-align:left}"
-            ".badge{color:#fff;border-radius:3px;padding:1px 7px;"
-            "font-size:85%}"
-            ".artifacts a{margin-right:.6em;font-size:90%}</style>"
+            + _STYLE +
             "<h1>jepsen-tpu results</h1><table>"
             "<tr><th>test</th><th>run</th><th>valid?</th>"
             "<th>artifacts</th></tr>"
-            + "".join(rows) + "</table>")
+            + _live_row(root) + "".join(rows) + "</table>")
+
+
+def _engine_html(root: str) -> str:
+    """The ``/engine`` page: the check-serve daemon's latest stats
+    snapshot (``<root>/serve/stats.json``, rewritten by the daemon
+    after every dispatch) — queue depth, per-tenant serve ledgers,
+    per-geometry dispatch counts, and every ``serve.*`` counter."""
+    stats_path = os.path.join(root, "serve", "stats.json")
+    head = ("<!doctype html><title>jepsen-tpu engine</title>" + _STYLE
+            + "<h1>check-serve daemon</h1>"
+              "<p><a href='/'>&larr; results index</a></p>")
+    if not os.path.exists(stats_path):
+        return (head + "<p>No daemon stats found — start one with "
+                       "<code>python -m jepsen_tpu check-serve"
+                       "</code> (it writes "
+                       "<code>serve/stats.json</code> under its "
+                       "store root).</p>")
+    try:
+        with open(stats_path) as f:
+            st = json.load(f)
+    except Exception as e:                              # noqa: BLE001
+        return head + f"<p>stats unreadable: {html.escape(str(e))}</p>"
+    counters = st.get("counters", {})
+    serve_rows = "".join(
+        f"<tr><td>{html.escape(k)}</td><td>{v}</td></tr>"
+        for k, v in sorted(counters.items())
+        if k.startswith("serve."))
+    disp_rows = "".join(
+        f"<tr><td>{html.escape(k)}</td><td>{v}</td></tr>"
+        for k, v in sorted(st.get("dispatch", {}).items()))
+    tenants = st.get("tenants", {})
+    tenant_rows = "".join(
+        f"<tr><td>{html.escape(t)}</td>"
+        f"<td>{html.escape(json.dumps(ev))}</td></tr>"
+        for t, ev in sorted(tenants.items()))
+    q = st.get("queue", {})
+    return (head
+            + f"<p>queue depth {q.get('depth', '?')} / "
+              f"{q.get('max_depth', '?')}, group width "
+              f"{q.get('group', '?')}, per-tenant in-flight cap "
+              f"{q.get('max_inflight_per_tenant', '?')}</p>"
+            + "<h2>serve counters</h2><table>"
+              "<tr><th>counter</th><th>value</th></tr>"
+            + serve_rows + "</table>"
+            + "<h2>dispatch groups (model/width)</h2><table>"
+              "<tr><th>geometry</th><th>count</th></tr>"
+            + disp_rows + "</table>"
+            + "<h2>tenants</h2><table>"
+              "<tr><th>tenant</th><th>events</th></tr>"
+            + tenant_rows + "</table>"
+            + "<h2>raw snapshot</h2><pre>"
+            + html.escape(json.dumps(st, indent=2, default=str))
+            + "</pre>")
 
 
 class _Handler(SimpleHTTPRequestHandler):
     store_root = "store"
 
+    def _html(self, body: str) -> None:
+        data = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):                                   # noqa: N802
         if self.path in ("/", "/index.html"):
-            body = _index_html(self.store_root).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html; charset=utf-8")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_index_html(self.store_root))
+            return
+        if self.path.rstrip("/") == "/engine":
+            self._html(_engine_html(self.store_root))
             return
         if self.path.startswith("/files/"):
             rel = urllib.parse.unquote(self.path[len("/files/"):])

@@ -31,6 +31,12 @@ _WORKER = textwrap.dedent("""
     sys.path.insert(0, {repo!r})
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # multiprocess computations on the CPU backend need an explicit
+    # collectives implementation (the default CPU client raises
+    # INVALID_ARGUMENT on any cross-process collective); gloo-over-TCP
+    # ships in jaxlib when built with it — the module-level capability
+    # probe skips this whole test where it is absent
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     from jepsen_tpu.parallel import distributed
     ok = distributed.initialize(
         coordinator_address="localhost:" + port,
@@ -96,6 +102,37 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _cpu_multiprocess_collectives_available() -> bool:
+    """Capability probe: multiprocess computations on the CPU backend
+    require a cross-process collectives implementation in jaxlib
+    (gloo-over-TCP). Without it every cross-process psum/allgather
+    raises ``INVALID_ARGUMENT: Multiprocess computations aren't
+    implemented on the CPU backend`` — the whole test is a known
+    environment failure, not a code failure, so it skips cleanly."""
+    try:
+        from jax._src.lib import xla_extension as xe
+        if not hasattr(xe, "make_gloo_tcp_collectives"):
+            return False
+        import jax
+        # the config flag must exist too (older jax wired gloo
+        # differently); the flag registry is consulted rather than
+        # attribute access — string flags are holders, not attributes
+        holders = getattr(jax.config, "_value_holders", None)
+        if holders is not None:
+            return "jax_cpu_collectives_implementation" in holders
+        return True                     # newer jax: trust jaxlib's gloo
+    except Exception:                                   # noqa: BLE001
+        return False
+
+
+@pytest.mark.slow          # ~40 s of two-process jax bootstraps: runs
+                           # in the CI mesh job and full local runs,
+                           # not the 870 s tier-1 budget
+@pytest.mark.skipif(
+    not _cpu_multiprocess_collectives_available(),
+    reason="jaxlib lacks CPU multiprocess collectives (gloo): "
+           "cross-process computations are unimplemented on the CPU "
+           "backend in this environment")
 def test_two_process_distributed_check(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)

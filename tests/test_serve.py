@@ -1,0 +1,412 @@
+"""The checker-as-a-service daemon (ISSUE 6): coalescer/fairness
+policy as pure host-side units, the HTTP protocol without an engine,
+and one end-to-end daemon serving concurrent multi-tenant traffic
+with verdicts differentially checked against the standalone facade
+chain."""
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import fixtures, models, obs
+from jepsen_tpu import history as h
+from jepsen_tpu.serve import coalesce
+from jepsen_tpu.serve import request as rq
+
+
+def _req(n_ops=32, tenant="t", t_submit=None, model=None,
+         deadline=None, rid=None):
+    """A CheckRequest for pure scheduling tests: the packed history
+    is a stub carrying only the length — the coalescer must never
+    need more than that on the host side."""
+    r = rq.CheckRequest(
+        id=rid or rq.new_request_id(), tenant=tenant,
+        model_name="cas-register",
+        model=model or models.cas_register(),
+        packed=types.SimpleNamespace(n=n_ops),           # host-side only
+        history=[], deadline=deadline)
+    if t_submit is not None:
+        r.t_submit = t_submit
+    return r
+
+
+# -- coalescer: geometry bucketing ---------------------------------------
+
+def test_plan_admission_buckets_mixed_geometry():
+    """Short histories must not ride a long history's padded walk:
+    plan_admission separates length buckets (via the lockstep
+    engine's own plan_buckets) and partitions every request exactly
+    once."""
+    lens = [20_000, 30, 40, 19_000, 25, 50, 18_000]
+    reqs = [_req(n_ops=n, tenant=f"t{i}") for i, n in enumerate(lens)]
+    groups = coalesce.plan_admission(reqs)
+    flat = sorted(i for g in groups for i in g)
+    assert flat == list(range(len(reqs)))               # a partition
+    big = {i for i, n in enumerate(lens) if n > 1000}
+    small = {i for i, n in enumerate(lens) if n < 1000}
+    for g in groups:
+        s = set(g)
+        assert not (s & big and s & small), \
+            f"group {g} mixes length classes"
+
+
+def test_plan_admission_group_width_cap():
+    reqs = [_req(n_ops=32, tenant="t") for _ in range(70)]
+    groups = coalesce.plan_admission(reqs, group=32)
+    assert all(len(g) <= 32 for g in groups)
+    assert sum(len(g) for g in groups) == 70
+
+
+# -- coalescer: fairness -------------------------------------------------
+
+def test_oldest_tenant_first_ordering():
+    """Within a dispatch group, the tenant who has waited longest
+    heads the lane order, and a tenant's requests stay contiguous."""
+    t0 = time.monotonic()
+    reqs = [
+        _req(tenant="young", t_submit=t0 + 5.0),
+        _req(tenant="old", t_submit=t0 + 0.0),
+        _req(tenant="young", t_submit=t0 + 2.0),   # young's oldest=2.0
+        _req(tenant="old", t_submit=t0 + 6.0),
+    ]
+    groups = coalesce.plan_admission(reqs)
+    assert len(groups) == 1
+    order = [reqs[i].tenant for i in groups[0]]
+    assert order == ["old", "old", "young", "young"]
+    times = [reqs[i].t_submit for i in groups[0]]
+    assert times == [t0 + 0.0, t0 + 6.0, t0 + 2.0, t0 + 5.0]
+
+
+def test_tenant_inflight_cap_limits_batch_and_releases():
+    q = coalesce.AdmissionQueue(max_depth=16,
+                                max_inflight_per_tenant=1, group=8)
+    t0 = time.monotonic()
+    a1 = _req(tenant="a", t_submit=t0)
+    a2 = _req(tenant="a", t_submit=t0 + 0.001)
+    a3 = _req(tenant="a", t_submit=t0 + 0.002)
+    b1 = _req(tenant="b", t_submit=t0 + 0.003)
+    for r in (a1, a2, a3, b1):
+        q.submit(r)
+    batch = q.next_batch(timeout=1.0)
+    # one per tenant: a's oldest plus b's only
+    assert {r.id for r in batch} == {a1.id, b1.id}
+    assert q.inflight() == {"a": 1, "b": 1}
+    # a2/a3 stay queued while a1 walks
+    assert q.next_batch(timeout=0.05) == []
+    q.mark_done(batch)
+    batch2 = q.next_batch(timeout=1.0)
+    assert [r.id for r in batch2] == [a2.id]
+    q.mark_done(batch2)
+
+
+def test_differing_engine_options_never_coalesce():
+    """Per-request options apply to the whole dispatch, so they are
+    part of the compatibility signature: same model + same options
+    share a group, differing options never do."""
+    t0 = time.monotonic()
+    plain1 = _req(tenant="a", t_submit=t0)
+    capped = _req(tenant="b", t_submit=t0 + 0.01)
+    capped.opts = {"max_states": 500}
+    plain2 = _req(tenant="c", t_submit=t0 + 0.02)
+    q = coalesce.AdmissionQueue(max_depth=16)
+    for r in (plain1, capped, plain2):
+        q.submit(r)
+    b1 = q.next_batch(timeout=1.0)      # the two optionless coalesce
+    assert {r.id for r in b1} == {plain1.id, plain2.id}
+    q.mark_done(b1)
+    b2 = q.next_batch(timeout=1.0)      # the capped one rides alone
+    assert [r.id for r in b2] == [capped.id]
+    q.mark_done(b2)
+
+
+def test_one_model_signature_per_dispatch_group():
+    t0 = time.monotonic()
+    cas = _req(tenant="a", t_submit=t0, model=models.cas_register())
+    mtx1 = _req(tenant="b", t_submit=t0 + 0.01, model=models.mutex())
+    mtx2 = _req(tenant="c", t_submit=t0 + 0.02, model=models.mutex())
+    q = coalesce.AdmissionQueue(max_depth=16)
+    for r in (mtx1, cas, mtx2):
+        q.submit(r)
+    b1 = q.next_batch(timeout=1.0)      # oldest (cas) goes first, alone
+    assert [r.id for r in b1] == [cas.id]
+    q.mark_done(b1)
+    b2 = q.next_batch(timeout=1.0)      # both mutexes coalesce
+    assert {r.id for r in b2} == {mtx1.id, mtx2.id}
+    q.mark_done(b2)
+
+
+# -- coalescer: backpressure + deadlines ---------------------------------
+
+def test_backpressure_rejects_at_bound():
+    q = coalesce.AdmissionQueue(max_depth=2)
+    q.submit(_req())
+    q.submit(_req())
+    with obs.capture() as cap:
+        with pytest.raises(coalesce.Backpressure):
+            q.submit(_req())
+    assert cap.counters.get("serve.rejected.backpressure") == 1
+    assert [f["stage"] for f in cap.fallbacks()] == ["serve-admit"]
+    assert q.depth() == 2               # the rejected one never entered
+
+
+def test_queued_deadline_expiry_never_dispatches():
+    q = coalesce.AdmissionQueue(max_depth=8)
+    timed_out = []
+    q.on_timeout = timed_out.append
+    dead = _req(tenant="late", deadline=time.monotonic() - 0.01)
+    live = _req(tenant="ok")
+    q.submit(dead)
+    q.submit(live)
+    with obs.capture() as cap:
+        batch = q.next_batch(timeout=1.0)
+    assert [r.id for r in batch] == [live.id]
+    assert [r.id for r in timed_out] == [dead.id]
+    assert cap.counters.get("serve.timeout") == 1
+    assert [f["stage"] for f in cap.fallbacks()] == ["serve-timeout"]
+    q.mark_done(batch)
+
+
+def test_cancel_queued_request():
+    q = coalesce.AdmissionQueue(max_depth=8)
+    r = _req()
+    q.submit(r)
+    assert q.cancel(r.id) is r
+    assert q.depth() == 0
+    assert q.cancel("nope") is None
+
+
+# -- registry ------------------------------------------------------------
+
+def test_registry_tenant_cardinality_is_bounded():
+    """Tenant names are client-controlled: past max_tenants distinct
+    names, new tenants share one ``(overflow)`` bucket instead of
+    growing per-tenant state forever."""
+    reg = rq.Registry(max_tenants=2)
+    for t in ("a", "b", "evil-0", "evil-1", "a"):
+        reg.ledger_record(t, "admitted")
+    stats = reg.stats()
+    assert set(stats["tenants"]) == {"a", "b", "(overflow)"}
+    assert stats["tenants"]["(overflow)"]["admitted"] == 2
+    assert stats["tenants"]["a"]["admitted"] == 2
+
+
+def test_registry_stats_survive_dotted_tenant_names():
+    """Tenant names are client-controlled and may contain dots; the
+    stats view must not split them into phantom tenants."""
+    reg = rq.Registry()
+    reg.ledger_record("team.a", "admitted")
+    reg.ledger_record("team.b", "admitted")
+    stats = reg.stats()
+    assert set(stats["tenants"]) == {"team.a", "team.b"}
+    assert stats["tenants"]["team.a"] == {"admitted": 1}
+
+
+def test_registry_finish_drops_history_payload():
+    """Terminal requests keep the verdict, not the history: the
+    packed arrays and Op list are released at the terminal
+    transition (the registry retains thousands of them)."""
+    reg = rq.Registry()
+    r = _req(n_ops=64)
+    r.n_ops = 64
+    reg.add(r)
+    reg.finish(r, rq.DONE, {"valid": True})
+    assert r.packed is None and r.history == ()
+    assert r.to_json()["ops"] == 64      # the count survives the drop
+
+
+def test_registry_finish_is_idempotent_and_bounded():
+    reg = rq.Registry(keep_done=2)
+    reqs = [_req(rid=f"r{i}") for i in range(4)]
+    for r in reqs:
+        reg.add(r)
+        reg.finish(r, rq.DONE, {"valid": True})
+    # first terminal transition wins
+    reg.finish(reqs[3], rq.TIMEOUT)
+    assert reqs[3].status == rq.DONE
+    # FIFO retention: the two oldest were evicted
+    assert reg.get("r0") is None and reg.get("r1") is None
+    assert reg.get("r2") is not None and reg.get("r3") is not None
+
+
+# -- HTTP protocol (no engine behind the queue) --------------------------
+
+def _post_json(url, payload, tenant=None):
+    req = urllib.request.Request(
+        url + "/check", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"X-Tenant": tenant} if tenant else {})})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get_json(url, path):
+    try:
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def protocol_daemon():
+    from jepsen_tpu import serve
+    d = serve.Daemon(port=0, host="127.0.0.1", queue_depth=2)
+    d.start(dispatch=False)             # admission only, no walks
+    yield d, f"http://127.0.0.1:{d.port}"
+    d.shutdown(drain_timeout=0.1)
+
+
+def test_http_submit_lookup_and_errors(protocol_daemon):
+    d, url = protocol_daemon
+    hist = [op.to_dict()
+            for op in fixtures.gen_history("cas", n_ops=8,
+                                           processes=2, seed=3)]
+    code, resp = _post_json(url, {"model": "cas-register",
+                                  "history": hist}, tenant="hdr")
+    assert code == 202 and resp["status"] == "queued"
+    assert resp["tenant"] == "hdr"      # X-Tenant header honored
+    code, st = _get_json(url, f"/check/{resp['id']}")
+    assert code == 200 and st["status"] == "queued"
+    # malformed bodies -> 400, never a crash
+    for bad in ({"model": "cas-register", "history": []},
+                {"model": "no-such-model", "history": hist},
+                {"history": "not-a-list"}):
+        code, err = _post_json(url, bad)
+        assert code == 400 and "error" in err
+    code, _ = _get_json(url, "/check/doesnotexist")
+    assert code == 404
+    code, ok = _get_json(url, "/healthz")
+    assert code == 200 and ok["ok"] is True
+
+
+def test_http_backpressure_returns_429(protocol_daemon):
+    d, url = protocol_daemon            # queue_depth=2, no dispatcher
+    hist = [op.to_dict()
+            for op in fixtures.gen_history("cas", n_ops=8,
+                                           processes=2, seed=4)]
+    codes = [_post_json(url, {"model": "cas-register",
+                              "history": hist})[0] for _ in range(4)]
+    assert codes[:2] == [202, 202]
+    assert codes[2] == 429 and codes[3] == 429
+    code, stats = _get_json(url, "/stats")
+    assert code == 200
+    assert stats["counters"].get("serve.rejected.backpressure",
+                                 0) >= 2
+    # rejected requests were retracted: only the two admitted ones
+    # exist in the registry census
+    assert stats["requests"] == {"queued": 2}
+
+
+def test_parse_check_body_edn():
+    from jepsen_tpu.serve.http import parse_check_body
+    edn_body = (b'{:model "cas-register" :tenant "e" '
+                b':history [{:process 0 :type :invoke :f :write '
+                b':value 1} {:process 0 :type :ok :f :write '
+                b':value 1}]}')
+    tenant, model_name, ops, options, timeout_s = parse_check_body(
+        edn_body, "application/edn")
+    assert (tenant, model_name, timeout_s) == ("e", "cas-register",
+                                               None)
+    assert [o.type for o in ops] == ["invoke", "ok"]
+
+
+# -- end to end ----------------------------------------------------------
+
+@pytest.mark.slow           # ~30 s of real HTTP + device walks: runs
+                            # unfiltered in the CI serve-smoke job and
+                            # full local runs
+def test_daemon_end_to_end_multi_tenant(tmp_path):
+    """One daemon process, four tenants posting concurrent valid AND
+    violating histories: verdicts must equal the standalone facade
+    chain's (witness included), per-tenant serve ledgers stay
+    isolated, completed checks persist as browsable store runs, and
+    the /engine stats page renders them."""
+    from jepsen_tpu import serve, web
+    from jepsen_tpu.checkers import facade
+
+    store_root = str(tmp_path)
+    d = serve.Daemon(port=0, host="127.0.0.1", group=8,
+                     store_root=store_root, persist=True).start()
+    url = f"http://127.0.0.1:{d.port}"
+    try:
+        cases = []                      # (tenant, hist, expect_valid)
+        for t in range(4):
+            good = fixtures.gen_history("cas", n_ops=16, processes=3,
+                                        seed=10 + t)
+            bad = fixtures.corrupt(
+                fixtures.gen_history("cas", n_ops=16, processes=3,
+                                     seed=20 + t), seed=t)
+            cases.append((f"tenant-{t}", good, True))
+            cases.append((f"tenant-{t}", bad, False))
+
+        results = {}
+        lock = threading.Lock()
+
+        def drive(tenant, hist, expect):
+            code, resp = _post_json(
+                url, {"model": "cas-register", "tenant": tenant,
+                      "history": [op.to_dict() for op in hist]})
+            assert code == 202, resp
+            rid = resp["id"]
+            end = time.monotonic() + 300
+            while time.monotonic() < end:
+                code, st = _get_json(url, f"/check/{rid}")
+                if st.get("status") in ("done", "timeout",
+                                        "cancelled"):
+                    break
+                time.sleep(0.02)
+            with lock:
+                results[(tenant, expect, rid)] = st
+
+        threads = [threading.Thread(target=drive, args=c, daemon=True)
+                   for c in cases]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(360)
+
+        assert len(results) == len(cases)
+        for (tenant, expect, rid), st in results.items():
+            assert st["status"] == "done", st
+            assert st["result"]["valid"] is expect, st
+        # witness retrieval: every violating verdict names the op,
+        # identical to the standalone facade chain's witness
+        for (tenant, expect, rid), st in results.items():
+            if expect:
+                continue
+            hist = next(hh for (tt, hh, ee) in cases
+                        if tt == tenant and ee is False)
+            stand = facade.auto_check_packed(
+                models.cas_register(), h.pack(hist), {})
+            assert stand["valid"] is False
+            assert st["result"]["op"] == stand["op"], \
+                (st["result"]["op"], stand["op"])
+        # per-tenant ledger isolation
+        code, stats = _get_json(url, "/stats")
+        assert code == 200
+        for t in range(4):
+            ten = stats["tenants"][f"tenant-{t}"]
+            assert ten["admitted"] == 2 and ten["done"] == 2
+        assert stats["counters"]["serve.completed"] == len(cases)
+        # persisted runs are browsable store runs
+        import os
+        runs = [p for p in os.listdir(store_root)
+                if p.startswith("serve-")and p != "serve"]
+        assert "serve-cas-register" in runs
+        run_dirs = os.listdir(
+            os.path.join(store_root, "serve-cas-register"))
+        assert len(run_dirs) == len(cases)
+        # the /engine page renders the daemon's stats snapshot
+        page = web._engine_html(store_root)
+        assert "serve.completed" in page and "tenant-3" in page
+        # and the index grows the live row
+        assert "/engine" in web._index_html(store_root)
+    finally:
+        assert d.shutdown() is True     # drains clean

@@ -1,0 +1,389 @@
+"""Open-loop load generator for the checker-as-a-service daemon.
+
+Replays a mixed-geometry history workload (several sizes, several
+tenants, a configurable fraction of known-violating histories)
+against a running daemon at a target arrival rate and reports
+sustained req/s plus p50/p99 verdict latency — split into two
+measurement windows so the warm-cache effect is a number, not an
+anecdote (window 2 runs entirely on compiled geometries and seeded
+memo tables; it should beat window 1).
+
+Open-loop means arrivals are scheduled by the clock, not by
+completions: if the daemon falls behind, the queue grows and
+backpressure 429s show up in the report instead of the generator
+politely slowing down — that is the regime a "millions of users"
+front door actually faces.
+
+Usage::
+
+    python tools/loadgen.py --url http://127.0.0.1:8642 [--quick]
+    python tools/loadgen.py --self-host --rate 20 --duration 10
+
+Exit status: 0 iff at least one request completed AND every verdict
+matched its history's known ground truth. The final ``/stats``
+snapshot rides along in the JSON report (the CI smoke job asserts
+zero silent fallbacks from it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_pool(*, sizes, tenants: int, violation_frac: float,
+               model: str = "cas-register", seed: int = 7,
+               kinds: Tuple[str, ...] = ("cas",)) -> List[Dict]:
+    """Pre-generate the payload pool: one entry per (size, kind)
+    pair per tenant slot, each a ready-to-POST body plus its known
+    ground-truth verdict."""
+    from jepsen_tpu import fixtures
+
+    pool: List[Dict] = []
+    i = 0
+    for kind in kinds:
+        for n_ops in sizes:
+            for t in range(tenants):
+                i += 1
+                hist = fixtures.gen_history(kind, n_ops=n_ops,
+                                            processes=3,
+                                            seed=seed + i)
+                expect = True
+                if (i * 1000 % 997) / 997.0 < violation_frac:
+                    hist = fixtures.corrupt(hist, seed=seed + i)
+                    expect = False
+                pool.append({
+                    "tenant": f"tenant-{t}",
+                    "expect": expect,
+                    "ops": len(hist),
+                    "body": json.dumps({
+                        "model": model,
+                        "tenant": f"tenant-{t}",
+                        "history": [op.to_dict() for op in hist],
+                    }).encode(),
+                })
+    return pool
+
+
+def _post(url: str, body: bytes) -> Tuple[int, Dict]:
+    req = urllib.request.Request(
+        url + "/check", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:                               # noqa: BLE001
+            return e.code, {}
+    except Exception:                                   # noqa: BLE001
+        # URLError / reset / socket timeout: transport failure, not an
+        # HTTP status — the caller records it instead of losing the
+        # request from the report's accounting
+        return -1, {}
+
+
+def _get(url: str, path: str) -> Tuple[int, Dict]:
+    try:
+        with urllib.request.urlopen(url + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, {}
+    except Exception:                                   # noqa: BLE001
+        return -1, {}
+
+
+def wait_ready(url: str, timeout: float = 30.0) -> bool:
+    """Poll /healthz until the daemon answers (the CI smoke job
+    starts the daemon in the background and races its jax import)."""
+    end = time.monotonic() + timeout
+    while time.monotonic() < end:
+        try:
+            code, _ = _get(url, "/healthz")
+            if code == 200:
+                return True
+        except Exception:                               # noqa: BLE001
+            pass
+        time.sleep(0.2)
+    return False
+
+
+def _percentile(xs: List[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    s = sorted(xs)
+    i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[i]
+
+
+def _window_report(records: List[Dict], t_start: float,
+                   t_mid: float, t_end: float) -> List[Dict]:
+    out = []
+    for lo, hi in ((t_start, t_mid), (t_mid, t_end)):
+        rs = [r for r in records if lo <= r["t_submit"] < hi]
+        done = [r for r in rs if r["status"] == "done"]
+        lats = [r["latency_s"] for r in done]
+        span = max(1e-9, hi - lo)
+        out.append({
+            "submitted": len(rs),
+            "completed": len(done),
+            "rejected_429": sum(1 for r in rs
+                                if r["status"] == "rejected"),
+            "req_s": round(len(done) / span, 2),
+            "p50_s": (round(_percentile(lats, 0.50), 4)
+                      if lats else None),
+            "p99_s": (round(_percentile(lats, 0.99), 4)
+                      if lats else None),
+        })
+    return out
+
+
+_POLL_MAX_S = 0.25
+
+
+def _await_ids(url: str, ids: List[str], poll_timeout: float) -> None:
+    end = time.monotonic() + poll_timeout
+    pending = set(ids)
+    poll = 0.02
+    while pending and time.monotonic() < end:
+        for rid in list(pending):
+            code, st = _get(url, f"/check/{rid}")
+            if code == 200 and st.get("status") in (
+                    "done", "timeout", "cancelled"):
+                pending.discard(rid)
+        time.sleep(poll)
+        poll = min(_POLL_MAX_S, poll * 1.5)
+
+
+def warmup(url: str, pool: List[Dict], *, burst: int = 8,
+           poll_timeout: float = 300.0) -> Dict[str, Any]:
+    """Pay the cold-start once, before measurement. Two phases:
+
+    1. one history per distinct size, sequentially — compiles the
+       singleton-lane geometries and seeds the memo tables;
+    2. concurrent bursts of ``burst`` same-size submissions — forms
+       coalesced dispatch groups so the power-of-two group-width
+       kernel geometries (the daemon pads widths to those) compile
+       now, not inside the measured windows.
+
+    After this the measured run reports steady-state serving — the
+    regime a long-lived daemon actually lives in. (Skippable with
+    --no-warmup to measure the cold wall itself.)"""
+    t0 = time.monotonic()
+    n = 0
+    seen = set()
+    for payload in pool:
+        if payload["ops"] in seen:
+            continue
+        seen.add(payload["ops"])
+        code, resp = _post(url, payload["body"])
+        if code == 202:
+            _await_ids(url, [resp["id"]], poll_timeout)
+            n += 1
+    by_size: Dict[int, List[Dict]] = {}
+    for p in pool:
+        by_size.setdefault(p["ops"], []).append(p)
+    for size_pool in by_size.values():
+        ids = []
+        for i in range(burst):
+            code, resp = _post(url, size_pool[i % len(size_pool)]
+                               ["body"])
+            if code == 202:
+                ids.append(resp["id"])
+        _await_ids(url, ids, poll_timeout)
+        n += len(ids)
+    return {"requests": n, "wall_s": round(time.monotonic() - t0, 3)}
+
+
+def run_load(url: str, *, rate: float, duration: float,
+             pool: List[Dict], poll_s: float = 0.01,
+             poll_timeout: float = 120.0) -> Dict[str, Any]:
+    """Drive the open-loop schedule; returns the report dict."""
+    records: List[Dict] = []
+    rec_lock = threading.Lock()
+    threads: List[threading.Thread] = []
+
+    def one(payload: Dict, t_sched: float) -> None:
+        rec = {"tenant": payload["tenant"], "ops": payload["ops"],
+               "expect": payload["expect"], "t_submit": t_sched,
+               "status": "lost", "latency_s": None, "match": None}
+        t0 = time.monotonic()
+        code, resp = _post(url, payload["body"])
+        if code == 429:
+            rec["status"] = "rejected"
+        elif code == -1:
+            rec["status"] = "error-net"
+        elif code != 202:
+            rec["status"] = f"error-{code}"
+        else:
+            rid = resp["id"]
+            end = time.monotonic() + poll_timeout
+            # exponential backoff to _POLL_MAX_S: hundreds of
+            # in-flight pollers at a fixed 10 ms would out-traffic
+            # the load they measure
+            poll = poll_s
+            while time.monotonic() < end:
+                code, st = _get(url, f"/check/{rid}")
+                if code == 200 and st.get("status") in (
+                        "done", "timeout", "cancelled"):
+                    rec["status"] = st["status"]
+                    rec["latency_s"] = time.monotonic() - t0
+                    valid = (st.get("result") or {}).get("valid")
+                    rec["match"] = (valid == payload["expect"]
+                                    if st["status"] == "done"
+                                    else None)
+                    break
+                time.sleep(poll)
+                poll = min(_POLL_MAX_S, poll * 1.5)
+        with rec_lock:
+            records.append(rec)
+
+    t_start = time.monotonic()
+    t_end = t_start + duration
+    i = 0
+    while True:
+        t_sched = t_start + i / rate
+        if t_sched >= t_end:
+            break
+        now = time.monotonic()
+        if t_sched > now:
+            time.sleep(t_sched - now)
+        payload = pool[i % len(pool)]
+        th = threading.Thread(target=one, args=(payload, t_sched),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+        i += 1
+    for th in threads:
+        th.join(poll_timeout + 30)
+    t_mid = t_start + duration / 2.0
+    done = [r for r in records if r["status"] == "done"]
+    mismatches = [r for r in records if r["match"] is False]
+    wall = max(1e-9, time.monotonic() - t_start)
+    report: Dict[str, Any] = {
+        "target_rate": rate, "duration_s": duration,
+        "submitted": len(records),
+        "completed": len(done),
+        "rejected_429": sum(1 for r in records
+                            if r["status"] == "rejected"),
+        "timeouts": sum(1 for r in records
+                        if r["status"] == "timeout"),
+        "verdict_mismatches": len(mismatches),
+        "sustained_req_s": round(len(done) / wall, 2),
+        "p50_s": _percentile([r["latency_s"] for r in done], 0.50),
+        "p99_s": _percentile([r["latency_s"] for r in done], 0.99),
+        "windows": _window_report(records, t_start, t_mid,
+                                  time.monotonic()),
+    }
+    code, stats = _get(url, "/stats")
+    if code == 200:
+        report["stats"] = stats
+        counters = stats.get("counters", {})
+        report["fallbacks"] = {
+            k: v for k, v in counters.items()
+            if k.startswith(("engine.fallback.",
+                             "checker.swallowed."))}
+    return report
+
+
+def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
+    """Programmatic entry (bench.py's ``serve`` sub-object): ``opts``
+    mirrors the CLI flags. Self-hosts a daemon when no url given."""
+    quick = bool(opts.get("quick"))
+    rate = float(opts.get("rate") or (8.0 if quick else 20.0))
+    duration = float(opts.get("duration") or (4.0 if quick else 20.0))
+    tenants = int(opts.get("tenants") or 4)
+    sizes = opts.get("sizes") or ([16, 32, 48] if quick
+                                  else [32, 96, 200, 400])
+    pool = build_pool(sizes=sizes, tenants=tenants,
+                      violation_frac=float(
+                          opts.get("violation_frac", 0.25)),
+                      model=opts.get("model", "cas-register"),
+                      seed=int(opts.get("seed", 7)))
+    url = opts.get("url")
+    daemon = None
+    if not url:
+        from jepsen_tpu import serve
+        daemon = serve.Daemon(port=int(opts.get("port") or 0),
+                              host="127.0.0.1",
+                              group=int(opts.get("group")
+                                        or (8 if quick else 32)),
+                              store_root=opts.get("store_root"),
+                              persist=bool(opts.get("store_root"))
+                              ).start()
+        url = f"http://127.0.0.1:{daemon.port}"
+    report: Dict[str, Any] = {}
+    try:
+        if not wait_ready(url, timeout=float(
+                opts.get("ready_timeout", 60.0))):
+            report["error"] = f"daemon at {url} never became ready"
+            return report
+        if opts.get("warmup", True):
+            report["warmup"] = warmup(
+                url, pool, burst=int(opts.get("warm_burst")
+                                     or (8 if quick else 16)))
+        report.update(run_load(url, rate=rate, duration=duration,
+                               pool=pool))
+        report["url"] = url
+        return report
+    finally:
+        if daemon is not None:
+            report["drained"] = daemon.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="open-loop load generator for the jepsen-tpu "
+                    "check daemon")
+    ap.add_argument("--url", default=None,
+                    help="daemon base url; omitted = --self-host")
+    ap.add_argument("--self-host", action="store_true",
+                    help="start an in-process daemon on an ephemeral "
+                         "port")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="target arrival rate, req/s")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="run length, seconds (two measurement "
+                         "windows of half each)")
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--model", default="cas-register")
+    ap.add_argument("--violation-frac", type=float, default=0.25)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--store-root", default=None,
+                    help="self-hosted daemon persistence root")
+    ap.add_argument("--quick", action="store_true",
+                    help="small CI run: low rate, short duration, "
+                         "tiny histories")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the cold-start warmup phase (measure "
+                         "the compile wall inside the windows)")
+    args = ap.parse_args(argv)
+    if args.self_host and args.url:
+        ap.error("--self-host and --url are mutually exclusive")
+    report = run_loadgen({
+        "url": args.url, "rate": args.rate,
+        "duration": args.duration, "tenants": args.tenants,
+        "model": args.model, "violation_frac": args.violation_frac,
+        "seed": args.seed, "store_root": args.store_root,
+        "quick": args.quick, "warmup": not args.no_warmup,
+    })
+    print(json.dumps(report, default=str))
+    if report.get("error"):
+        return 2
+    ok = (report.get("completed", 0) > 0
+          and report.get("verdict_mismatches", 0) == 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
