@@ -386,13 +386,20 @@ def serve_probe(quick: bool = True) -> dict:
     keep = ("warmup", "target_rate", "duration_s", "submitted",
             "completed", "rejected_429", "timeouts",
             "verdict_mismatches", "sustained_req_s", "p50_s",
-            "p99_s", "windows", "fallbacks", "drained", "error")
+            "p99_s", "windows", "stage_split", "latency_crosscheck",
+            "fallbacks", "drained", "error")
     out = {k: report[k] for k in keep if k in report}
     stats = report.get("stats", {})
     out["counters"] = {k: v
                        for k, v in stats.get("counters", {}).items()
                        if k.startswith("serve.")}
     out["dispatch"] = stats.get("dispatch", {})
+    # the daemon's histogram-derived tails + padding waste: the
+    # serving-quality numbers BENCH_r*.json tracks across PRs
+    out["histograms"] = stats.get("histograms", {})
+    out["pad_waste_s"] = stats.get("counters", {}).get(
+        "serve.pad_waste_s")
+    out["device_s"] = stats.get("counters", {}).get("serve.device_s")
     return out
 
 

@@ -163,8 +163,8 @@ def _cmd_check_serve(args) -> int:
 
     signal.signal(signal.SIGTERM, _term)
     print(f"jepsen-tpu check daemon: http://localhost:{daemon.port}/ "
-          f"(POST /check, GET /check/<id>, GET /stats; "
-          f"store root {args.store_root})")
+          f"(POST /check, GET /check/<id>, GET /stats, GET /metrics, "
+          f"POST /profile; store root {args.store_root})")
     daemon.serve_forever()
     print(json.dumps({"shutdown": "clean", **daemon.stats()},
                      default=str))

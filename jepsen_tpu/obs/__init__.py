@@ -26,18 +26,24 @@ Set ``JEPSEN_TPU_NO_OBS=1`` to disable all recording. See
 ``docs/OBSERVABILITY.md`` for the full API, the counter taxonomy, and
 the trace-viewer workflow.
 """
-from jepsen_tpu.obs.core import (Capture, Recorder, capture,
-                                 checker_swallowed, count, counters,
-                                 decision, enabled, engine_fallback,
-                                 engine_selected, gauge, gauges, reset,
-                                 span)
+from jepsen_tpu.obs.core import (HIST_EDGES, Capture, Recorder,
+                                 capture, checker_swallowed, count,
+                                 counters, decision, enabled,
+                                 engine_fallback, engine_selected,
+                                 gauge, gauges, hist_delta, hist_merge,
+                                 hist_quantile, hist_summary,
+                                 histogram, histograms,
+                                 quantile_from_cumulative, reset, span)
 from jepsen_tpu.obs.trace import (export_jsonl, export_trace, load_any,
+                                  parse_prometheus, prometheus_text,
                                   snapshot, trace_events)
 
 __all__ = [
-    "Capture", "Recorder", "capture", "checker_swallowed", "count",
-    "counters", "decision", "enabled", "engine_fallback",
-    "engine_selected", "gauge", "gauges", "reset", "span",
-    "export_jsonl", "export_trace", "load_any", "snapshot",
-    "trace_events",
+    "HIST_EDGES", "Capture", "Recorder", "capture",
+    "checker_swallowed", "count", "counters", "decision", "enabled",
+    "engine_fallback", "engine_selected", "gauge", "gauges",
+    "hist_delta", "hist_merge", "hist_quantile", "hist_summary",
+    "histogram", "histograms", "quantile_from_cumulative", "reset",
+    "span", "export_jsonl", "export_trace", "load_any",
+    "parse_prometheus", "prometheus_text", "snapshot", "trace_events",
 ]

@@ -29,7 +29,9 @@ Design constraints (ISSUE 2):
 """
 from __future__ import annotations
 
+import bisect
 import contextvars
+import math
 import os
 import threading
 import time
@@ -44,6 +46,16 @@ _T0 = time.perf_counter()
 _MAX_SPANS = 100_000
 _MAX_LEDGER = 10_000
 
+# Fixed log-spaced histogram buckets shared by EVERY histogram: ten
+# buckets per decade (ratio 10^0.1 ~ 1.26) from 1 µs to 1000 s. One
+# fixed layout means snapshots merge/difference bucket-by-bucket
+# (loadgen's /metrics-delta quantile cross-check depends on that) and
+# the quantile interpolation error stays well under the 15% the
+# cross-check allows. Values past the last edge land in a +Inf
+# overflow bucket; the recorded sum keeps the mean exact regardless.
+HIST_EDGES: Tuple[float, ...] = tuple(
+    round(10.0 ** (k / 10.0), 12) for k in range(-60, 31))
+
 
 def _now_us() -> float:
     return (time.perf_counter() - _T0) * 1e6
@@ -54,7 +66,8 @@ class Recorder:
     process-global instance backs :func:`jepsen_tpu.obs.trace.export_*`;
     additional instances are created per :func:`capture`."""
 
-    __slots__ = ("_lock", "spans", "counters", "gauges", "ledger")
+    __slots__ = ("_lock", "spans", "counters", "gauges", "ledger",
+                 "hists")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -62,6 +75,7 @@ class Recorder:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, Any] = {}
         self.ledger: List[Dict[str, Any]] = []
+        self.hists: Dict[str, Dict[str, Any]] = {}
 
     # -- mutation (all lock-guarded) ------------------------------------
     def add_span(self, ev: Dict[str, Any]) -> None:
@@ -80,6 +94,20 @@ class Recorder:
         with self._lock:
             self.gauges[name] = value
 
+    def observe(self, name: str, value: float) -> None:
+        """One histogram observation. ``le`` semantics (Prometheus):
+        bucket ``i`` counts values ``<= HIST_EDGES[i]``; the trailing
+        slot is the +Inf overflow bucket."""
+        with self._lock:
+            h = self.hists.get(name)
+            if h is None:
+                h = self.hists[name] = {
+                    "count": 0, "sum": 0.0,
+                    "counts": [0] * (len(HIST_EDGES) + 1)}
+            h["counts"][bisect.bisect_left(HIST_EDGES, value)] += 1
+            h["count"] += 1
+            h["sum"] += value
+
     def decide(self, rec: Dict[str, Any]) -> None:
         with self._lock:
             if len(self.ledger) >= _MAX_LEDGER:
@@ -90,11 +118,15 @@ class Recorder:
 
     # -- read side ------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
-        """Consistent copy of counters, gauges, and the ledger (spans are
-        exported separately — they can be large)."""
+        """Consistent copy of counters, gauges, histograms, and the
+        ledger (spans are exported separately — they can be large)."""
         with self._lock:
             return {"counters": dict(self.counters),
                     "gauges": dict(self.gauges),
+                    "histograms": {k: {"count": h["count"],
+                                       "sum": h["sum"],
+                                       "counts": list(h["counts"])}
+                                   for k, h in self.hists.items()},
                     "ledger": [dict(r) for r in self.ledger]}
 
     def span_events(self) -> List[Dict[str, Any]]:
@@ -107,6 +139,7 @@ class Recorder:
             self.counters.clear()
             self.gauges.clear()
             self.ledger.clear()
+            self.hists.clear()
 
 
 GLOBAL = Recorder()
@@ -221,6 +254,128 @@ def gauges() -> Dict[str, Any]:
     return GLOBAL.snapshot()["gauges"]
 
 
+# -- histograms ----------------------------------------------------------
+
+def histogram(name: str, value: float) -> None:
+    """Observe ``value`` into the fixed log-spaced histogram ``name``
+    (process-wide and any captures). The serving layer feeds these
+    with per-request queue-wait / service-time / end-to-end latency
+    and per-dispatch-group kernel wall; ``GET /metrics`` exposes them
+    as Prometheus ``_bucket``/``_sum``/``_count`` series."""
+    if not _ENABLED:
+        return
+    for s in _sinks():
+        s.observe(name, value)
+
+
+def histograms() -> Dict[str, Dict[str, Any]]:
+    """Snapshot of the process-global histograms:
+    ``{name: {"count", "sum", "counts"}}`` with ``counts`` the raw
+    per-bucket tallies aligned to :data:`HIST_EDGES` plus one +Inf
+    overflow slot."""
+    return GLOBAL.snapshot()["histograms"]
+
+
+def hist_merge(a: Optional[Dict[str, Any]],
+               b: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Bucket-wise sum of two histogram snapshots (same fixed bucket
+    layout, so merging is elementwise)."""
+    if a is None or b is None:
+        src = a or b or {"count": 0, "sum": 0.0,
+                         "counts": [0] * (len(HIST_EDGES) + 1)}
+        return {"count": src["count"], "sum": src["sum"],
+                "counts": list(src["counts"])}
+    return {"count": a["count"] + b["count"],
+            "sum": a["sum"] + b["sum"],
+            "counts": [x + y for x, y in zip(a["counts"],
+                                             b["counts"])]}
+
+
+def hist_delta(after: Optional[Dict[str, Any]],
+               before: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """``after - before`` bucket-wise: the distribution of the
+    observations that happened BETWEEN two snapshots of a cumulative
+    histogram (both loadgen's /metrics cross-check and the daemon's
+    time-series ring difference snapshots this way). Negative cells
+    (a reset between snapshots) clamp to zero."""
+    if after is None:
+        return {"count": 0, "sum": 0.0,
+                "counts": [0] * (len(HIST_EDGES) + 1)}
+    if before is None:
+        return {"count": after["count"], "sum": after["sum"],
+                "counts": list(after["counts"])}
+    counts = [max(0, x - y) for x, y in zip(after["counts"],
+                                            before["counts"])]
+    return {"count": sum(counts),
+            "sum": max(0.0, after["sum"] - before["sum"]),
+            "counts": counts}
+
+
+def hist_quantile(h: Optional[Dict[str, Any]],
+                  q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile (0..1) of a histogram snapshot by
+    linear interpolation within the bucket holding the target rank.
+    None for an empty histogram. The overflow bucket reports the last
+    edge (a floor — the true value is larger)."""
+    if not h or not h.get("count"):
+        return None
+    counts = h["counts"]
+    target = q * h["count"]
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        acc += c
+        if acc >= target:
+            if i >= len(HIST_EDGES):            # +Inf overflow
+                return HIST_EDGES[-1]
+            lo = HIST_EDGES[i - 1] if i > 0 else 0.0
+            hi = HIST_EDGES[i]
+            frac = (target - (acc - c)) / c
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+    return HIST_EDGES[-1]
+
+
+def quantile_from_cumulative(pairs: List[Tuple[float, float]],
+                             q: float) -> Optional[float]:
+    """Quantile from Prometheus-style CUMULATIVE buckets:
+    ``pairs = [(le, cumulative_count), ...]`` (any order; +Inf
+    allowed). This is the parse-side twin of :func:`hist_quantile` —
+    loadgen feeds it the bucket DELTAS of two /metrics scrapes."""
+    pairs = sorted((float(le), float(v)) for le, v in pairs)
+    if not pairs:
+        return None
+    total = pairs[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in pairs:
+        if cum >= target:
+            if math.isinf(le):
+                return prev_le if prev_le > 0 else None
+            width = cum - prev_cum
+            frac = ((target - prev_cum) / width) if width > 0 else 1.0
+            lo = prev_le if not math.isinf(prev_le) else 0.0
+            return lo + (le - lo) * min(1.0, max(0.0, frac))
+        prev_le, prev_cum = le, cum
+    return pairs[-1][0] if not math.isinf(pairs[-1][0]) else None
+
+
+def hist_summary(h: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Headline digest of one histogram snapshot — the shape
+    ``bench.py --serve`` and the ``/engine`` dashboard embed."""
+    if not h or not h.get("count"):
+        return {"count": 0}
+    n = h["count"]
+    out = {"count": int(n), "sum": round(h["sum"], 6),
+           "mean": round(h["sum"] / n, 6)}
+    for label, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+        v = hist_quantile(h, q)
+        out[label] = round(v, 6) if v is not None else None
+    return out
+
+
 # -- engine-decision ledger ---------------------------------------------
 
 def decision(stage: str, event: str, cause: Optional[str] = None,
@@ -289,6 +444,13 @@ class Capture:
     def gauges(self) -> Dict[str, Any]:
         with self._rec._lock:
             return dict(self._rec.gauges)
+
+    @property
+    def histograms(self) -> Dict[str, Dict[str, Any]]:
+        with self._rec._lock:
+            return {k: {"count": h["count"], "sum": h["sum"],
+                        "counts": list(h["counts"])}
+                    for k, h in self._rec.hists.items()}
 
     @property
     def ledger(self) -> List[Dict[str, Any]]:

@@ -11,9 +11,10 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional
+import re
+from typing import Any, Dict, List, Optional, Tuple
 
-from jepsen_tpu.obs.core import GLOBAL, Capture, Recorder
+from jepsen_tpu.obs.core import GLOBAL, HIST_EDGES, Capture, Recorder
 
 
 def _recorder_of(source: Optional[Any]) -> Recorder:
@@ -44,7 +45,8 @@ def export_trace(path: str, source: Optional[Any] = None) -> str:
 
 def export_jsonl(path: str, source: Optional[Any] = None) -> str:
     """Write ``obs.jsonl``: one JSON object per line, each tagged with a
-    ``"type"`` of ``span`` / ``counter`` / ``gauge`` / ``decision``."""
+    ``"type"`` of ``span`` / ``counter`` / ``gauge`` / ``histogram`` /
+    ``decision``."""
     rec = _recorder_of(source)
     snap = rec.snapshot()
     with open(path, "w") as f:
@@ -54,6 +56,9 @@ def export_jsonl(path: str, source: Optional[Any] = None) -> str:
         for name, value in sorted(snap["gauges"].items()):
             f.write(json.dumps({"type": "gauge", "name": name,
                                 "value": value}, default=str) + "\n")
+        for name, h in sorted(snap.get("histograms", {}).items()):
+            f.write(json.dumps({"type": "histogram", "name": name,
+                                **h}, default=str) + "\n")
         for r in snap["ledger"]:
             f.write(json.dumps({"type": "decision", **r},
                                default=str) + "\n")
@@ -79,7 +84,8 @@ def load_any(path: str) -> Dict[str, List[Dict[str, Any]]]:
     "gauges": [...]}`` — the shared parser behind
     ``tools/trace_view.py``."""
     out: Dict[str, List[Dict[str, Any]]] = {
-        "spans": [], "decisions": [], "counters": [], "gauges": []}
+        "spans": [], "decisions": [], "counters": [], "gauges": [],
+        "histograms": []}
     with open(path) as f:
         head = f.read(1)
         f.seek(0)
@@ -107,4 +113,118 @@ def load_any(path: str) -> Dict[str, List[Dict[str, Any]]]:
                 out["counters"].append(rec)
             elif kind == "gauge":
                 out["gauges"].append(rec)
+            elif kind == "histogram":
+                out["histograms"].append(rec)
+    return out
+
+
+# -- Prometheus text exposition ------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$")
+_PROM_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def _prom_name(name: str) -> str:
+    s = _PROM_BAD.sub("_", str(name))
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return "jepsen_" + s
+
+
+def _prom_val(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(source: Optional[Any] = None) -> str:
+    """Prometheus text exposition (format 0.0.4) of every counter,
+    numeric gauge, and histogram in the recorder — the body of the
+    daemon's ``GET /metrics``. Histograms emit the full fixed bucket
+    ladder every scrape (plus ``+Inf``/``_sum``/``_count``), so two
+    scrapes always difference bucket-by-bucket.
+
+    Two classes of name are withheld: per-tenant counters
+    (``serve.tenant.<t>.*`` — tenant names are client-controlled, so
+    they are both unbounded cardinality and sanitization-collision
+    bait; ``GET /stats`` carries the per-tenant view), and any name
+    whose sanitized form collides with an already-emitted one (a
+    duplicate series makes strict scrapers reject the WHOLE
+    exposition; dropped names are counted in
+    ``jepsen_obs_prom_collisions`` so the gap is visible)."""
+    rec = _recorder_of(source)
+    snap = rec.snapshot()
+    lines: List[str] = []
+    emitted: Dict[str, str] = {}
+    collisions = 0
+
+    def _uniq(raw: str) -> Optional[str]:
+        nonlocal collisions
+        s = _prom_name(raw)
+        prev = emitted.get(s)
+        if prev is None:
+            emitted[s] = raw
+            return s
+        if prev == raw:
+            return s
+        collisions += 1
+        return None
+
+    for name, value in sorted(snap["counters"].items()):
+        if name.startswith("serve.tenant."):
+            continue
+        n = _uniq(name)
+        if n is None:
+            continue
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_prom_val(value)}")
+    for name, value in sorted(snap["gauges"].items()):
+        if isinstance(value, bool) or not isinstance(value,
+                                                     (int, float)):
+            continue                    # modes/dicts stay JSON-side
+        n = _uniq(name)
+        if n is None:
+            continue
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_prom_val(value)}")
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        n = _uniq(name)
+        if n is None:
+            continue
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for edge, c in zip(HIST_EDGES, h["counts"]):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{edge:g}"}} {cum}')
+        cum += h["counts"][len(HIST_EDGES)]
+        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{n}_sum {_prom_val(h['sum'])}")
+        lines.append(f"{n}_count {h['count']}")
+    if collisions:
+        lines.append("# TYPE jepsen_obs_prom_collisions gauge")
+        lines.append(f"jepsen_obs_prom_collisions {collisions}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str
+                     ) -> Dict[str, List[Tuple[Dict[str, str],
+                                               float]]]:
+    """Parse a text exposition back into
+    ``{metric_name: [(labels, value), ...]}``. Raises ValueError on a
+    malformed sample line — the exposition-format test and loadgen's
+    cross-check both parse with this."""
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None:
+            raise ValueError(f"malformed exposition line: {line!r}")
+        labels = dict(_PROM_LABEL.findall(m.group(2) or ""))
+        out.setdefault(m.group(1), []).append(
+            (labels, float(m.group(3))))
     return out

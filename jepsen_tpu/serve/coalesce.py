@@ -176,7 +176,10 @@ class AdmissionQueue:
                     for r in batch:
                         self._inflight[r.tenant] = \
                             self._inflight.get(r.tenant, 0) + 1
-                        r.t_dispatch = now
+                        # coalesce stamp: selected into a dispatch
+                        # group (the engine stamps t_dispatch when
+                        # the device call actually starts)
+                        r.t_coalesce = now
                         r.status = rq.DISPATCHED
                     obs.gauge("serve.queue_depth", len(self._queued))
                     if len(batch) > 1:

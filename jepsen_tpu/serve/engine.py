@@ -35,6 +35,7 @@ import logging
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 from jepsen_tpu import obs
@@ -42,6 +43,70 @@ from jepsen_tpu.serve import request as rq
 from jepsen_tpu.serve.coalesce import AdmissionQueue
 
 log = logging.getLogger("jepsen.serve")
+
+
+def _profiler_start(path: str) -> None:
+    """Module-level indirection so tests can stub the profiler."""
+    import jax
+    jax.profiler.start_trace(path)
+
+
+def _profiler_stop() -> None:
+    import jax
+    jax.profiler.stop_trace()
+
+
+class _TimeSeriesRing:
+    """Rolling in-memory time series of serving health: one point per
+    completed dispatch — req/s since the previous point, p50/p99 over
+    the e2e-latency histogram delta, queue depth, and in-flight lanes.
+    Bounded (default 256 points ~ the last few minutes under load);
+    serialized into ``stats.json`` so the ``/engine`` dashboard can
+    sparkline a daemon it does not share a process with."""
+
+    def __init__(self, cap: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._points: "deque[Dict[str, Any]]" = deque(maxlen=cap)
+        self._prev_ts: Optional[float] = None
+        self._prev_done: float = 0.0
+        self._prev_hist: Optional[Dict[str, Any]] = None
+
+    def sample(self, queue: AdmissionQueue,
+               snap: Optional[Dict[str, Any]] = None) -> None:
+        # `snap` shares one Recorder.snapshot() per dispatch between
+        # the ring and the stats file — snapshot deep-copies the
+        # (up-to-10k-record) ledger under the global obs lock, so
+        # taking it once per loop iteration matters
+        if snap is None:
+            snap = obs.core.GLOBAL.snapshot()
+        now = time.monotonic()
+        done = snap["counters"].get("serve.completed", 0.0)
+        hist = snap["histograms"].get("serve.e2e_s")
+        depth = queue.depth()
+        lanes = sum(queue.inflight().values())
+        with self._lock:
+            dt = (now - self._prev_ts) if self._prev_ts is not None \
+                else None
+            delta = obs.hist_delta(hist, self._prev_hist)
+            p50 = obs.hist_quantile(delta, 0.50)
+            p99 = obs.hist_quantile(delta, 0.99)
+            point = {
+                "ts": round(time.time(), 3),
+                "req_s": (round((done - self._prev_done) / dt, 3)
+                          if dt and dt > 0 else None),
+                "p50_s": round(p50, 6) if p50 is not None else None,
+                "p99_s": round(p99, 6) if p99 is not None else None,
+                "depth": depth,
+                "inflight": lanes,
+            }
+            self._points.append(point)
+            self._prev_ts = now
+            self._prev_done = done
+            self._prev_hist = hist
+
+    def points(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(p) for p in self._points]
 
 
 class Dispatcher:
@@ -62,6 +127,14 @@ class Dispatcher:
         self._thread: Optional[threading.Thread] = None
         self.dispatch_counts: Dict[str, int] = {}
         self._counts_lock = threading.Lock()
+        self.ring = _TimeSeriesRing()
+        # on-demand profiling (POST /profile): arm -> the next N
+        # dispatches run under jax.profiler.trace, capture persisted
+        # under the store root
+        self._profile_lock = threading.Lock()
+        self._profile_left = 0
+        self._profile_dir: Optional[str] = None
+        self._profile_active = False
         queue.on_timeout = self._finish_timeout_queued
 
     # -- lifecycle -------------------------------------------------------
@@ -80,6 +153,10 @@ class Dispatcher:
         t = self._thread
         if t is not None:
             t.join(timeout)
+        # flush a still-open profiler capture: an armed profile that
+        # never saw enough dispatches must not leave the trace
+        # recording (and its promised capture dir empty) forever
+        self._profile_force_stop()
 
     def drain(self, timeout: float = 60.0) -> bool:
         """Wait until no request is queued or walking. Judged from
@@ -104,12 +181,85 @@ class Dispatcher:
             batch = self.queue.next_batch(timeout=0.1)
             if not batch:
                 continue
+            self._profile_maybe_start()
             try:
                 self._dispatch(batch)
             finally:
                 self.queue.mark_done(batch)
                 obs.gauge("serve.inflight", 0)
-                self._write_stats_file()
+                self._profile_maybe_stop()
+                snap = obs.core.GLOBAL.snapshot()
+                self.ring.sample(self.queue, snap)
+                self._write_stats_file(snap)
+
+    # -- on-demand profiling ---------------------------------------------
+    def arm_profile(self, dispatches: int) -> str:
+        """Arm ``jax.profiler.trace`` around the next N dispatches;
+        the capture persists under ``<store-root>/serve/profile-<ts>/``.
+        Raises RuntimeError when already armed or without a store
+        root (the capture needs somewhere durable to land)."""
+        if self.store_root is None:
+            raise RuntimeError("profiling needs a store root")
+        from jepsen_tpu import store
+        with self._profile_lock:
+            if self._profile_left > 0 or self._profile_active:
+                raise RuntimeError(
+                    f"profile already armed "
+                    f"({self._profile_left} dispatches left)")
+            d = store.serve_profile_dir(self.store_root)
+            self._profile_dir = d
+            self._profile_left = int(dispatches)
+            return d
+
+    def profile_state(self) -> Dict[str, Any]:
+        with self._profile_lock:
+            return {"armed": int(self._profile_left),
+                    "active": bool(self._profile_active),
+                    "dir": self._profile_dir}
+
+    def _profile_maybe_start(self) -> None:
+        with self._profile_lock:
+            if self._profile_left <= 0 or self._profile_active:
+                return
+            path = self._profile_dir
+            try:
+                _profiler_start(path)
+                self._profile_active = True
+                obs.decision("serve-profile", "route",
+                             cause="start", dir=path,
+                             dispatches=self._profile_left)
+            except Exception as e:                      # noqa: BLE001
+                log.warning("profiler start failed: %s", e)
+                obs.engine_fallback("serve-profile",
+                                    type(e).__name__)
+                self._profile_left = 0
+
+    def _profile_maybe_stop(self) -> None:
+        with self._profile_lock:
+            if not self._profile_active:
+                return
+            self._profile_left -= 1
+            if self._profile_left > 0:
+                return
+            self._profile_stop_locked()
+
+    def _profile_force_stop(self) -> None:
+        """Stop and flush an active capture regardless of how many
+        armed dispatches remain (daemon shutdown)."""
+        with self._profile_lock:
+            if self._profile_active:
+                self._profile_stop_locked()
+            self._profile_left = 0
+
+    def _profile_stop_locked(self) -> None:
+        try:
+            _profiler_stop()
+            obs.count("serve.profile.captures")
+        except Exception as e:                          # noqa: BLE001
+            log.warning("profiler stop failed: %s", e)
+            obs.engine_fallback("serve-profile", type(e).__name__)
+        self._profile_active = False
+        self._profile_left = 0
 
     def _dispatch(self, batch: List["rq.CheckRequest"]) -> None:
         req0 = batch[0]
@@ -120,7 +270,13 @@ class Dispatcher:
                 self.dispatch_counts.get(sig, 0) + 1
         obs.count("serve.dispatched", len(batch))
         obs.gauge("serve.inflight", len(batch))
+        t0 = time.monotonic()
         for r in batch:
+            # dispatch stamp + queue-wait histogram: admit -> selected
+            # into this group (t_coalesce, stamped by next_batch)
+            r.t_dispatch = t0
+            obs.histogram("serve.queue_wait_s",
+                          max(0.0, (r.t_coalesce or t0) - r.t_submit))
             self.registry.ledger_record(
                 r.tenant, "dispatched", id=r.id, group=len(batch),
                 ops=int(r.packed.n))
@@ -142,53 +298,101 @@ class Dispatcher:
         kw = dict(self.engine_kw)
         kw.update(req0.opts)
         kw["should_abort"] = _aborted
-        t0 = time.monotonic()
-        try:
-            from jepsen_tpu.checkers import facade
-            with obs.span("serve.dispatch", model=req0.model_name,
-                          lanes=len(batch)):
-                if len(batch) == 1:
-                    results = [facade.auto_check_packed(
-                        model, req0.packed, kw)]
-                else:
-                    # quantize the lane count to a power of two by
-                    # replicating the LONGEST member (its verdict is
-                    # recomputed and discarded; padding with the
-                    # longest keeps the group's padded step count
-                    # unchanged): a serving daemon sees every group
-                    # width 1..group over its life, and each distinct
-                    # H is a distinct compiled kernel geometry — the
-                    # pad bounds that churn to log2(group) geometries
-                    # a warmup can prime. JEPSEN_TPU_SERVE_NO_PAD=1
-                    # dispatches raw widths.
-                    packed_list = [r.packed for r in batch]
-                    n_real = len(packed_list)
-                    if not os.environ.get("JEPSEN_TPU_SERVE_NO_PAD"):
-                        Hq = 1 << (n_real - 1).bit_length()
-                        # never pad past the configured group width:
-                        # the engine-side re-plan splits oversized
-                        # groups, which would both defeat the pad and
-                        # break the admission/engine plan agreement
-                        cap = int(self.engine_kw.get("group") or 0) \
-                            or 32
-                        Hq = min(Hq, max(cap, n_real))
-                        longest = max(packed_list, key=lambda p: p.n)
-                        pad = Hq - n_real
-                        if pad > 0:
-                            packed_list = packed_list + [longest] * pad
-                            obs.count("serve.pad_lanes", pad)
-                    results = facade.auto_check_many_packed(
-                        model, packed_list, kw)[:n_real]
-        except Exception as e:                          # noqa: BLE001
-            log.warning("serve dispatch crashed: %r", e, exc_info=e)
-            obs.engine_fallback("serve-dispatch", type(e).__name__,
-                                lanes=len(batch))
-            err = {"valid": "unknown",
-                   "error": f"{type(e).__name__}: {e}"}
-            results = [dict(err) for _ in batch]
-        elapsed = time.monotonic() - t0
+        # quantize the lane count to a power of two by replicating the
+        # LONGEST member (its verdict is recomputed and discarded;
+        # padding with the longest keeps the group's padded step count
+        # unchanged): a serving daemon sees every group width 1..group
+        # over its life, and each distinct H is a distinct compiled
+        # kernel geometry — the pad bounds that churn to log2(group)
+        # geometries a warmup can prime. JEPSEN_TPU_SERVE_NO_PAD=1
+        # dispatches raw widths.
+        n_real = len(batch)
+        packed_list = [r.packed for r in batch]
+        pad = 0
+        if n_real > 1 and not os.environ.get("JEPSEN_TPU_SERVE_NO_PAD"):
+            Hq = 1 << (n_real - 1).bit_length()
+            # never pad past the configured group width: the
+            # engine-side re-plan splits oversized groups, which would
+            # both defeat the pad and break the admission/engine plan
+            # agreement
+            cap = int(self.engine_kw.get("group") or 0) or 32
+            Hq = min(Hq, max(cap, n_real))
+            longest = max(packed_list, key=lambda p: p.n)
+            pad = max(0, Hq - n_real)
+            if pad > 0:
+                packed_list = packed_list + [longest] * pad
+                obs.count("serve.pad_lanes", pad)
+        # the dispatcher thread's own obs records (fallbacks, engine
+        # selections from the facade chain, the serve-dispatch crash
+        # containment) are captured here and re-emitted into every
+        # member request's stitched trace below — ledgers are
+        # thread-isolated, so without this a client-side
+        # obs.capture() around submit/poll would never see them
+        with obs.capture() as cap:
+            try:
+                from jepsen_tpu.checkers import facade
+                with obs.span("serve.dispatch",
+                              model=req0.model_name,
+                              lanes=len(batch)):
+                    if len(batch) == 1:
+                        results = [facade.auto_check_packed(
+                            model, req0.packed, kw)]
+                    else:
+                        results = facade.auto_check_many_packed(
+                            model, packed_list, kw)[:n_real]
+            except Exception as e:                      # noqa: BLE001
+                log.warning("serve dispatch crashed: %r", e,
+                            exc_info=e)
+                obs.engine_fallback("serve-dispatch",
+                                    type(e).__name__,
+                                    lanes=len(batch))
+                err = {"valid": "unknown",
+                       "error": f"{type(e).__name__}: {e}"}
+                results = [dict(err) for _ in batch]
+        t_collect = time.monotonic()
+        elapsed = t_collect - t0
+
+        # device-time attribution: the group's measured kernel wall is
+        # amortized over its lanes — each member (one real lane) gets
+        # wall/lanes, the replicated pad lanes' share is padding waste
+        # (a first-class counter). share*n_real + waste == wall, so
+        # attributed device-seconds reconcile with dispatch wall by
+        # construction (asserted within 2% in tests).
+        lanes = n_real + pad
+        share = elapsed / lanes
+        waste = share * pad
+        obs.histogram("serve.dispatch_wall_s", elapsed)
+        obs.count("serve.device_s", share * n_real)
+        obs.count("serve.pad_waste_s", waste)
+
+        # stitched per-request trace: the group-level dispatch record
+        # plus every ledger record the dispatch produced, re-emitted
+        # per member with the request id. Fallbacks/swallows also land
+        # in the member's TENANT serve ledger, so "no silent fallback"
+        # stays assertable from the client side (GET /check/<id> and
+        # GET /stats), not just from inside the daemon process.
+        engine_recs = [r for r in cap.ledger
+                       if r.get("event") in ("selected", "fallback",
+                                             "swallowed", "route",
+                                             "skipped")]
+        disp_rec = {"ts": round(time.time(), 6),
+                    "stage": "serve-dispatch", "event": "dispatch",
+                    "group": lanes, "real": n_real, "pad": pad,
+                    "wall_s": round(elapsed, 6),
+                    "device_s": round(share, 9),
+                    "pad_waste_s": round(waste, 9)}
         now = time.monotonic()
         for req, res in zip(batch, results):
+            req.t_collect = t_collect
+            req.device_s = share
+            req.stitch([disp_rec] + engine_recs)
+            self.registry.add_device_time(req.tenant, share)
+            for r in engine_recs:
+                if r.get("event") in ("fallback", "swallowed"):
+                    self.registry.ledger_record(
+                        req.tenant, f"engine-{r['event']}",
+                        id=req.id, stage=r.get("stage"),
+                        cause=r.get("cause"))
             self._finish(req, res, elapsed, now)
 
     # -- completion ------------------------------------------------------
@@ -213,8 +417,19 @@ class Dispatcher:
             # device time, not about discarding finished work
             status = rq.DONE
             obs.count("serve.completed")
+            # latency histograms observed exactly where serve.completed
+            # bumps, so the CI invariant "e2e histogram count equals
+            # completed requests" holds at every /metrics scrape
+            obs.histogram("serve.e2e_s", now - req.t_submit)
+            obs.histogram("serve.service_s",
+                          now - (req.t_coalesce or req.t_dispatch
+                                 or req.t_submit))
         if self.persist and status == rq.DONE:
             try:
+                # provisional done stamp so the PERSISTED waterfall
+                # carries its publish stage (registry.finish re-stamps
+                # a hair later; the live GET view uses that one)
+                req.t_done = now
                 req.run_dir = self._persist(req, res)
             except Exception as e:                      # noqa: BLE001
                 log.warning("serve persist failed for %s: %s",
@@ -224,6 +439,7 @@ class Dispatcher:
             req.tenant, status, id=req.id,
             valid=res.get("valid"), engine=res.get("engine"),
             dispatch_s=round(elapsed, 6),
+            device_s=round(req.device_s or 0.0, 9),
             latency_s=round(now - req.t_submit, 6))
         obs.count(
             f"serve.tenant.{self.registry.bucket_tenant(req.tenant)}"
@@ -253,14 +469,20 @@ class Dispatcher:
         out = dict(res)
         out["serve"] = {"id": req.id, "tenant": req.tenant,
                         "latency-s": round(
-                            time.monotonic() - req.t_submit, 6)}
+                            time.monotonic() - req.t_submit, 6),
+                        "device-s": round(req.device_s or 0.0, 9),
+                        "waterfall": req.waterfall(),
+                        "trace": [dict(r) for r in req.trace]}
         return store.save_check(self.store_root,
                                 f"serve-{req.model_name}", req.id,
                                 list(req.history), out)
 
     # -- stats -----------------------------------------------------------
-    def stats(self) -> Dict[str, Any]:
-        counters = {k: v for k, v in obs.counters().items()
+    def stats(self, snap: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
+        if snap is None:
+            snap = obs.core.GLOBAL.snapshot()
+        counters = {k: v for k, v in snap["counters"].items()
                     if k.startswith(("serve.", "engine.", "lockstep.",
                                      "compile_cache.", "memo_cache.",
                                      "transfer."))}
@@ -275,11 +497,19 @@ class Dispatcher:
                           self.queue.max_inflight_per_tenant},
             "dispatch": dispatch,
             "counters": counters,
+            # headline digests of the serve-path latency histograms
+            # (full bucket ladders live on GET /metrics)
+            "histograms": {k: obs.hist_summary(h)
+                           for k, h in snap["histograms"].items()
+                           if k.startswith("serve.")},
+            "timeseries": self.ring.points(),
+            "profile": self.profile_state(),
         }
         out.update(self.registry.stats())
         return out
 
-    def _write_stats_file(self) -> None:
+    def _write_stats_file(self, snap: Optional[Dict[str, Any]] = None
+                          ) -> None:
         """Drop the latest stats snapshot under the store root
         (best-effort) so the results browser's ``/engine`` page can
         render a daemon it does not share a process with."""
@@ -290,7 +520,7 @@ class Dispatcher:
             os.makedirs(d, exist_ok=True)
             tmp = os.path.join(d, ".stats.json.tmp")
             with open(tmp, "w") as f:
-                json.dump({"ts": time.time(), **self.stats()}, f,
+                json.dump({"ts": time.time(), **self.stats(snap)}, f,
                           default=str)
             os.replace(tmp, os.path.join(d, "stats.json"))
         except Exception:                               # noqa: BLE001

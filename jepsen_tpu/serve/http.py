@@ -15,10 +15,19 @@
   ``400`` on malformed input, ``429`` + ``Retry-After`` under
   backpressure.
 - ``GET /check/<id>`` — status/result. ``result`` carries the full
-  checker verdict (witness included) once ``status`` is terminal.
-  ``DELETE /check/<id>`` cancels a queued request.
+  checker verdict (witness included) once ``status`` is terminal,
+  plus the stage ``waterfall`` (admit→coalesce→walk→publish), the
+  stitched dispatcher ``trace``, and the request's attributed
+  ``device-s``. ``DELETE /check/<id>`` cancels a queued request.
 - ``GET /stats`` — queue depths, per-tenant ledger counts, cache
-  counters, per-geometry dispatch counts. ``GET /healthz`` — liveness.
+  counters, per-geometry dispatch counts, latency-histogram digests,
+  and the rolling time-series ring. ``GET /healthz`` — liveness.
+- ``GET /metrics`` — Prometheus text exposition (every counter,
+  numeric gauge, and latency histogram with ``_bucket``/``_sum``/
+  ``_count`` series; scrape-ready).
+- ``POST /profile`` — ``{"dispatches": N}`` arms ``jax.profiler``
+  around the next N dispatches; the capture persists under
+  ``<store-root>/serve/profile-<ts>/``.
 """
 from __future__ import annotations
 
@@ -212,6 +221,27 @@ class Daemon:
             return 404, {"error": f"unknown request {req_id!r}"}
         return 200, req.to_json()
 
+    def profile(self, body: bytes) -> Tuple[int, Dict]:
+        """Arm on-demand profiling: the next N dispatches run under
+        ``jax.profiler.trace``. 409 when already armed or when the
+        daemon has no store root to persist the capture into."""
+        try:
+            data = json.loads(body) if body else {}
+            n = int(data.get("dispatches", 1))
+            if not 1 <= n <= 1000:
+                raise ValueError("dispatches must be in 1..1000")
+        except Exception as e:                          # noqa: BLE001
+            return 400, {"error": f"{type(e).__name__}: {e}"}
+        try:
+            d = self.dispatcher.arm_profile(n)
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
+        except Exception as e:                          # noqa: BLE001
+            # e.g. an unwritable store root: the capture dir could
+            # not be created — an HTTP error, never a dropped socket
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+        return 202, {"profile-dir": d, "dispatches": n}
+
     def cancel(self, req_id: str) -> Tuple[int, Dict]:
         req = self.registry.get(req_id)
         if req is None:
@@ -244,8 +274,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _reply(self, code: int, payload: Dict) -> None:
         body = json.dumps(payload, default=str).encode()
+        self._reply_raw(code, body, "application/json")
+
+    def _reply_raw(self, code: int, body: bytes,
+                   content_type: str) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         if code == 429:
             self.send_header("Retry-After", "1")
@@ -253,15 +287,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_POST(self) -> None:                          # noqa: N802
-        if self.path.rstrip("/") != "/check":
-            self._reply(404, {"error": "POST /check only"})
-            return
+        path = self.path.rstrip("/")
         n = int(self.headers.get("Content-Length") or 0)
         if n > self.daemon_ref.max_body_bytes:
             # refuse BEFORE reading: a body cap enforced after
             # rfile.read would already have paid the memory
             self._reply(413, {"error": f"body {n} bytes exceeds "
                               f"{self.daemon_ref.max_body_bytes}"})
+            return
+        if path == "/profile":
+            body = self.rfile.read(n) if n else b""
+            code, payload = self.daemon_ref.profile(body)
+            self._reply(code, payload)
+            return
+        if path != "/check":
+            self._reply(404,
+                        {"error": "POST /check or /profile only"})
             return
         body = self.rfile.read(n) if n else b""
         code, payload = self.daemon_ref.submit(
@@ -278,6 +319,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path.rstrip("/") == "/stats":
             self._reply(200, self.daemon_ref.stats())
+            return
+        if path.rstrip("/") == "/metrics":
+            # Prometheus text exposition of the process-global
+            # recorder: counters, numeric gauges, histogram ladders
+            from jepsen_tpu import obs
+            self._reply_raw(200, obs.prometheus_text().encode(),
+                            "text/plain; version=0.0.4; "
+                            "charset=utf-8")
             return
         if path.rstrip("/") == "/healthz":
             self._reply(200, {"ok": True})

@@ -40,6 +40,11 @@ CANCELLED = "cancelled"
 
 _TERMINAL = (DONE, TIMEOUT, CANCELLED)
 
+# stitched per-request trace records are bounded: a pathological
+# dispatch (deep fallback chains) must not grow retained terminal
+# requests past the "verdicts, not gigabytes" contract
+_TRACE_CAP = 64
+
 
 def new_request_id() -> str:
     return uuid.uuid4().hex[:16]
@@ -58,14 +63,29 @@ class CheckRequest:
     n_ops: int = 0              # survives the terminal payload drop
     opts: Dict[str, Any] = field(default_factory=dict)
     deadline: Optional[float] = None        # time.monotonic() instant
+    # stage timestamps (time.monotonic): admit -> coalesce (selected
+    # into a dispatch group) -> dispatch (engine call starts) ->
+    # collect (engine call returned) -> done (verdict published).
+    # t_submit_wall anchors the monotonic deltas to the wall clock for
+    # clients rendering the waterfall.
     t_submit: float = field(default_factory=time.monotonic)
+    t_submit_wall: float = field(default_factory=time.time)
+    t_coalesce: Optional[float] = None
     t_dispatch: Optional[float] = None
+    t_collect: Optional[float] = None
     t_done: Optional[float] = None
     status: str = QUEUED
     result: Optional[Dict[str, Any]] = None
     run_dir: Optional[str] = None           # when persisted via store
     done_event: threading.Event = field(default_factory=threading.Event)
     cancel_requested: bool = False
+    device_s: Optional[float] = None        # attributed device time
+    # per-request stitched trace: the dispatcher thread re-emits its
+    # group-level spans and any engine fallback/selection records into
+    # every member's ledger (tagged with the request id), so a
+    # client's GET /check/<id> sees what its own dispatch did even
+    # though three threads touched it
+    trace: List[Dict[str, Any]] = field(default_factory=list)
 
     @property
     def model_sig(self) -> tuple:
@@ -87,16 +107,64 @@ class CheckRequest:
     def terminal(self) -> bool:
         return self.status in _TERMINAL
 
+    def stitch(self, recs: List[Dict[str, Any]]) -> None:
+        """Append dispatcher-thread records to this request's stitched
+        trace, tagged with the request id (bounded at ``_TRACE_CAP``;
+        overflow is counted so a truncated trace is visible)."""
+        room = _TRACE_CAP - len(self.trace)
+        for r in recs[:max(0, room)]:
+            rec = dict(r)
+            rec["id"] = self.id
+            self.trace.append(rec)
+        if len(recs) > room:
+            from jepsen_tpu import obs
+            obs.count("serve.trace_truncated", len(recs) - room)
+
+    def waterfall(self) -> List[Dict[str, Any]]:
+        """The request's life as contiguous stages relative to submit:
+        ``queued`` (admission -> selected into a group), ``coalesce``
+        (selection -> engine call), ``walk`` (the device dispatch),
+        ``publish`` (collect -> verdict published). Only stages whose
+        boundary timestamps exist appear — a queued-side timeout shows
+        just its queue time."""
+        out: List[Dict[str, Any]] = []
+
+        def add(stage: str, start: Optional[float],
+                end: Optional[float]) -> None:
+            if start is None or end is None:
+                return
+            out.append({"stage": stage,
+                        "start-s": round(start - self.t_submit, 6),
+                        "dur-s": round(max(0.0, end - start), 6)})
+
+        add("queued", self.t_submit, self.t_coalesce or self.t_done)
+        add("coalesce", self.t_coalesce, self.t_dispatch)
+        add("walk", self.t_dispatch, self.t_collect)
+        add("publish", self.t_collect, self.t_done)
+        return out
+
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
             "id": self.id, "tenant": self.tenant,
             "model": self.model_name, "status": self.status,
             "ops": int(self.n_ops),
+            "submitted-at": round(self.t_submit_wall, 3),
         }
-        if self.t_dispatch is not None:
-            out["queued-s"] = round(self.t_dispatch - self.t_submit, 6)
+        if self.t_coalesce is not None:
+            out["queue-wait-s"] = round(
+                self.t_coalesce - self.t_submit, 6)
+        if self.t_done is not None and self.t_coalesce is not None:
+            out["service-s"] = round(
+                self.t_done - self.t_coalesce, 6)
         if self.t_done is not None:
             out["latency-s"] = round(self.t_done - self.t_submit, 6)
+        if self.device_s is not None:
+            out["device-s"] = round(self.device_s, 9)
+        wf = self.waterfall()
+        if wf:
+            out["waterfall"] = wf
+        if self.trace:
+            out["trace"] = [dict(r) for r in self.trace]
         if self.result is not None:
             out["result"] = self.result
         if self.run_dir is not None:
@@ -128,6 +196,9 @@ class Registry:
         # nested, NOT "tenant.event" flat keys: tenant names are
         # client-controlled and may themselves contain dots
         self._event_counts: Dict[str, Dict[str, int]] = {}
+        # attributed device-seconds per tenant (the amortized share of
+        # each dispatch group's kernel wall; see engine._dispatch)
+        self._device_s: Dict[str, float] = {}
 
     def add(self, req: CheckRequest) -> None:
         with self._lock:
@@ -202,6 +273,14 @@ class Registry:
             from jepsen_tpu import obs
             obs.count("serve.tenant_overflow")
 
+    def add_device_time(self, tenant: str, seconds: float) -> None:
+        """Accumulate a request's attributed device-seconds under its
+        (bounded) tenant bucket — the per-tenant cost view of the
+        device-time attribution."""
+        with self._lock:
+            b = self._bucket_tenant_locked(tenant)
+            self._device_s[b] = self._device_s.get(b, 0.0) + seconds
+
     def tenant_ledger(self, tenant: str) -> List[Dict[str, Any]]:
         with self._lock:
             return [dict(r) for r in self._tenant_ledgers.get(tenant, ())]
@@ -218,4 +297,7 @@ class Registry:
                 census[req.status] = census.get(req.status, 0) + 1
             tenants = {t: dict(ev)
                        for t, ev in self._event_counts.items()}
-            return {"requests": census, "tenants": tenants}
+            device_s = {t: round(v, 6)
+                        for t, v in self._device_s.items()}
+            return {"requests": census, "tenants": tenants,
+                    "device-seconds": device_s}

@@ -250,6 +250,24 @@ def save_check(root: str, name: str, run_id: str, history: List[Op],
                  "results": results}, run_dir=d)
 
 
+def serve_profile_dir(root: str) -> str:
+    """Create (and return) a fresh capture directory for the
+    check-serve daemon's on-demand profiler —
+    ``<root>/serve/profile-<ts>/``, beside the daemon's
+    ``stats.json`` so captures are browsable artifacts of the store
+    like everything else the daemon writes."""
+    import time as _time
+    ts = _time.strftime("%Y%m%dT%H%M%S", _time.gmtime())
+    d = os.path.join(root, "serve", f"profile-{ts}")
+    n = 0
+    base = d
+    while os.path.exists(d):
+        n += 1
+        d = f"{base}-{n}"
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def load_history(run_dir: str) -> List[Op]:
     """Load a stored history for offline re-analysis (the upstream
     re-check path; SURVEY.md §5 checkpoint/resume)."""

@@ -88,7 +88,41 @@ _STYLE = ("<style>body{font-family:sans-serif;margin:2em}"
           ".badge{color:#fff;border-radius:3px;padding:1px 7px;"
           "font-size:85%}"
           ".artifacts a{margin-right:.6em;font-size:90%}"
+          ".spark{display:inline-block;vertical-align:middle;"
+          "margin-right:2em}"
+          ".spark .lbl{font-size:80%;color:#666}"
           "pre{background:#f6f6f6;padding:1em;overflow:auto}</style>")
+
+
+def _sparkline(values, width: int = 220, height: int = 36) -> str:
+    """Inline-SVG sparkline over a list of numbers (None gaps are
+    skipped). No javascript — the /engine page is a meta-refresh
+    dashboard, so each render is a fresh polyline."""
+    pts = [(i, float(v)) for i, v in enumerate(values)
+           if isinstance(v, (int, float))]
+    if len(pts) < 2:
+        return "<span style='color:#999'>&mdash;</span>"
+    lo = min(v for _, v in pts)
+    hi = max(v for _, v in pts)
+    span_x = max(1, pts[-1][0] - pts[0][0])
+    span_y = (hi - lo) or 1.0
+    coords = " ".join(
+        f"{(i - pts[0][0]) / span_x * width:.1f},"
+        f"{height - 3 - (v - lo) / span_y * (height - 6):.1f}"
+        for i, v in pts)
+    return (f"<svg width='{width}' height='{height}'>"
+            f"<polyline points='{coords}' fill='none' "
+            f"stroke='#3b6ea5' stroke-width='1.5'/></svg>")
+
+
+def _spark_row(points, key: str, label: str, fmt: str = "{:g}") -> str:
+    vals = [p.get(key) for p in points]
+    last = next((v for v in reversed(vals)
+                 if isinstance(v, (int, float))), None)
+    last_s = fmt.format(last) if last is not None else "?"
+    return (f"<span class='spark'><span class='lbl'>{label} "
+            f"(now {html.escape(last_s)})</span><br>"
+            f"{_sparkline(vals)}</span>")
 
 
 def _index_html(root: str) -> str:
@@ -106,12 +140,17 @@ def _index_html(root: str) -> str:
 def _engine_html(root: str) -> str:
     """The ``/engine`` page: the check-serve daemon's latest stats
     snapshot (``<root>/serve/stats.json``, rewritten by the daemon
-    after every dispatch) — queue depth, per-tenant serve ledgers,
+    after every dispatch) — a live auto-refreshing dashboard with
+    sparklines over the daemon's rolling time-series ring (req/s,
+    p50/p99, queue depth, in-flight), latency-histogram digests,
+    per-tenant device-seconds, queue depth, per-tenant serve ledgers,
     per-geometry dispatch counts, and every ``serve.*`` counter."""
     stats_path = os.path.join(root, "serve", "stats.json")
-    head = ("<!doctype html><title>jepsen-tpu engine</title>" + _STYLE
+    head = ("<!doctype html><title>jepsen-tpu engine</title>"
+            "<meta http-equiv='refresh' content='2'>" + _STYLE
             + "<h1>check-serve daemon</h1>"
-              "<p><a href='/'>&larr; results index</a></p>")
+              "<p><a href='/'>&larr; results index</a> &middot; "
+              "auto-refreshes every 2 s</p>")
     if not os.path.exists(stats_path):
         return (head + "<p>No daemon stats found — start one with "
                        "<code>python -m jepsen_tpu check-serve"
@@ -136,12 +175,40 @@ def _engine_html(root: str) -> str:
         f"<tr><td>{html.escape(t)}</td>"
         f"<td>{html.escape(json.dumps(ev))}</td></tr>"
         for t, ev in sorted(tenants.items()))
+    points = st.get("timeseries", [])
+    sparks = ""
+    if points:
+        sparks = ("<h2>live (last %d dispatches)</h2><div>" %
+                  len(points)
+                  + _spark_row(points, "req_s", "req/s")
+                  + _spark_row(points, "p50_s", "p50 s", "{:.3f}")
+                  + _spark_row(points, "p99_s", "p99 s", "{:.3f}")
+                  + _spark_row(points, "depth", "queue depth")
+                  + _spark_row(points, "inflight", "in-flight")
+                  + "</div>")
+    hists = st.get("histograms", {})
+    hist_rows = "".join(
+        f"<tr><td>{html.escape(k)}</td><td>{h.get('count', 0)}</td>"
+        f"<td>{h.get('p50', '')}</td><td>{h.get('p99', '')}</td>"
+        f"<td>{h.get('mean', '')}</td></tr>"
+        for k, h in sorted(hists.items()))
+    dev_rows = "".join(
+        f"<tr><td>{html.escape(t)}</td><td>{v}</td></tr>"
+        for t, v in sorted(st.get("device-seconds", {}).items()))
     q = st.get("queue", {})
     return (head
             + f"<p>queue depth {q.get('depth', '?')} / "
               f"{q.get('max_depth', '?')}, group width "
               f"{q.get('group', '?')}, per-tenant in-flight cap "
               f"{q.get('max_inflight_per_tenant', '?')}</p>"
+            + sparks
+            + ("<h2>latency histograms</h2><table>"
+               "<tr><th>histogram</th><th>count</th><th>p50 s</th>"
+               "<th>p99 s</th><th>mean s</th></tr>"
+               + hist_rows + "</table>" if hist_rows else "")
+            + ("<h2>device-seconds by tenant</h2><table>"
+               "<tr><th>tenant</th><th>attributed s</th></tr>"
+               + dev_rows + "</table>" if dev_rows else "")
             + "<h2>serve counters</h2><table>"
               "<tr><th>counter</th><th>value</th></tr>"
             + serve_rows + "</table>"

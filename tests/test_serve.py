@@ -332,6 +332,8 @@ def test_daemon_end_to_end_multi_tenant(tmp_path):
     from jepsen_tpu.checkers import facade
 
     store_root = str(tmp_path)
+    c0 = obs.counters()
+    h0 = obs.histograms()
     d = serve.Daemon(port=0, host="127.0.0.1", group=8,
                      store_root=store_root, persist=True).start()
     url = f"http://127.0.0.1:{d.port}"
@@ -403,9 +405,64 @@ def test_daemon_end_to_end_multi_tenant(tmp_path):
         run_dirs = os.listdir(
             os.path.join(store_root, "serve-cas-register"))
         assert len(run_dirs) == len(cases)
-        # the /engine page renders the daemon's stats snapshot
+        # telemetry (ISSUE 8): every done response carries the stage
+        # waterfall, the stitched dispatcher trace, and its
+        # attributed device time
+        for st in results.values():
+            stages = [s["stage"] for s in st["waterfall"]]
+            assert stages[0] == "queued" and "walk" in stages
+            assert st["device-s"] > 0
+            assert st["queue-wait-s"] >= 0 and st["service-s"] > 0
+            assert any(t["stage"] == "serve-dispatch"
+                       for t in st["trace"])
+        # attributed device-seconds reconcile with measured dispatch
+        # wall within 2% (deltas: the suite shares the recorder)
+        c1, h1 = obs.counters(), obs.histograms()
+        wall = obs.hist_delta(h1.get("serve.dispatch_wall_s"),
+                              h0.get("serve.dispatch_wall_s"))["sum"]
+        attributed = (c1.get("serve.device_s", 0)
+                      - c0.get("serve.device_s", 0))
+        waste = (c1.get("serve.pad_waste_s", 0)
+                 - c0.get("serve.pad_waste_s", 0))
+        assert wall > 0
+        assert abs(attributed + waste - wall) <= 0.02 * wall
+        # /metrics: Prometheus-parseable, histogram count == completed
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        parsed = obs.parse_prometheus(text)
+        assert "jepsen_serve_e2e_s_bucket" in parsed
+        assert (parsed["jepsen_serve_e2e_s_count"][0][1]
+                == parsed["jepsen_serve_completed"][0][1])
+        # POST /profile wraps the next dispatch in jax.profiler and
+        # persists the capture under the store root
+        preq = urllib.request.Request(
+            url + "/profile",
+            data=json.dumps({"dispatches": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(preq, timeout=10) as r:
+            assert r.status == 202
+            pdir = json.loads(r.read())["profile-dir"]
+        _post_json(url, {"model": "cas-register",
+                         "tenant": "prof",
+                         "history": [op.to_dict() for op in
+                                     fixtures.gen_history(
+                                         "cas", n_ops=8,
+                                         processes=2, seed=99)]})
+        end = time.monotonic() + 120
+        while time.monotonic() < end:
+            if d.dispatcher.profile_state()["armed"] == 0 \
+                    and not d.dispatcher.profile_state()["active"]:
+                break
+            time.sleep(0.05)
+        captured = [os.path.join(r, f)
+                    for r, _, fs in os.walk(pdir) for f in fs]
+        assert captured, f"no profiler capture under {pdir}"
+        # the /engine page renders the daemon's stats snapshot —
+        # now with sparklines + histogram digests
         page = web._engine_html(store_root)
         assert "serve.completed" in page and "tenant-3" in page
+        assert "latency histograms" in page
         # and the index grows the live row
         assert "/engine" in web._index_html(store_root)
     finally:

@@ -1,0 +1,451 @@
+"""Serve-path telemetry (ISSUE 8): the histogram primitive (bucket
+boundaries, concurrent observes, snapshot merge/delta), the
+Prometheus text exposition (parseable, ``_sum``/``_count``
+consistent), the stitched per-request cross-thread trace, device-time
+attribution reconciling with dispatch wall, the rolling time-series
+ring, on-demand profiling arming, and loadgen's quantile cross-check
+logic — all with a stubbed engine, so every test here is host-only
+and fast."""
+import importlib.util
+import json
+import math
+import os
+import threading
+import time
+import types
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import models, obs
+from jepsen_tpu.serve import engine as serve_engine
+from jepsen_tpu.serve import request as rq
+from jepsen_tpu.serve.coalesce import AdmissionQueue
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"telemetry_{name}", os.path.join(_TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- histogram primitive -------------------------------------------------
+
+def test_histogram_bucket_boundaries_le_semantics():
+    """Prometheus ``le`` semantics: a value exactly on an edge counts
+    into that edge's bucket; a value just past it into the next."""
+    r = obs.Recorder()
+    edge = obs.HIST_EDGES[40]
+    r.observe("h", edge)
+    r.observe("h", edge * 1.0001)
+    counts = r.snapshot()["histograms"]["h"]["counts"]
+    assert counts[40] == 1 and counts[41] == 1
+    # below the first edge and past the last edge both still land
+    r.observe("h", 0.0)
+    r.observe("h", obs.HIST_EDGES[-1] * 10)
+    counts = r.snapshot()["histograms"]["h"]["counts"]
+    assert counts[0] == 1                       # underflow -> first
+    assert counts[len(obs.HIST_EDGES)] == 1     # overflow -> +Inf
+
+
+def test_histogram_concurrent_observes():
+    r = obs.Recorder()
+    n_threads, per = 8, 500
+
+    def work(k):
+        for i in range(per):
+            r.observe("lat", 0.001 * (k + 1))
+
+    threads = [threading.Thread(target=work, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    h = r.snapshot()["histograms"]["lat"]
+    assert h["count"] == n_threads * per
+    assert h["count"] == sum(h["counts"])
+    expect = sum(per * 0.001 * (k + 1) for k in range(n_threads))
+    assert abs(h["sum"] - expect) < 1e-6
+
+
+def test_histogram_snapshot_merge_and_delta():
+    a, b = obs.Recorder(), obs.Recorder()
+    for v in (0.01, 0.02, 0.5):
+        a.observe("h", v)
+    for v in (0.02, 4.0):
+        b.observe("h", v)
+    ha = a.snapshot()["histograms"]["h"]
+    hb = b.snapshot()["histograms"]["h"]
+    m = obs.hist_merge(ha, hb)
+    assert m["count"] == 5
+    assert abs(m["sum"] - 4.55) < 1e-9
+    # delta recovers one side of a merge exactly
+    d = obs.hist_delta(m, ha)
+    assert d["counts"] == hb["counts"] and d["count"] == hb["count"]
+    assert obs.hist_delta(ha, ha)["count"] == 0
+    assert obs.hist_delta(None, ha)["count"] == 0
+    assert obs.hist_delta(ha, None)["count"] == ha["count"]
+
+
+def test_histogram_quantiles_and_summary():
+    r = obs.Recorder()
+    for _ in range(100):
+        r.observe("h", 0.1)
+    h = r.snapshot()["histograms"]["h"]
+    p50 = obs.hist_quantile(h, 0.5)
+    # one log-spaced bucket wide: the estimate must sit within the
+    # bucket that holds 0.1 (ratio 10^0.1)
+    assert 0.1 / 1.26 <= p50 <= 0.1 * 1.26
+    s = obs.hist_summary(h)
+    assert s["count"] == 100 and abs(s["mean"] - 0.1) < 1e-6
+    assert obs.hist_quantile({"count": 0, "sum": 0.0,
+                              "counts": []}, 0.5) is None
+    assert obs.hist_summary(None) == {"count": 0}
+
+
+def test_histogram_reaches_capture_and_global():
+    with obs.capture() as cap:
+        obs.histogram("telemetry.test.h", 123.0)
+    assert cap.histograms["telemetry.test.h"]["count"] == 1
+    assert obs.histograms()["telemetry.test.h"]["count"] >= 1
+
+
+# -- Prometheus exposition -----------------------------------------------
+
+def test_prometheus_exposition_parseable_and_consistent():
+    r = obs.Recorder()
+    r.count("serve.completed", 7)
+    r.count("serve.tenant.we ird/name.done", 2)   # client-controlled
+    r.gauge("serve.queue_depth", 3)
+    r.gauge("transfer.mode", {"packed": True})    # non-numeric: skip
+    for v in (0.01, 0.02, 0.02, 0.5, 2.0):
+        r.observe("serve.e2e_s", v)
+    text = obs.prometheus_text(r)
+    # every sample line is format-valid (the parser raises otherwise)
+    parsed = obs.parse_prometheus(text)
+    assert parsed["jepsen_serve_completed"][0][1] == 7
+    assert parsed["jepsen_serve_queue_depth"][0][1] == 3
+    assert not any("transfer_mode" in k for k in parsed)
+    # per-tenant counters stay JSON-side: unbounded client-controlled
+    # cardinality has no place in a scrape
+    assert not any("serve_tenant" in k for k in parsed)
+    buckets = parsed["jepsen_serve_e2e_s_bucket"]
+    # cumulative and monotone, +Inf equals _count, _sum matches
+    vals = [v for labels, v in sorted(
+        buckets, key=lambda lv: float(lv[0]["le"]))]
+    assert vals == sorted(vals)
+    inf = [v for labels, v in buckets if labels["le"] == "+Inf"][0]
+    assert inf == parsed["jepsen_serve_e2e_s_count"][0][1] == 5
+    assert abs(parsed["jepsen_serve_e2e_s_sum"][0][1] - 2.55) < 1e-9
+    # quantiles derived from the exposition agree with the internal
+    # histogram (the loadgen cross-check path)
+    pairs = [(float(labels["le"]), v) for labels, v in buckets]
+    h = r.snapshot()["histograms"]["serve.e2e_s"]
+    internal = obs.hist_quantile(h, 0.5)
+    external = obs.quantile_from_cumulative(pairs, 0.5)
+    assert abs(internal - external) / internal < 1e-3
+
+
+def test_parse_prometheus_rejects_garbage():
+    with pytest.raises(ValueError):
+        obs.parse_prometheus("this is not { exposition\n")
+
+
+def test_prometheus_sanitization_collisions_dropped_not_duplicated():
+    """Two raw names sanitizing to one series would make strict
+    scrapers reject the whole exposition as a duplicate sample — the
+    loser is dropped and the drop surfaced as a gauge instead."""
+    r = obs.Recorder()
+    r.count("weird.a-b", 1)
+    r.count("weird.a_b", 2)
+    parsed = obs.parse_prometheus(obs.prometheus_text(r))
+    assert len(parsed["jepsen_weird_a_b"]) == 1
+    assert parsed["jepsen_obs_prom_collisions"][0][1] == 1
+
+
+# -- the stubbed dispatcher: stitching, attribution, ring ---------------
+
+def _mk_req(n_ops=8, tenant="t", deadline=None):
+    return rq.CheckRequest(
+        id=rq.new_request_id(), tenant=tenant,
+        model_name="cas-register", model=models.cas_register(),
+        packed=types.SimpleNamespace(n=n_ops), history=[],
+        n_ops=n_ops, deadline=deadline)
+
+
+@pytest.fixture
+def stub_dispatcher(monkeypatch):
+    """A real Dispatcher + AdmissionQueue + Registry over a stubbed
+    facade (no device walk): the whole telemetry pipeline minus jax.
+    The stub emits a ledger fallback + selection from the DISPATCHER
+    thread, which client-side captures can never see directly — the
+    stitched trace must carry them."""
+    from jepsen_tpu.checkers import facade
+
+    def fake_many(model, packed_list, kw):
+        obs.engine_fallback("stub-stage", "StubErr")
+        obs.engine_selected("stub-engine")
+        time.sleep(0.02)
+        return [{"valid": True, "engine": "stub"}
+                for _ in packed_list]
+
+    def fake_one(model, packed, kw):
+        obs.engine_selected("stub-engine")
+        time.sleep(0.01)
+        return {"valid": True, "engine": "stub"}
+
+    monkeypatch.setattr(facade, "auto_check_many_packed", fake_many)
+    monkeypatch.setattr(facade, "auto_check_packed", fake_one)
+    q = AdmissionQueue(max_depth=32, group=8)
+    reg = rq.Registry()
+    d = serve_engine.Dispatcher(q, reg)
+    d.start()
+    yield d, q, reg
+    d.stop()
+
+
+def _run(reg, q, reqs, timeout=10.0):
+    for r in reqs:
+        reg.add(r)
+        q.submit(r)
+    for r in reqs:
+        assert r.done_event.wait(timeout), r.status
+
+
+def test_stitched_trace_and_waterfall_roundtrip(stub_dispatcher):
+    d, q, reg = stub_dispatcher
+    reqs = [_mk_req(tenant=f"t{i % 2}") for i in range(3)]
+    _run(reg, q, reqs)
+    for r in reqs:
+        j = r.to_json()
+        # the waterfall covers the whole request life contiguously
+        stages = [s["stage"] for s in j["waterfall"]]
+        assert stages == ["queued", "coalesce", "walk", "publish"]
+        for prev, nxt in zip(j["waterfall"], j["waterfall"][1:]):
+            assert nxt["start-s"] == pytest.approx(
+                prev["start-s"] + prev["dur-s"], abs=1e-4)
+        assert j["queue-wait-s"] >= 0 and j["service-s"] > 0
+        assert abs(j["queue-wait-s"] + j["service-s"]
+                   - j["latency-s"]) < 1e-3
+        # dispatcher-thread records re-emitted with the request id
+        assert all(t["id"] == r.id for t in j["trace"])
+        events = {(t["stage"], t["event"]) for t in j["trace"]}
+        assert ("serve-dispatch", "dispatch") in events
+        assert ("stub-stage", "fallback") in events
+        assert ("stub-engine", "selected") in events
+    # group-level fallbacks also land in each member's TENANT serve
+    # ledger -> "no silent fallback" is assertable from /stats
+    stats = d.stats()
+    for t in ("t0", "t1"):
+        assert stats["tenants"][t]["engine-fallback"] >= 1
+
+
+def test_attribution_reconciles_with_dispatch_wall(stub_dispatcher):
+    d, q, reg = stub_dispatcher
+    c0 = obs.counters()
+    h0 = obs.histograms()
+    # 3 real lanes pad to 4: one replicated lane's share is waste
+    reqs = [_mk_req(tenant=f"t{i}") for i in range(3)]
+    _run(reg, q, reqs)
+    # plus a singleton dispatch (no padding)
+    solo = _mk_req(tenant="solo")
+    _run(reg, q, [solo])
+    c1 = obs.counters()
+    h1 = obs.histograms()
+    dc = lambda k: c1.get(k, 0) - c0.get(k, 0)          # noqa: E731
+    wall = obs.hist_delta(h1.get("serve.dispatch_wall_s"),
+                          h0.get("serve.dispatch_wall_s"))
+    assert wall["count"] >= 2
+    attributed = dc("serve.device_s")
+    waste = dc("serve.pad_waste_s")
+    assert attributed > 0 and waste > 0
+    # the acceptance bar: attributed + waste == measured wall (2%)
+    assert abs(attributed + waste - wall["sum"]) <= 0.02 * wall["sum"]
+    # per-request and per-tenant attribution exists and is consistent
+    assert all(r.device_s > 0 for r in reqs) and solo.device_s > 0
+    dev = d.stats()["device-seconds"]
+    assert abs(sum(dev.values()) - attributed) < 1e-3
+    # e2e histogram counts completions, one for one
+    e2e = obs.hist_delta(h1.get("serve.e2e_s"), h0.get("serve.e2e_s"))
+    assert e2e["count"] == dc("serve.completed") == 4
+
+
+def test_timeseries_ring_samples_per_dispatch(stub_dispatcher):
+    d, q, reg = stub_dispatcher
+    _run(reg, q, [_mk_req()])
+    _run(reg, q, [_mk_req()])
+    pts = d.stats()["timeseries"]
+    assert len(pts) >= 2
+    for p in pts:
+        assert set(p) == {"ts", "req_s", "p50_s", "p99_s", "depth",
+                          "inflight"}
+    # the second point has a rate (a previous point to difference)
+    assert pts[-1]["req_s"] is not None
+    assert pts[-1]["p50_s"] is not None and pts[-1]["p50_s"] > 0
+
+
+def test_profile_arms_around_n_dispatches(stub_dispatcher, tmp_path,
+                                          monkeypatch):
+    d, q, reg = stub_dispatcher
+    calls = []
+    monkeypatch.setattr(serve_engine, "_profiler_start",
+                        lambda p: calls.append(("start", p)))
+    monkeypatch.setattr(serve_engine, "_profiler_stop",
+                        lambda: calls.append(("stop", None)))
+    with pytest.raises(RuntimeError):
+        d.arm_profile(1)                    # no store root
+    d.store_root = str(tmp_path)
+    pdir = d.arm_profile(2)
+    assert os.path.isdir(pdir) and "profile-" in pdir
+    with pytest.raises(RuntimeError):
+        d.arm_profile(1)                    # already armed
+    for _ in range(3):                      # 3 dispatches, 2 profiled
+        _run(reg, q, [_mk_req()])
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert calls[0][1] == pdir
+    st = d.profile_state()
+    assert st["armed"] == 0 and st["active"] is False
+    # an armed-but-undersubscribed capture is flushed at stop():
+    # the trace must not keep recording (nor the capture dir stay
+    # empty) because traffic dried up before N dispatches
+    d.arm_profile(5)
+    _run(reg, q, [_mk_req()])
+    assert [c[0] for c in calls] == ["start", "stop", "start"]
+    d.stop()
+    assert [c[0] for c in calls] == ["start", "stop", "start",
+                                     "stop"]
+    assert d.profile_state()["armed"] == 0
+
+
+# -- HTTP: /metrics and /profile (no engine behind the queue) -----------
+
+@pytest.fixture
+def protocol_daemon():
+    from jepsen_tpu import serve
+    d = serve.Daemon(port=0, host="127.0.0.1", queue_depth=4)
+    d.start(dispatch=False)
+    yield d, f"http://127.0.0.1:{d.port}"
+    d.shutdown(drain_timeout=0.1)
+
+
+def test_http_metrics_exposition(protocol_daemon):
+    d, url = protocol_daemon
+    from jepsen_tpu import fixtures
+    hist = [op.to_dict() for op in fixtures.gen_history(
+        "cas", n_ops=8, processes=2, seed=5)]
+    req = urllib.request.Request(
+        url + "/check",
+        data=json.dumps({"model": "cas-register",
+                         "history": hist}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.status == 202
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    parsed = obs.parse_prometheus(text)
+    assert parsed["jepsen_serve_admitted"][0][1] >= 1
+
+
+def test_http_profile_routes(protocol_daemon):
+    d, url = protocol_daemon
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    # no store root behind this daemon -> profiling cannot persist
+    code, body = post("/profile", {"dispatches": 2})
+    assert code == 409 and "store root" in body["error"]
+    code, body = post("/profile", {"dispatches": 0})
+    assert code == 400
+
+
+# -- loadgen cross-check + trace_view waterfall -------------------------
+
+def test_loadgen_crosscheck_logic():
+    lg = _load_tool("loadgen")
+    before = [(0.1, 0.0), (1.0, 0.0), (float("inf"), 0.0)]
+    agree = [(0.1, 10.0), (1.0, 10.0), (float("inf"), 10.0)]
+    xc = lg.crosscheck_quantiles({"p50": 0.05, "p99": 0.09},
+                                 before, agree)
+    assert xc["ok"] is True
+    # gross disagreement (a unit bug: seconds vs milliseconds)
+    disagree = [(0.1, 0.0), (1.0, 0.0), (10.0, 10.0),
+                (float("inf"), 10.0)]
+    xc = lg.crosscheck_quantiles({"p50": 0.05, "p99": 0.06},
+                                 before, disagree)
+    assert xc["ok"] is False
+    assert lg.crosscheck_quantiles({"p50": 1.0}, None, agree) is None
+
+
+def test_trace_view_renders_request_waterfall(capsys):
+    tv = _load_tool("trace_view")
+    doc = {"id": "abc123", "tenant": "team-a", "status": "done",
+           "latency-s": 0.5, "device-s": 0.1,
+           "waterfall": [
+               {"stage": "queued", "start-s": 0.0, "dur-s": 0.1},
+               {"stage": "coalesce", "start-s": 0.1, "dur-s": 0.01},
+               {"stage": "walk", "start-s": 0.11, "dur-s": 0.35},
+               {"stage": "publish", "start-s": 0.46, "dur-s": 0.04}],
+           "trace": [{"stage": "serve-dispatch", "event": "dispatch",
+                      "id": "abc123", "wall_s": 0.35}]}
+    w = tv.request_waterfall(doc)
+    assert w is not None and len(w["waterfall"]) == 4
+    tv._print_waterfall(w)
+    out = capsys.readouterr().out
+    assert "abc123" in out and "walk" in out and "#" in out
+    # a daemon-persisted results.json nests the same under "serve"
+    w2 = tv.request_waterfall({"valid": True,
+                               "serve": {"id": "x", "tenant": "t",
+                                         "waterfall":
+                                             doc["waterfall"]}})
+    assert w2 is not None and w2["id"] == "x"
+    # plain trace.json documents fall through to the span summary
+    assert tv.request_waterfall({"traceEvents": []}) is None
+
+
+def test_queued_timeout_waterfall_has_queue_stage_only():
+    reg = rq.Registry()
+    r = _mk_req(deadline=time.monotonic() - 1)
+    reg.add(r)
+    reg.finish(r, rq.TIMEOUT, {"valid": "unknown",
+                               "cause": "deadline"})
+    wf = r.to_json()["waterfall"]
+    assert [s["stage"] for s in wf] == ["queued"]
+
+
+def test_stats_file_carries_telemetry(stub_dispatcher, tmp_path):
+    d, q, reg = stub_dispatcher
+    d.store_root = str(tmp_path)
+    _run(reg, q, [_mk_req()])
+    # the dispatcher rewrites stats.json after every dispatch; the
+    # write happens on the dispatcher thread AFTER the done event
+    # fires, so poll briefly
+    path = os.path.join(str(tmp_path), "serve", "stats.json")
+    end = time.monotonic() + 5.0
+    while not os.path.exists(path) and time.monotonic() < end:
+        time.sleep(0.01)
+    assert os.path.exists(path)
+    with open(path) as f:
+        st = json.load(f)
+    assert st["timeseries"] and "serve.e2e_s" in st["histograms"]
+    assert math.isfinite(
+        st["counters"].get("serve.pad_waste_s", 0.0))
+    from jepsen_tpu import web
+    page = web._engine_html(str(tmp_path))
+    assert "latency histograms" in page
+    assert "auto-refresh" in page or "refresh" in page

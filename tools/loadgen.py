@@ -20,9 +20,13 @@ Usage::
     python tools/loadgen.py --self-host --rate 20 --duration 10
 
 Exit status: 0 iff at least one request completed AND every verdict
-matched its history's known ground truth. The final ``/stats``
-snapshot rides along in the JSON report (the CI smoke job asserts
-zero silent fallbacks from it).
+matched its history's known ground truth AND the latency cross-check
+passed (loadgen's client-measured p50/p99 vs the daemon's
+histogram-derived quantiles over the /metrics delta — >15%
+disagreement past the poll-resolution slack means a clock/stamping
+bug). The report also splits queue-wait from service time using the
+daemon's stage timestamps, and the final ``/stats`` snapshot rides
+along (the CI smoke job asserts zero silent fallbacks from it).
 """
 from __future__ import annotations
 
@@ -103,6 +107,34 @@ def _get(url: str, path: str) -> Tuple[int, Dict]:
         return -1, {}
 
 
+def _get_text(url: str, path: str) -> Tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url + path, timeout=30) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, ""
+    except Exception:                                   # noqa: BLE001
+        return -1, ""
+
+
+def fetch_hist_buckets(url: str,
+                       metric: str = "jepsen_serve_e2e_s"
+                       ) -> Optional[List[Tuple[float, float]]]:
+    """Scrape /metrics and return the CUMULATIVE ``(le, count)``
+    bucket pairs of one histogram (None when the endpoint or the
+    series is missing)."""
+    from jepsen_tpu import obs
+
+    code, text = _get_text(url, "/metrics")
+    if code != 200 or not text:
+        return None
+    samples = obs.parse_prometheus(text)
+    rows = samples.get(metric + "_bucket")
+    if not rows:
+        return None
+    return sorted((float(labels["le"]), v) for labels, v in rows)
+
+
 def wait_ready(url: str, timeout: float = 30.0) -> bool:
     """Poll /healthz until the daemon answers (the CI smoke job
     starts the daemon in the background and races its jax import)."""
@@ -149,6 +181,61 @@ def _window_report(records: List[Dict], t_start: float,
 
 
 _POLL_MAX_S = 0.25
+
+# The cross-check is resolution-aware: the daemon's histogram answers
+# a quantile only to within its bucket (log-spaced, ratio 10^0.1), so
+# the loadgen-side value is compared against the BUCKET INTERVAL
+# around the histogram estimate, and the 15% bound applies to the
+# distance OUTSIDE that interval. Client-side latency is additionally
+# quantized by the poll schedule (a verdict is observed up to
+# _POLL_MAX_S after it published) — that much absolute slack rides on
+# top. Clock/stamping bugs (unit mixups, monotonic-vs-wall mixes, a
+# stage stamped by the wrong thread) disagree by orders of magnitude,
+# far past every bound here.
+_XCHECK_REL = 0.15
+_XCHECK_ABS_S = _POLL_MAX_S + 0.1
+_BUCKET_RATIO = 10.0 ** 0.1
+
+
+def crosscheck_quantiles(lg: Dict[str, Optional[float]],
+                         before: Optional[List[Tuple[float, float]]],
+                         after: Optional[List[Tuple[float, float]]]
+                         ) -> Optional[Dict[str, Any]]:
+    """Compare loadgen's own measured p50/p99 against the daemon's
+    histogram-derived quantiles over the /metrics DELTA between two
+    scrapes (the delta isolates the measured window from warmup
+    traffic — cumulative buckets difference bucket-by-bucket).
+    Returns the comparison dict (``"ok"`` False on >15% disagreement
+    past the poll-resolution slack), or None when either side is
+    unavailable."""
+    from jepsen_tpu import obs
+
+    if before is None or after is None:
+        return None
+    b = {le: v for le, v in before}
+    delta = [(le, v - b.get(le, 0.0)) for le, v in after]
+    out: Dict[str, Any] = {}
+    ok = True
+    for label, q in (("p50", 0.50), ("p99", 0.99)):
+        mine = lg.get(label)
+        hist = obs.quantile_from_cumulative(delta, q)
+        if mine is None or hist is None:
+            out[label] = {"loadgen_s": mine, "hist_s": hist,
+                          "ok": None}
+            continue
+        # distance from loadgen's value to the one-bucket interval
+        # around the histogram estimate (inside the interval the two
+        # agree as well as the histogram can resolve)
+        lo, hi = hist / _BUCKET_RATIO, hist * _BUCKET_RATIO
+        diff = max(0.0, lo - mine, mine - hi)
+        rel = diff / max(mine, hist, 1e-9)
+        this_ok = rel <= _XCHECK_REL or diff <= _XCHECK_ABS_S
+        ok = ok and this_ok
+        out[label] = {"loadgen_s": round(mine, 4),
+                      "hist_s": round(hist, 4),
+                      "rel": round(rel, 3), "ok": this_ok}
+    out["ok"] = ok
+    return out
 
 
 def _await_ids(url: str, ids: List[str], poll_timeout: float) -> None:
@@ -242,6 +329,10 @@ def run_load(url: str, *, rate: float, duration: float,
                     rec["match"] = (valid == payload["expect"]
                                     if st["status"] == "done"
                                     else None)
+                    # the daemon's stamped stage split (queue wait vs
+                    # service) — reported beside the client-side wall
+                    rec["queue_wait_s"] = st.get("queue-wait-s")
+                    rec["service_s"] = st.get("service-s")
                     break
                 time.sleep(poll)
                 poll = min(_POLL_MAX_S, poll * 1.5)
@@ -284,6 +375,24 @@ def run_load(url: str, *, rate: float, duration: float,
         "p99_s": _percentile([r["latency_s"] for r in done], 0.99),
         "windows": _window_report(records, t_start, t_mid,
                                   time.monotonic()),
+        # queue-wait vs service-time split from the daemon's stage
+        # timestamps (GET /check/<id> waterfall fields)
+        "stage_split": {
+            kind: {
+                "p50_s": _percentile(vals, 0.50),
+                "p99_s": _percentile(vals, 0.99),
+                "mean_s": (round(sum(vals) / len(vals), 4)
+                           if vals else None),
+            }
+            for kind, vals in (
+                ("queue_wait",
+                 [r["queue_wait_s"] for r in done
+                  if isinstance(r.get("queue_wait_s"),
+                                (int, float))]),
+                ("service",
+                 [r["service_s"] for r in done
+                  if isinstance(r.get("service_s"),
+                                (int, float))]))},
     }
     code, stats = _get(url, "/stats")
     if code == 200:
@@ -332,8 +441,17 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
             report["warmup"] = warmup(
                 url, pool, burst=int(opts.get("warm_burst")
                                      or (8 if quick else 16)))
+        # scrape the e2e histogram around the measured run: the delta
+        # is the measured window's distribution, warmup excluded
+        hist_before = fetch_hist_buckets(url)
         report.update(run_load(url, rate=rate, duration=duration,
                                pool=pool))
+        hist_after = fetch_hist_buckets(url)
+        xc = crosscheck_quantiles(
+            {"p50": report.get("p50_s"), "p99": report.get("p99_s")},
+            hist_before, hist_after)
+        if xc is not None:
+            report["latency_crosscheck"] = xc
         report["url"] = url
         return report
     finally:
@@ -382,6 +500,12 @@ def main(argv=None) -> int:
         return 2
     ok = (report.get("completed", 0) > 0
           and report.get("verdict_mismatches", 0) == 0)
+    # the histogram cross-check catches clock/stamping bugs: loadgen's
+    # client-measured quantiles and the daemon's histogram-derived
+    # ones must agree (>15% past the poll-resolution slack is a bug)
+    xc = report.get("latency_crosscheck")
+    if xc is not None and xc.get("ok") is False:
+        ok = False
     return 0 if ok else 1
 
 

@@ -7,9 +7,16 @@ is contained in the parent's), spans grouped by mesh device (the
 engine-decision ledger as a fallback/selection table, and the
 counters.
 
+Also renders a per-request serve WATERFALL: point it at a saved
+``GET /check/<id>`` response (or a daemon-persisted ``results.json``,
+whose ``serve`` sub-object carries the same fields) and it prints the
+admit→coalesce→walk→publish stage bars, the attributed device time,
+and the stitched dispatcher trace.
+
 Usage:
     python tools/trace_view.py trace.json [--top 15] [--json]
     python tools/trace_view.py store/<name>/latest/obs.jsonl
+    python tools/trace_view.py check_response.json   # waterfall
 
 Exit codes: 0 on success, 2 when the file cannot be parsed.
 """
@@ -20,7 +27,7 @@ import json
 import os
 import sys
 from collections import defaultdict
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -103,6 +110,59 @@ def decision_table(decisions: List[Dict[str, Any]]
     return {ev: dict(rows) for ev, rows in out.items()}
 
 
+def request_waterfall(doc: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Extract the serve-request waterfall view from a
+    ``GET /check/<id>`` response or a daemon-persisted
+    ``results.json`` (its ``serve`` sub-object). None when the
+    document carries no waterfall."""
+    serve = doc.get("serve") if isinstance(doc.get("serve"),
+                                           dict) else {}
+    wf = doc.get("waterfall") or serve.get("waterfall")
+    if not wf:
+        return None
+    src = doc if doc.get("waterfall") else serve
+    return {
+        "id": doc.get("id") or serve.get("id"),
+        "tenant": doc.get("tenant") or serve.get("tenant"),
+        "status": doc.get("status"),
+        "latency_s": doc.get("latency-s") or serve.get("latency-s"),
+        "device_s": doc.get("device-s") or serve.get("device-s"),
+        "waterfall": wf,
+        "trace": src.get("trace") or [],
+    }
+
+
+def _print_waterfall(w: Dict[str, Any], width: int = 44) -> None:
+    total = max((s["start-s"] + s["dur-s"] for s in w["waterfall"]),
+                default=0.0) or 1e-9
+    head = f"request {w.get('id') or '?'}"
+    if w.get("tenant"):
+        head += f" (tenant {w['tenant']})"
+    if w.get("status"):
+        head += f" {w['status']}"
+    if w.get("latency_s") is not None:
+        head += f", {w['latency_s']:.4f}s end to end"
+    if w.get("device_s") is not None:
+        head += f", {w['device_s']:.6f}s device"
+    print(head)
+    for s in w["waterfall"]:
+        lead = min(width, int(round(s["start-s"] / total * width)))
+        bar = max(1, min(width + 1 - lead,
+                         int(round(s["dur-s"] / total * width))))
+        tail = width + 1 - lead - bar
+        print(f"  {s['stage']:>9} {s['start-s']:>9.4f}s "
+              f"{' ' * lead}{'#' * bar}{' ' * tail} "
+              f"{s['dur-s']:.4f}s")
+    if w["trace"]:
+        print("  stitched dispatcher trace:")
+        for r in w["trace"]:
+            extra = {k: v for k, v in r.items()
+                     if k not in ("stage", "event", "id", "ts")}
+            print(f"    {r.get('event', '?'):9} "
+                  f"{r.get('stage', '?'):16} "
+                  f"{json.dumps(extra, default=str)}")
+
+
 def summarize(path: str, top: int = 15) -> Dict[str, Any]:
     from jepsen_tpu import obs
 
@@ -121,6 +181,8 @@ def summarize(path: str, top: int = 15) -> Dict[str, Any]:
         "decisions": decision_table(data["decisions"]),
         "counters": {c["name"]: c["value"] for c in data["counters"]},
         "gauges": gauges,
+        "histograms": {h["name"]: obs.hist_summary(h)
+                       for h in data.get("histograms", [])},
     }
     by_dev = device_table(data["spans"])
     if by_dev:
@@ -190,6 +252,15 @@ def _print_human(s: Dict[str, Any]) -> None:
             print(f"  {event}:")
             for key, n in sorted(rows.items(), key=lambda kv: -kv[1]):
                 print(f"    {key:48} x{n}")
+    if s.get("histograms"):
+        print("\nhistograms:")
+        print(f"  {'name':32} {'count':>7} {'p50':>10} {'p99':>10} "
+              f"{'mean':>10}")
+        for name, h in sorted(s["histograms"].items()):
+            print(f"  {name:32} {h.get('count', 0):>7} "
+                  f"{h.get('p50') or 0:>10.4f} "
+                  f"{h.get('p99') or 0:>10.4f} "
+                  f"{h.get('mean') or 0:>10.4f}")
     if s["counters"]:
         print("\ncounters:")
         for name, v in sorted(s["counters"].items()):
@@ -208,6 +279,24 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args()
+    # a /check/<id> response (or daemon results.json) renders as a
+    # per-request waterfall instead of a span summary. The probe is
+    # size-gated: waterfall docs are a few KB, while a full exported
+    # trace.json can carry 100k spans — no point parsing those twice.
+    try:
+        if os.path.getsize(args.path) < (4 << 20):
+            with open(args.path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict):
+                w = request_waterfall(doc)
+                if w is not None:
+                    if args.json:
+                        print(json.dumps(w))
+                    else:
+                        _print_waterfall(w)
+                    return 0
+    except (OSError, json.JSONDecodeError):
+        pass                        # fall through to the span parser
     try:
         s = summarize(args.path, args.top)
     except (OSError, json.JSONDecodeError, KeyError, TypeError) as e:
