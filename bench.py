@@ -403,6 +403,64 @@ def serve_probe(quick: bool = True) -> dict:
     return out
 
 
+def txn_probe(n_txns: int, seed: int) -> dict:
+    """The transactional rung (ISSUE 9): a ``n_txns`` list-append
+    history (key-rotated, the real Jepsen workload shape) with one
+    injected G-single block, classified end-to-end — dependency
+    inference + the MXU boolean-closure engine vs the host SCC
+    baseline on the SAME inferred graph. Reports agg txns/s both ways
+    (warm best-of-2), the Kahn-trimmed core size the dense closure
+    actually walked, and the detected anomaly classes (the injected
+    class must be among them, or the rung reports an error)."""
+    from jepsen_tpu import fixtures, txn
+    from jepsen_tpu.txn import infer as txn_infer
+    from jepsen_tpu.txn import ops as txn_ops
+
+    t0 = time.monotonic()
+    h = fixtures.gen_txn_history(n_txns, keys=6, processes=8,
+                                 key_rotate=32, seed=seed)
+    h = h + [op.with_(index=-1) for op in
+             fixtures.txn_anomaly_block("G-single")]
+    gen_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    txns, fails = txn_ops.collect(h)
+    graph = txn_infer.infer(txns, fails)
+    infer_s = time.monotonic() - t0
+
+    def best_of(fn, k=2):
+        res, times = None, []
+        for _ in range(k):
+            t1 = time.monotonic()
+            res = fn()
+            times.append(time.monotonic() - t1)
+        return res, min(times)
+
+    dev, dev_s = best_of(lambda: txn.check_history(h))
+    host, host_s = best_of(
+        lambda: txn.check_history(h, force_host=True))
+    out = {
+        "txns": int(graph.n), "edges": int(graph.e),
+        "edge_counts": graph.edge_counts(),
+        "gen_s": round(gen_s, 2), "infer_s": round(infer_s, 2),
+        "device": {"check_s": round(dev_s, 3),
+                   "txns_s": round(graph.n / max(dev_s, 1e-9)),
+                   "engine": dev.get("engine"),
+                   "core_txns": dev.get("core-txns"),
+                   "anomalies": dev.get("anomalies")},
+        "host": {"check_s": round(host_s, 3),
+                 "txns_s": round(graph.n / max(host_s, 1e-9)),
+                 "engine": host.get("engine"),
+                 "anomalies": host.get("anomalies")},
+        "speedup_vs_host": round(host_s / max(dev_s, 1e-9), 2),
+    }
+    if dev.get("anomalies") != host.get("anomalies") \
+            or "G-single" not in (dev.get("anomalies") or ()):
+        out["error"] = (f"classification drift: device "
+                        f"{dev.get('anomalies')} vs host "
+                        f"{host.get('anomalies')}")
+    return out
+
+
 def _ragged_lengths(total: int, keys: int = 12,
                     ratio: float = 1.45) -> list:
     """Deterministic mixed-length key split (BASELINE config #4 shape):
@@ -510,6 +568,12 @@ def main() -> int:
                          "in-process check daemon driven by the "
                          "open-loop load generator (req/s, p50/p99 "
                          "verdict latency)")
+    ap.add_argument("--txn", action="store_true",
+                    help="append the 'txn' sub-object: the "
+                         "transactional rung — a --ops-txn "
+                         "list-append history with an injected "
+                         "anomaly, MXU closure vs host SCC "
+                         "(agg txns/s both ways)")
     args = ap.parse_args()
     if args.quick:
         args.ops = min(args.ops, 20_000)
@@ -674,6 +738,12 @@ def main() -> int:
                 out["serve"] = serve_probe(quick=args.quick)
         except Exception as e:                          # noqa: BLE001
             out["serve"] = {"error": f"{type(e).__name__}: {e}"}
+    if args.txn:
+        try:
+            with obs.span("bench.txn_probe", txns=args.ops):
+                out["txn"] = txn_probe(args.ops, args.seed)
+        except Exception as e:                          # noqa: BLE001
+            out["txn"] = {"error": f"{type(e).__name__}: {e}"}
     _finish(out, res.get("engine"))
     print(json.dumps(out))
     return 0

@@ -419,9 +419,35 @@ def auto_check_many_packed(model: Model, packed_list,
     return out
 
 
+def auto_check_txn(history: Sequence[Op],
+                   kw: Optional[Mapping] = None) -> Dict[str, Any]:
+    """The transactional (Elle-style) route: list-append dependency
+    inference + cycle search on the MXU closure engine, host SCC
+    behind the standard exactly-one-obs-fallback contract (stage
+    ``txn-closure`` — recorded inside :mod:`jepsen_tpu.txn`). Exactly
+    one ``"selected"`` ledger record per call names the engine that
+    produced the verdict, mirroring :func:`auto_check_packed`."""
+    import time as _time
+
+    from jepsen_tpu import txn as txn_mod
+    from jepsen_tpu.checkers import transfer
+
+    transfer.record_mode()
+    ekw = _engine_kw(kw or {}, _TXN_KW)
+    t0 = _time.monotonic()
+    with obs.span("facade.txn", ops=len(history)):
+        res = txn_mod.check_history(history, **ekw)
+    obs.engine_selected(res.get("engine", "txn"), txns=res.get("txns"),
+                        edges=res.get("edges"),
+                        valid=res.get("valid"),
+                        elapsed_s=round(_time.monotonic() - t0, 6))
+    return res
+
+
 # keyword subsets understood by each engine; user opts are filtered so one
 # checker config can carry opts for every algorithm it may route to.
 _REACH_KW = ("max_states", "max_slots", "max_dense", "should_abort")
+_TXN_KW = ("devices", "max_dense_txns", "force_host")
 # check_many additionally shards the key axis over a mesh and admits
 # a dispatch-group width override (the serving layer's admission
 # coalescer planned the batch at its own --group width; the engine-side

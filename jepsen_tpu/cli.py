@@ -244,6 +244,42 @@ def _cmd_recheck(args) -> int:
     return 0 if res.get("valid") is True else 1
 
 
+def _cmd_check(args) -> int:
+    """``check`` — one-shot offline check of a history file. A
+    transactional history (EDN/JSONL list-append ops, ``f == "txn"``
+    — the Elle workload shape) routes through
+    ``facade.auto_check_txn``; ``--txn`` forces that route, otherwise
+    it is auto-detected from the ops. Non-txn histories take the
+    ``recheck`` linearizable path against ``--model``. With
+    ``--store-root`` the run persists as a browsable store dir — the
+    anomaly report (classes + witness cycle) lands in results.json
+    exactly like linear runs, and ``web.py`` renders the badges."""
+    from jepsen_tpu import models
+    from jepsen_tpu.checkers import facade
+
+    history = _load_history(args.path)
+    client_ops = [op for op in history if op.process != "nemesis"]
+    is_txn = args.txn or (bool(client_ops)
+                          and all(op.f == "txn" for op in client_ops))
+    if is_txn:
+        res = facade.auto_check_txn(history, {})
+    else:
+        model = getattr(models, args.model.replace("-", "_"))()
+        checker = facade.linearizable(model, algorithm=args.algorithm)
+        res = facade.check_safe(checker, {"model": model}, history)
+    if args.store_root:
+        import uuid
+
+        from jepsen_tpu import store
+        run_id = uuid.uuid4().hex[:8]
+        name = "txn-check" if is_txn else f"check-{args.model}"
+        res = dict(res)
+        res["run-dir"] = store.save_check(args.store_root, name, run_id,
+                                          list(history), res)
+    print(json.dumps(res, indent=2, default=str))
+    return 0 if res.get("valid") is True else 1
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="jepsen-tpu",
@@ -300,6 +336,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      help="do not write completed checks into the "
                           "store")
     csp.set_defaults(fn=_cmd_check_serve)
+
+    ckp = sub.add_parser(
+        "check",
+        help="check one history file; txn (list-append) histories "
+             "auto-route through the transactional checker")
+    ckp.add_argument("path",
+                     help="run dir, history.jsonl, or history.edn "
+                          "(EDN list-append format supported)")
+    ckp.add_argument("--txn", action="store_true",
+                     help="force the transactional route (default: "
+                          "auto-detected when every client op is a "
+                          "txn)")
+    ckp.add_argument("--model", default="cas-register",
+                     help="model for NON-txn histories")
+    ckp.add_argument("--algorithm", default="auto")
+    ckp.add_argument("--store-root", default=None,
+                     help="persist the check as a browsable store "
+                          "run (anomaly report included)")
+    ckp.set_defaults(fn=_cmd_check)
 
     rp = sub.add_parser("recheck",
                         help="re-analyze stored histories offline "

@@ -26,7 +26,10 @@ _KEYWORD_RE = re.compile(r"^[A-Za-z*+!_?<>=.-][A-Za-z0-9*+!_?<>=.#:/-]*$")
 _KEYWORD_KEYS = {"process", "type", "f", "value", "time", "index", "valid?",
                  "read", "write", "cas", "invoke", "ok", "fail", "info",
                  "nemesis", "acquire", "release", "add", "lock", "unlock",
-                 "enqueue", "dequeue", "start", "stop", "txn"}
+                 "enqueue", "dequeue", "start", "stop", "txn",
+                 # list-append micro-op kinds (Elle's [:append k v] /
+                 # [:r k vs] vectors round-trip as keywords)
+                 "append", "r"}
 
 
 class Keyword(str):

@@ -203,6 +203,45 @@ class FakeCluster:
         with n.lock:
             return sorted(n.data.get(key) or (), key=repr)
 
+    def txn(self, node: str, micro_ops: Sequence[Sequence[Any]]) -> list:
+        """Execute a list-append transaction — ``[["append", k, v],
+        ["r", k, None], ...]`` — returning the completed micro-ops
+        (reads filled with the observed list). Safe mode commits the
+        WHOLE transaction atomically under the global lock (so
+        histories are serializable by construction); sloppy mode
+        applies each micro-op to the local replica and replicates
+        last-writer-wins — concurrent/partitioned appends clobber
+        whole lists, surfacing as genuine Elle anomalies
+        (incompatible orders, lost appends) the txn checker must
+        catch."""
+        n = self._enter(node)
+        out = []
+        if self.safe:
+            if not self._has_majority(node):
+                raise Unavailable(f"{node} lost quorum")
+            with self._glock:
+                if not self._has_majority(node):       # re-check inside
+                    raise FakeTimeout(f"{node} lost quorum mid-txn")
+                for kind, key, v in micro_ops:
+                    if kind == "append":
+                        self._global.setdefault(("txn", key),
+                                                []).append(v)
+                        out.append(["append", key, v])
+                    else:
+                        out.append(["r", key, list(
+                            self._global.get(("txn", key)) or ())])
+            return out
+        for kind, key, v in micro_ops:
+            if kind == "append":
+                self._sloppy_apply(n, ("txn", key),
+                                   lambda cur, v=v: list(cur or ()) + [v])
+                out.append(["append", key, v])
+            else:
+                with n.lock:
+                    out.append(["r", key,
+                                list(n.data.get(("txn", key)) or ())])
+        return out
+
     def incr(self, node: str, key: Any, delta: Any) -> None:
         """Increment the counter at ``key`` by ``delta``."""
         n = self._enter(node)

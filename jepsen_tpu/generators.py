@@ -512,6 +512,53 @@ def unique_values(f: str) -> UniqueValues:
     return UniqueValues(f)
 
 
+class TxnWorkload(Generator):
+    """Elle-style list-append transactions (upstream
+    ``jepsen.tests.cycle.append``): each op is ``{"f": "txn", "value":
+    [["append", k, v], ["r", k, None], ...]}`` — 1..``max_len``
+    micro-ops over ``keys`` keys, appends carrying per-key UNIQUE
+    increasing values (the traceability precondition the inference
+    depends on; uniqueness is guarded by one lock across workers).
+    ``single_key=True`` confines every txn to one key (the CAS-based
+    etcd/redis tiers commit a txn as one per-key compare-and-set)."""
+
+    def __init__(self, keys: int = 3, max_len: int = 4,
+                 read_p: float = 0.5, seed: Optional[int] = None,
+                 key_prefix: str = "t", single_key: bool = False):
+        self._keys = [f"{key_prefix}{i}" for i in range(keys)]
+        self._max_len = max(1, max_len)
+        self._read_p = read_p
+        self._rng = random.Random(seed)
+        self._next: Dict[str, int] = {k: 0 for k in self._keys}
+        self._single = single_key
+        self._lock = threading.Lock()
+
+    def op(self, test, process):
+        with self._lock:
+            rng = self._rng
+            n = rng.randint(1, self._max_len)
+            if self._single:
+                pool = [rng.choice(self._keys)] * n
+            else:
+                pool = [rng.choice(self._keys) for _ in range(n)]
+            micros = []
+            for k in pool:
+                if rng.random() < self._read_p:
+                    micros.append(["r", k, None])
+                else:
+                    v = self._next[k]
+                    self._next[k] = v + 1
+                    micros.append(["append", k, v])
+            return {"f": "txn", "value": micros}
+
+
+def txn_workload(keys: int = 3, max_len: int = 4, read_p: float = 0.5,
+                 seed: Optional[int] = None,
+                 single_key: bool = False) -> TxnWorkload:
+    return TxnWorkload(keys=keys, max_len=max_len, read_p=read_p,
+                       seed=seed, single_key=single_key)
+
+
 # -- independent-keys generators (upstream jepsen.independent) ---------------
 
 class SequentialKeys(Generator):

@@ -241,3 +241,13 @@ def unordered_queue() -> UnorderedQueue:
 
 def noop_model() -> NoOp:
     return NoOp()
+
+
+def bounded_set(universe: int = 12) -> "Model":
+    """Int-coded bounded set (state = one bitmask int, <= 2**universe
+    reachable states) — the memo-friendly set model that lets set
+    workloads reach the dense-walk device engines. Lazy import: the
+    class lives in :mod:`jepsen_tpu.models.memo` beside the memoizer
+    it exists for."""
+    from jepsen_tpu.models.memo import BoundedSetModel
+    return BoundedSetModel(0, universe)

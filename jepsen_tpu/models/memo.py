@@ -20,13 +20,53 @@ from typing import Dict, List, Sequence, Tuple
 import numpy as np
 
 from jepsen_tpu.history import PackedHistory
-from jepsen_tpu.models import Model, is_inconsistent
+from jepsen_tpu.models import Model, StepResult, inconsistent, \
+    is_inconsistent
 from jepsen_tpu.op import Op
 
 
 class StateExplosion(RuntimeError):
     """Raised when the reachable state space exceeds ``max_states`` — the
     caller should fall back to an un-memoized (object-stepping) search."""
+
+
+@dataclass(frozen=True, slots=True)
+class BoundedSetModel(Model):
+    """Int-coded grow-only set over a BOUNDED element universe
+    ``{0..universe-1}`` (ROADMAP item 3(a) opening move): state is one
+    bitmask int, so the reachable space is at most ``2**universe`` and
+    the memo BFS — hence the dense-walk device engines — admits set
+    workloads that :class:`~jepsen_tpu.models.SetModel` (frozenset
+    state, unbounded alphabet) would push to host checking.
+
+    ``add v`` (0 <= v < universe) sets bit ``v``; ``read`` with value
+    ``None`` matches any state, otherwise the observed collection must
+    equal the current contents exactly. Differentially equivalent to
+    ``SetModel`` on in-universe histories (tests/test_models.py)."""
+    mask: int = 0
+    universe: int = 12
+
+    def step(self, op: Op) -> StepResult:
+        if op.f == "add":
+            v = op.value
+            if not isinstance(v, int) or not 0 <= v < self.universe:
+                return inconsistent(
+                    f"add {v!r} outside universe 0..{self.universe - 1}")
+            return BoundedSetModel(self.mask | (1 << v), self.universe)
+        if op.f == "read":
+            if op.value is None:
+                return self
+            try:
+                got = frozenset(int(x) for x in op.value)
+            except (TypeError, ValueError):
+                return inconsistent(f"unreadable set value {op.value!r}")
+            here = frozenset(i for i in range(self.universe)
+                             if self.mask >> i & 1)
+            if got == here:
+                return self
+            return inconsistent(f"read {sorted(got)}, expected "
+                                f"{sorted(here)}")
+        return inconsistent(f"bounded-set cannot {op.f}")
 
 
 @dataclass(frozen=True)

@@ -308,8 +308,15 @@ class Dispatcher:
         # dispatches raw widths.
         n_real = len(batch)
         packed_list = [r.packed for r in batch]
+        # transactional groups: the txn chain is host inference + the
+        # closure kernel (whose geometry pads to a power of two
+        # INTERNALLY), so the lane-count pad below — a dense-walk
+        # geometry concern — does not apply
+        from jepsen_tpu.txn.ops import ListAppend as _ListAppend
+        is_txn = isinstance(model, _ListAppend)
         pad = 0
-        if n_real > 1 and not os.environ.get("JEPSEN_TPU_SERVE_NO_PAD"):
+        if n_real > 1 and not is_txn \
+                and not os.environ.get("JEPSEN_TPU_SERVE_NO_PAD"):
             Hq = 1 << (n_real - 1).bit_length()
             # never pad past the configured group width: the
             # engine-side re-plan splits oversized groups, which would
@@ -334,7 +341,14 @@ class Dispatcher:
                 with obs.span("serve.dispatch",
                               model=req0.model_name,
                               lanes=len(batch)):
-                    if len(batch) == 1:
+                    if is_txn:
+                        # one txn chain per member: host dependency
+                        # inference is per-history; the closure
+                        # kernel geometry is shared across members
+                        # via its power-of-two pad + jit cache
+                        results = [facade.auto_check_txn(
+                            list(r.history), kw) for r in batch]
+                    elif len(batch) == 1:
                         results = [facade.auto_check_packed(
                             model, req0.packed, kw)]
                     else:

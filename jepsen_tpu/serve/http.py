@@ -45,16 +45,24 @@ from jepsen_tpu.serve.engine import Dispatcher
 
 # engine options a client may set per request — bounded to the knobs
 # that cannot destabilize co-tenants (no devices=, no interpret=)
-_CLIENT_OPTS = ("max_states", "max_slots", "max_dense", "time_limit")
+_CLIENT_OPTS = ("max_states", "max_slots", "max_dense", "time_limit",
+                "max_dense_txns")
 
 _MODEL_NAMES = ("register", "cas-register", "mutex", "multi-register",
                 "set-model", "fifo-queue", "unordered-queue",
-                "noop-model")
+                "noop-model", "txn-list-append")
 
 
 def resolve_model(name: str):
     """Model name -> fresh model instance (the CLI's vocabulary:
-    ``cas-register`` -> ``models.cas_register()``)."""
+    ``cas-register`` -> ``models.cas_register()``). The transactional
+    marker ``txn-list-append`` routes its dispatch groups through
+    ``facade.auto_check_txn`` instead of the linearizable engines —
+    and, because the model type is part of the coalescing signature,
+    txn requests coalesce into their own groups by construction."""
+    if name == "txn-list-append":
+        from jepsen_tpu.txn import ops as txn_ops
+        return txn_ops.list_append_model()
     from jepsen_tpu import models
     if name not in _MODEL_NAMES:
         raise ValueError(f"unknown model {name!r}; "
@@ -192,6 +200,14 @@ class Daemon:
                                  or "anonymous")
             model = resolve_model(model_name)
             packed = h.pack(ops)
+            from jepsen_tpu.txn.ops import ListAppend, micro_ops
+            if isinstance(model, ListAppend):
+                # validate micro-ops AT ADMISSION: a malformed txn
+                # must be this client's 400, not a dispatch-time crash
+                # that degrades every co-tenant in the coalesced group
+                for op in ops:
+                    if op.f == "txn":
+                        micro_ops(op.value)
         except Exception as e:                          # noqa: BLE001
             return 400, {"error": f"{type(e).__name__}: {e}"}
         req = rq.CheckRequest(

@@ -52,11 +52,25 @@ class SetClient(cl.Client):
 def set_test(mode: str = "linearizable", *, time_limit: float = 5.0,
              concurrency: int = 5, seed: Optional[int] = None,
              with_nemesis: bool = True, store: bool = False,
-             nemesis_interval: float = 1.0, nodes: Any = 5) -> Dict[str, Any]:
+             nemesis_interval: float = 1.0, nodes: Any = 5,
+             universe: Optional[int] = 12) -> Dict[str, Any]:
+    """``universe`` bounds the add workload to that many unique
+    elements and composes a ``linear`` checker over the int-coded
+    :func:`jepsen_tpu.models.bounded_set` model — a memo-enumerable
+    state space (<= 2**universe), so the set suite's history reaches
+    the dense-walk device engines instead of only the host invariant
+    checker (ROADMAP item 3(a)). ``universe=None`` restores the
+    unbounded workload with host-only checking."""
+    from jepsen_tpu import models
+
     node_names = util.node_names(nodes)
     cluster = FakeCluster(node_names, mode=mode, seed=seed)
-    adds = g.TimeLimit(time_limit,
-                       g.Stagger(0.001, g.unique_values("add"), seed=seed))
+    adds: g.GenLike = g.TimeLimit(
+        time_limit, g.Stagger(0.001, g.unique_values("add"), seed=seed))
+    if universe is not None:
+        # unique_values counts 0,1,2,...: capping the COUNT at the
+        # universe also caps every VALUE inside it
+        adds = g.Limit(universe, adds)
     # Final reads retry (paced) until one succeeds — a fixed attempt
     # budget could be consumed entirely by a not-yet-healed partition,
     # turning a healthy run into {"valid": "unknown"}. The barrier makes
@@ -88,6 +102,9 @@ def set_test(mode: str = "linearizable", *, time_limit: float = 5.0,
         "generator": generator,
         "checker": facade.compose({
             "set": facade.set_checker(),
+            **({"linear": facade.linearizable(
+                    models.bounded_set(universe))}
+               if universe is not None else {}),
             "timeline": timeline.html(),
             "latency": perf.latency_graph(),
             "rate": perf.rate_graph(),

@@ -1,0 +1,259 @@
+"""The device half of the transactional checker: dependency-cycle
+search as batched boolean matrix squaring on the MXU.
+
+The inferred COO edges become three dense adjacency masks — the
+edge-type-restricted graphs of the anomaly taxonomy (``ww`` for G0,
+``ww ∪ wr`` for G1c, the full graph) — stacked ``[3, Np, Np]`` and
+closed in ONE jitted program by repeated boolean squaring
+(Fischer–Meyer: ``C ← C ∨ C·C``, ``⌈log2 Np⌉`` times), the same
+reachability-as-matmul shape the ``reach_*`` engines run. The batch
+axis rides a single ``einsum('bij,bjk->bik')`` — the vmap'd squaring
+ladder — so all three closures share every MXU dispatch. Diagonal
+hits are the cycle verdicts; the G-single predicate is one more
+matmul-shaped contraction (``diag(A_rw · (C_wwwr ∨ I))``).
+
+Wire discipline (the transfer diet): adjacency crosses host→device
+bit-packed 8-per-byte (:func:`transfer.pack_bool`) and unpacks
+on-device where bandwidth is free; the verdict fetch is FOUR booleans
+(lazy-verdict shape — witness extraction is host-side from the COO
+graph, nothing big ever crosses back). ``transfer.count_put``
+accounts the packed vs blanket-f32 bytes.
+
+Geometry: ``Np`` pads to the next power of two (≥ 8) so a serving
+daemon compiles log2-many closure programs, not one per graph size.
+Graphs past the dense envelope (:func:`admits`) are first Kahn-trimmed
+to their cyclic core (:func:`jepsen_tpu.txn.host_ref.trim_core` —
+cycle-preserving, so verdicts are unchanged); a core still past the
+envelope declines to the host SCC reference (a recorded route, not a
+crash). With ``devices`` the closure tiles row-blocks over the 1-D
+mesh from :mod:`jepsen_tpu.parallel` (each chip squares its block
+against the all-gathered matrix), for graphs past one chip's HBM.
+"""
+from __future__ import annotations
+
+import math
+import os
+from functools import lru_cache
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from jepsen_tpu import obs
+from jepsen_tpu.txn.infer import RW, WR, WW, DepGraph
+
+# dense closure envelope: Np*Np f32 intermediates, 4 lanes — 8192 is
+# ~1 GiB of HBM transients on one chip. Overridable for tests/bench.
+_MAX_DENSE_DEFAULT = 8192
+
+
+def max_dense() -> int:
+    try:
+        return int(os.environ.get("JEPSEN_TPU_TXN_MAX_DENSE", "") or
+                   _MAX_DENSE_DEFAULT)
+    except ValueError:
+        return _MAX_DENSE_DEFAULT
+
+
+def device_enabled() -> bool:
+    """``JEPSEN_TPU_NO_TXN_DEVICE=1`` opts the closure kernel out
+    (consulted per call, like the transfer-diet gates)."""
+    return not os.environ.get("JEPSEN_TPU_NO_TXN_DEVICE")
+
+
+def admits(n: int, cap: Optional[int] = None) -> bool:
+    return n <= (cap if cap is not None else max_dense())
+
+
+def _pad_n(n: int) -> int:
+    return max(8, 1 << max(0, (n - 1)).bit_length())
+
+
+def _masks(graph: DepGraph, Np: int
+           ) -> Tuple[np.ndarray, np.ndarray]:
+    """COO -> stacked dense masks [3, Np, Np] (ww / ww∪wr / full) and
+    the rw mask [Np, Np]."""
+    masks = np.zeros((3, Np, Np), bool)
+    rw = np.zeros((Np, Np), bool)
+    src = graph.src.astype(np.int64)
+    dst = graph.dst.astype(np.int64)
+    et = graph.et
+    ww_m = et == WW
+    wr_m = et == WR
+    rw_m = et == RW
+    masks[0, src[ww_m], dst[ww_m]] = True
+    masks[1][masks[0]] = True
+    masks[1, src[wr_m], dst[wr_m]] = True
+    masks[2][masks[1]] = True
+    masks[2, src[rw_m], dst[rw_m]] = True
+    rw[src[rw_m], dst[rw_m]] = True
+    return masks, rw
+
+
+@lru_cache(maxsize=32)
+def _closure_call(Np: int, packed_wire: bool):
+    """One compiled closure program per (padded geometry, wire
+    format): unpack-on-device, the batched squaring ladder, diagonal
+    reduction, and the G-single contraction — verdict is 4 bools."""
+    import jax
+    import jax.numpy as jnp
+
+    n_iter = max(1, math.ceil(math.log2(Np)))
+
+    def fn(wire3, wire_rw):
+        if packed_wire:
+            A = jnp.unpackbits(wire3, count=3 * Np * Np) \
+                   .reshape(3, Np, Np).astype(jnp.float32)
+            Arw = jnp.unpackbits(wire_rw, count=Np * Np) \
+                     .reshape(Np, Np).astype(jnp.float32)
+        else:
+            A = wire3.astype(jnp.float32)
+            Arw = wire_rw.astype(jnp.float32)
+        C = A
+        for _ in range(n_iter):
+            # entries stay exactly {0,1}: path counts are re-saturated
+            # every squaring, so f32 never overflows (max count <= Np)
+            prod = jnp.einsum("bij,bjk->bik", C, C,
+                              preferred_element_type=jnp.float32)
+            C = jnp.where(prod > 0, 1.0, C)
+        cyc = jnp.einsum("bii->b", C) > 0                    # [3]
+        refl = jnp.maximum(C[1], jnp.eye(Np, dtype=jnp.float32))
+        gs = jnp.einsum("ij,ji->", Arw, refl) > 0
+        return jnp.concatenate([cyc, gs[None]])
+
+    return jax.jit(fn)
+
+
+def _put_wire(masks: np.ndarray, rw: np.ndarray
+              ) -> Tuple[Any, Any, bool]:
+    """Marshal the adjacency under the diet: bit-packed 8-per-byte
+    when the packed-wire gate is open, dense uint8 otherwise; bytes
+    accounted either way against the blanket f32 reference."""
+    from jepsen_tpu.checkers import transfer
+
+    packed_wire = transfer.packed_enabled()
+    if packed_wire:
+        w3 = transfer.pack_bool(masks)
+        wrw = transfer.pack_bool(rw)
+    else:
+        w3 = masks.astype(np.uint8)
+        wrw = rw.astype(np.uint8)
+    transfer.count_put(int(w3.nbytes + wrw.nbytes),
+                       int((masks.size + rw.size) * 4))
+    return w3, wrw, packed_wire
+
+
+def closure_booleans(graph: DepGraph,
+                     devices: Optional[Sequence] = None
+                     ) -> Dict[str, bool]:
+    """The four cycle predicates from the device closure. Raises on
+    any device failure — the caller owns the exactly-one-obs-fallback
+    contract to the host SCC reference."""
+    Np = _pad_n(graph.n)
+    masks, rw = _masks(graph, Np)
+    if devices is not None and len(devices) > 1:
+        out = _tiled_booleans(masks, rw, Np, list(devices))
+    else:
+        w3, wrw, packed_wire = _put_wire(masks, rw)
+        out = np.asarray(_closure_call(Np, packed_wire)(w3, wrw))
+        obs.count("txn.closure.device")
+    return {"cyc_ww": bool(out[0]), "cyc_wwwr": bool(out[1]),
+            "cyc_full": bool(out[2]), "gsingle": bool(out[3])}
+
+
+# -- mesh tiling ---------------------------------------------------------
+
+@lru_cache(maxsize=16)
+def _tiled_calls(Np: int, n_dev: int, dev_key: Any):
+    """Compiled row-block step/verdict programs for one (geometry,
+    mesh) pair: each device squares its [Np/n_dev, Np] block against
+    the all-gathered matrix (the closure FLOPs shard n_dev ways; the
+    gather is the transient the docs call out)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from jepsen_tpu import parallel
+
+    devs = list(dev_key)
+    m = parallel.mesh("shard", devs)
+    rows = Np // n_dev
+    row_sh = NamedSharding(m, P("shard", None))
+
+    def step(Mb):
+        M = jax.lax.all_gather(Mb, "shard", axis=0, tiled=True)
+        prod = jnp.dot(Mb, M, preferred_element_type=jnp.float32)
+        return jnp.where(prod > 0, 1.0, Mb)
+
+    def diag_any(Mb):
+        i0 = jax.lax.axis_index("shard") * rows
+        d = Mb[jnp.arange(rows), i0 + jnp.arange(rows)]
+        return jnp.any(d > 0)[None]
+
+    def gsingle(Arw_b, C_b):
+        Cg = jax.lax.all_gather(C_b, "shard", axis=0, tiled=True)
+        i0 = jax.lax.axis_index("shard") * rows
+        col = jax.lax.dynamic_slice_in_dim(Cg, i0, rows, axis=1)
+        eye = (jnp.arange(Np)[:, None]
+               == (i0 + jnp.arange(rows))[None, :]).astype(jnp.float32)
+        refl = jnp.maximum(col, eye)                     # [Np, rows]
+        vals = jnp.einsum("ij,ji->i", Arw_b, refl)
+        return jnp.any(vals > 0)[None]
+
+    sm = parallel.shard_map
+    step_f = jax.jit(sm(step, m, in_specs=P("shard", None),
+                        out_specs=P("shard", None), check=False))
+    diag_f = jax.jit(sm(diag_any, m, in_specs=P("shard", None),
+                        out_specs=P("shard"), check=False))
+    gs_f = jax.jit(sm(gsingle, m,
+                      in_specs=(P("shard", None), P("shard", None)),
+                      out_specs=P("shard"), check=False))
+    cast_f = jax.jit(lambda x: x.astype(jnp.float32))
+    return step_f, diag_f, gs_f, cast_f, row_sh
+
+
+def _tiled_booleans(masks: np.ndarray, rw: np.ndarray, Np: int,
+                    devs: List) -> np.ndarray:
+    import jax
+
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checkers import transfer
+
+    # row blocks need Np % n_dev == 0 and Np is a power of two, so the
+    # mesh uses the largest power-of-two PREFIX of the device order (3
+    # chips -> 2) rather than refusing — or, worse, looping forever
+    # growing Np against an odd divisor
+    devs = parallel.device_order(devs)
+    n_dev = 1 << (len(devs).bit_length() - 1)
+    devs = devs[:n_dev]
+    while Np % n_dev or Np < n_dev * 8:
+        Np *= 2
+    if masks.shape[1] != Np:                 # re-pad to the mesh grid
+        grown = np.zeros((3, Np, Np), bool)
+        grown[:, :masks.shape[1], :masks.shape[2]] = masks
+        masks = grown
+        grown_rw = np.zeros((Np, Np), bool)
+        grown_rw[:rw.shape[0], :rw.shape[1]] = rw
+        rw = grown_rw
+    step_f, diag_f, gs_f, cast_f, row_sh = _tiled_calls(
+        Np, n_dev, tuple(devs))
+    # the tiled wire is uint8 (one byte per element — the row-sharded
+    # put wants byte-addressable blocks; the sub-byte packing is the
+    # single-chip path's), cast to f32 ON DEVICE; accounted as what
+    # the link actually carries vs the blanket f32 reference
+    transfer.count_put(int(masks.size + rw.size),
+                       int((masks.size + rw.size) * 4))
+    n_iter = max(1, math.ceil(math.log2(Np)))
+    out = []
+    C_wwwr = None
+    for lane in range(3):
+        M = cast_f(jax.device_put(masks[lane].astype(np.uint8),
+                                  row_sh))
+        for _ in range(n_iter):
+            M = step_f(M)
+        out.append(bool(np.asarray(diag_f(M)).any()))
+        if lane == 1:
+            C_wwwr = M
+    Arw = cast_f(jax.device_put(rw.astype(np.uint8), row_sh))
+    gs = bool(np.asarray(gs_f(Arw, C_wwwr)).any())
+    obs.count("txn.closure.tiled")
+    return np.asarray(out + [gs])

@@ -1,0 +1,289 @@
+"""Host SCC reference for the transactional checker — the fallback
+rung behind :mod:`jepsen_tpu.txn.cycles` (same contract as the dense
+walks' host oracles: exactly one obs fallback routes here, verdicts
+bit-identical). Iterative Tarjan over the COO dependency graph, the
+Kahn trim that strips the acyclic fringe before a big graph meets the
+dense device closure, and the deterministic witness walk BOTH engine
+paths use to turn "a cycle exists in class X" into one concrete cycle
+for the report.
+
+The anomaly taxonomy maps to edge-type-restricted cycle predicates
+(Adya / Elle):
+
+- ``G0``       — a cycle using only ``ww`` edges (write cycle);
+- ``G1c``      — a cycle in ``ww ∪ wr`` that is not already G0;
+- ``G-single`` — a cycle with exactly one ``rw`` edge: some rw edge
+  ``u → v`` with a ``ww ∪ wr`` path ``v ⇒ u``;
+- ``G2``       — any remaining cycle (≥2 rw edges).
+
+:func:`derive_anomalies` turns the four raw booleans into the reported
+class list identically for the device and host paths, so differential
+identity reduces to boolean agreement (tested in
+``tests/test_txn.py``).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from jepsen_tpu.txn.infer import RW, WR, WW, DepGraph
+
+# class name -> edge types allowed in its witness cycle
+_CLASS_EDGES = {"G0": (WW,), "G1c": (WW, WR),
+                "G-single": (WW, WR, RW), "G2": (WW, WR, RW)}
+
+
+def _adj(graph: DepGraph, types: Sequence[int]
+         ) -> List[List[Tuple[int, int]]]:
+    """Adjacency lists restricted to ``types``: node -> sorted
+    [(dst, et), ...] (sorted so every walk is deterministic)."""
+    out: List[List[Tuple[int, int]]] = [[] for _ in range(graph.n)]
+    tset = set(types)
+    for u, v, t in zip(graph.src.tolist(), graph.dst.tolist(),
+                       graph.et.tolist()):
+        if t in tset:
+            out[int(u)].append((int(v), int(t)))
+    for lst in out:
+        lst.sort()
+    return out
+
+
+def scc(n: int, adj: List[List[Tuple[int, int]]]) -> List[List[int]]:
+    """Iterative Tarjan (100k-node graphs must not hit the recursion
+    limit). Returns the strongly connected components, each sorted."""
+    index = [-1] * n
+    low = [0] * n
+    on_stack = [False] * n
+    stack: List[int] = []
+    comps: List[List[int]] = []
+    counter = 0
+    for root in range(n):
+        if index[root] >= 0:
+            continue
+        work: List[Tuple[int, int]] = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter
+                counter += 1
+                stack.append(v)
+                on_stack[v] = True
+            advanced = False
+            for i in range(pi, len(adj[v])):
+                w = adj[v][i][0]
+                if index[w] < 0:
+                    work[-1] = (v, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if on_stack[w]:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == v:
+                        break
+                comps.append(sorted(comp))
+    return comps
+
+
+def _has_cycle(n: int, adj: List[List[Tuple[int, int]]]) -> bool:
+    return any(len(c) > 1 for c in scc(n, adj))
+
+
+def classify_booleans(graph: DepGraph) -> Dict[str, bool]:
+    """The four raw cycle predicates, from Tarjan/BFS on the host —
+    the reference the device closure is differentially held to."""
+    adj_ww = _adj(graph, (WW,))
+    adj_wwwr = _adj(graph, (WW, WR))
+    adj_full = _adj(graph, (WW, WR, RW))
+    cyc_ww = _has_cycle(graph.n, adj_ww)
+    cyc_wwwr = _has_cycle(graph.n, adj_wwwr)
+    cyc_full = _has_cycle(graph.n, adj_full)
+    gsingle = False
+    if cyc_full:
+        # a G-single cycle (one rw edge u->v + ww∪wr path v => u) lies
+        # inside a full-graph SCC; search only there
+        comp_of = {}
+        for ci, comp in enumerate(scc(graph.n, adj_full)):
+            if len(comp) > 1:
+                for v in comp:
+                    comp_of[v] = ci
+        for u, v, t in zip(graph.src.tolist(), graph.dst.tolist(),
+                           graph.et.tolist()):
+            if t != RW:
+                continue
+            u, v = int(u), int(v)
+            if comp_of.get(u) is None or comp_of.get(u) != comp_of.get(v):
+                continue
+            if _bfs_path(adj_wwwr, v, u) is not None:
+                gsingle = True
+                break
+    return {"cyc_ww": cyc_ww, "cyc_wwwr": cyc_wwwr,
+            "cyc_full": cyc_full, "gsingle": gsingle}
+
+
+def derive_anomalies(b: Dict[str, bool]) -> List[str]:
+    """Boolean predicates -> reported class list. Each class appears
+    only when not implied by a stronger one, and the SAME derivation
+    serves the device and host paths."""
+    out: List[str] = []
+    if b["cyc_ww"]:
+        out.append("G0")
+    if b["cyc_wwwr"] and not b["cyc_ww"]:
+        out.append("G1c")
+    if b["gsingle"] and not b["cyc_wwwr"]:
+        out.append("G-single")
+    if b["cyc_full"] and not (b["cyc_wwwr"] or b["gsingle"]):
+        out.append("G2")
+    return out
+
+
+def _bfs_path(adj: List[List[Tuple[int, int]]], start: int,
+              goal: int) -> Optional[List[int]]:
+    """Shortest path start -> goal (deterministic: sorted adjacency,
+    FIFO). Returns the node list including both ends, or None."""
+    if start == goal:
+        return [start]
+    prev: Dict[int, int] = {start: -1}
+    q: deque = deque([start])
+    while q:
+        u = q.popleft()
+        for v, _t in adj[u]:
+            if v in prev:
+                continue
+            prev[v] = u
+            if v == goal:
+                path = [v]
+                while path[-1] != start:
+                    path.append(prev[path[-1]])
+                return list(reversed(path))
+            q.append(v)
+    return None
+
+
+def _edge_type(graph_adj: List[List[Tuple[int, int]]], u: int,
+               v: int) -> int:
+    """The preferred (lowest-code: ww < wr < rw) edge type u -> v."""
+    for dst, t in graph_adj[u]:          # sorted: (dst, et) ascending
+        if dst == v:
+            return t
+    raise KeyError((u, v))
+
+
+def find_witness(graph: DepGraph, cls: str) -> Optional[Dict[str, Any]]:
+    """One concrete cycle of class ``cls``, deterministically (lowest
+    node ids, shortest paths): ``{"cycle": [tid...], "edges":
+    [type-name...]}`` where ``edges[i]`` labels ``cycle[i] ->
+    cycle[i+1 mod len]``. None when the class has no cycle (callers
+    only ask after a positive verdict)."""
+    from jepsen_tpu.txn.infer import EDGE_NAMES
+
+    types = _CLASS_EDGES.get(cls)
+    if types is None:
+        return None
+    adj = _adj(graph, types)
+    if cls == "G-single":
+        adj_wwwr = _adj(graph, (WW, WR))
+        # only rw edges inside a full-graph SCC can close a cycle:
+        # filtering first keeps the witness walk O(core), not
+        # O(rw-edges * E) over a 100k-txn graph
+        comp_of: Dict[int, int] = {}
+        for ci, comp in enumerate(scc(graph.n, adj)):
+            if len(comp) > 1:
+                for v in comp:
+                    comp_of[v] = ci
+        rw_edges = sorted(
+            (int(u), int(v))
+            for u, v, t in zip(graph.src.tolist(), graph.dst.tolist(),
+                               graph.et.tolist())
+            if t == RW and comp_of.get(int(u)) is not None
+            and comp_of.get(int(u)) == comp_of.get(int(v)))
+        for u, v in rw_edges:
+            path = _bfs_path(adj_wwwr, v, u)
+            if path is not None:
+                cycle = [u] + path[:-1]
+                edges = [RW] + [_edge_type(adj_wwwr, path[i],
+                                           path[i + 1])
+                                for i in range(len(path) - 1)]
+                return {"cycle": cycle,
+                        "edges": [EDGE_NAMES[t] for t in edges]}
+        return None
+    # G0 / G1c / G2: shortest cycle through the smallest node of the
+    # first multi-node SCC of the restricted graph
+    for comp in scc(graph.n, adj):
+        if len(comp) < 2:
+            continue
+        start = comp[0]
+        comp_set = set(comp)
+        sub = [[(v, t) for v, t in adj[u] if v in comp_set]
+               for u in range(graph.n)]
+        for succ, _t in sub[start]:
+            path = _bfs_path(sub, succ, start)
+            if path is not None:
+                cycle = [start] + path[:-1]
+                edges = [_edge_type(sub, cycle[i],
+                                    cycle[(i + 1) % len(cycle)])
+                         for i in range(len(cycle))]
+                return {"cycle": cycle,
+                        "edges": [EDGE_NAMES[t] for t in edges]}
+    return None
+
+
+def trim_core(graph: DepGraph
+              ) -> Tuple[np.ndarray, DepGraph]:
+    """Kahn-peel the acyclic fringe (queue-based, O(V+E)): repeatedly
+    strip in-degree-0 nodes, then out-degree-0 nodes on the remainder.
+    Every cycle of every edge-type restriction survives (a subgraph
+    cycle is a full-graph cycle). Returns ``(core_node_ids, core
+    subgraph relabeled dense)`` — the dense device closure runs on the
+    core when the full graph is past its envelope."""
+    n = graph.n
+    src = graph.src.astype(np.int64)
+    dst = graph.dst.astype(np.int64)
+    alive = np.ones(n, bool)
+    for direction in range(2):
+        s, d = (src, dst) if direction == 0 else (dst, src)
+        indeg = np.zeros(n, np.int64)
+        np.add.at(indeg, d, alive[s] & alive[d])
+        # adjacency (forward for this direction) for queue propagation
+        order = np.argsort(s, kind="stable")
+        s_sorted, d_sorted = s[order], d[order]
+        starts = np.searchsorted(s_sorted, np.arange(n + 1))
+        q = deque(np.nonzero(alive & (indeg == 0))[0].tolist())
+        while q:
+            u = q.popleft()
+            if not alive[u]:
+                continue
+            alive[u] = False
+            for i in range(starts[u], starts[u + 1]):
+                v = int(d_sorted[i])
+                if alive[v]:
+                    indeg[v] -= 1
+                    if indeg[v] == 0:
+                        q.append(v)
+    core = np.nonzero(alive)[0]
+    relabel = -np.ones(n, np.int64)
+    relabel[core] = np.arange(len(core))
+    keep = alive[src] & alive[dst]
+    from jepsen_tpu.checkers import transfer
+    dt = transfer.idx_dtype(max(len(core), 1), count=False)
+    sub = DepGraph(
+        n=len(core),
+        src=relabel[src[keep]].astype(dt),
+        dst=relabel[dst[keep]].astype(dt),
+        et=graph.et[keep],
+        txns=tuple(graph.txns[int(i)] for i in core),
+        direct=(), counters={})
+    return core, sub

@@ -37,24 +37,85 @@ def _badge(valid: str) -> str:
             f"{html.escape(label)}</span>")
 
 
+# Elle anomaly classes the transactional checker reports, by severity
+# color: write cycles darkest, the committed-read classes amber-red,
+# inference-direct classes purple. Anything NOT in this table — an
+# unknown anomaly string from a future checker or a malformed
+# results.json — takes the existing grey badge path via _badge.
+_ANOMALY_COLORS = {
+    "G0": "#7b1fa2", "G1c": "#c2185b", "G-single": "#d84315",
+    "G2": "#c62828", "G1a": "#ad1457",
+    "incompatible-order": "#6a1b9a", "duplicate-append": "#6a1b9a",
+}
+
+
+def _anomaly_badge(name: str) -> str:
+    color = _ANOMALY_COLORS.get(name)
+    if color is None:
+        return _badge(name)                 # unknown string: grey path
+    return (f"<span class='badge' style='background:{color}'>"
+            f"{html.escape(name)}</span>")
+
+
+def _witness_html(res: dict) -> str:
+    """The txn verdict's witness cycle as an ordered op list (one
+    <li> per transaction, the edge type that leads OUT of it
+    annotated), collapsed behind <details> so invalid rows stay
+    scannable."""
+    w = res.get("witness")
+    if not isinstance(w, dict) or not w.get("cycle"):
+        return ""
+    items = []
+    edges = w.get("edges") or []
+    for i, t in enumerate(w["cycle"]):
+        et = edges[i] if i < len(edges) else "?"
+        items.append(
+            f"<li>txn {html.escape(str(t.get('txn')))} "
+            f"(p{html.escape(str(t.get('process')))}"
+            f"@{html.escape(str(t.get('index')))}): "
+            f"<code>{html.escape(json.dumps(t.get('value')))}</code> "
+            f"&rarr;<b>{html.escape(str(et))}</b></li>")
+    return (f"<details><summary>witness cycle "
+            f"({len(items)} txns)</summary><ol>"
+            + "".join(items) + "</ol></details>")
+
+
+def _txn_cell(res: dict) -> str:
+    """Anomaly-class badges + witness for a transactional verdict;
+    empty for non-txn results."""
+    anomalies = res.get("anomalies")
+    if not isinstance(anomalies, list) or not anomalies:
+        return ""
+    badges = " ".join(_anomaly_badge(str(a)) for a in anomalies)
+    return f" {badges}{_witness_html(res)}"
+
+
 def _run_row(root: str, name: str, run: str) -> str:
     valid = ""
+    res: dict = {}
     res_path = os.path.join(run, "results.json")
     if os.path.exists(res_path):
         try:
             with open(res_path) as f:
-                valid = str(json.load(f).get("valid"))
+                res = json.load(f)
+            valid = str(res.get("valid"))
         except Exception:                               # noqa: BLE001
             valid = "?"
+            res = {}
     rel = urllib.parse.quote(os.path.relpath(run, root))
     links = " ".join(
         f"<a href='/files/{rel}/{urllib.parse.quote(a)}'>"
         f"{html.escape(a)}</a>"
         for a in _ARTIFACTS
         if os.path.exists(os.path.join(run, a)))
+    # txn verdicts may live at the top level (cli check / serve runs)
+    # or composed under results.txn (suite runs)
+    txn_res = res if "anomalies" in res else \
+        (res.get("results", {}) or {}).get("txn", {})
+    txn_cell = _txn_cell(txn_res if isinstance(txn_res, dict) else {})
     return (f"<tr><td><a href='/files/{rel}/'>{html.escape(name)}</a>"
             f"</td><td>{html.escape(os.path.basename(run))}</td>"
-            f"<td>{_badge(valid)}</td>"
+            f"<td>{_badge(valid)}{txn_cell}</td>"
             f"<td class='artifacts'>{links}</td></tr>")
 
 

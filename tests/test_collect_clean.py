@@ -35,7 +35,10 @@ def test_tools_and_obs_modules_import_cleanly():
         "root = sys.argv[1]\n"
         "sys.path.insert(0, root)\n"
         "for name in ('jepsen_tpu.obs', 'jepsen_tpu.obs.core',\n"
-        "             'jepsen_tpu.obs.trace'):\n"
+        "             'jepsen_tpu.obs.trace', 'jepsen_tpu.txn',\n"
+        "             'jepsen_tpu.txn.ops', 'jepsen_tpu.txn.infer',\n"
+        "             'jepsen_tpu.txn.cycles',\n"
+        "             'jepsen_tpu.txn.host_ref'):\n"
         "    importlib.import_module(name)\n"
         "files = sorted(glob.glob(os.path.join(root, 'tools', '*.py')))\n"
         "assert files, 'no tools found'\n"
@@ -44,7 +47,7 @@ def test_tools_and_obs_modules_import_cleanly():
         "    spec = importlib.util.spec_from_file_location(name, f)\n"
         "    mod = importlib.util.module_from_spec(spec)\n"
         "    spec.loader.exec_module(mod)\n"
-        "print('imported', len(files) + 3)\n")
+        "print('imported', len(files) + 8)\n")
     proc = subprocess.run([sys.executable, "-c", code, root], cwd=root,
                           capture_output=True, text=True, timeout=240,
                           env=env)

@@ -1,0 +1,528 @@
+"""Transactional checker (ISSUE 9): ops/packing/EDN round-trip, Elle
+edge inference, the MXU closure engine differentially held to the host
+Tarjan/SCC reference (fuzzed histories, injected ww/wr/rw anomalies,
+ambiguous orders, forced-failure exactly-one-fallback), trim/tiled
+routes, facade/serve/cli/web/suite integration."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from jepsen_tpu import edn, fixtures, generators as g, obs, txn
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers import facade
+from jepsen_tpu.op import Op, invoke, ok, fail, info
+from jepsen_tpu.txn import cycles, host_ref, infer, ops
+
+
+def _seq_txns(*txns, p0=0):
+    """Sequential txn ops: each entry is [(kind, key, committed)], the
+    invoke carries None reads, the ok the given values."""
+    out = []
+    for i, t in enumerate(txns):
+        out.append(invoke(p0 + i, "txn",
+                          [[k, kk, None if k == "r" else v]
+                           for k, kk, v in t]))
+        out.append(ok(p0 + i, "txn", [list(x) for x in t]))
+    return h.index(out)
+
+
+# -- ops / packing -----------------------------------------------------------
+
+def test_micro_ops_validation():
+    assert ops.micro_ops([["append", "k", 1], ["r", "k", [1]]]) == \
+        [("append", "k", 1), ("r", "k", [1])]
+    assert ops.micro_ops([["read", "k", None]]) == [("r", "k", None)]
+    with pytest.raises(ops.MalformedTxn):
+        ops.micro_ops("nope")
+    with pytest.raises(ops.MalformedTxn):
+        ops.micro_ops([["bogus", "k", 1]])
+    with pytest.raises(ops.MalformedTxn):
+        ops.micro_ops([["r", "k", 3]])          # read version not a vector
+
+
+def test_collect_pairs_ok_fail_info():
+    hist = h.index([
+        invoke(0, "txn", [["append", "k", 1], ["r", "k", None]]),
+        ok(0, "txn", [["append", "k", 1], ["r", "k", [1]]]),
+        invoke(1, "txn", [["append", "k", 2]]),
+        fail(1, "txn", [["append", "k", 2]]),
+        invoke(2, "txn", [["append", "k", 3], ["r", "k", None]]),
+        info(2, "txn", [["append", "k", 3], ["r", "k", None]]),
+    ])
+    txns, fails = ops.collect(hist)
+    assert len(txns) == 2 and len(fails) == 1
+    assert txns[0].micros == (("append", "k", 1), ("r", "k", [1]))
+    assert txns[1].crashed is True
+    # crashed reads are blanked: nobody observed them
+    assert txns[1].micros == (("append", "k", 3), ("r", "k", None))
+    assert fails[0].micros == (("append", "k", 2),)
+
+
+def test_pack_txns_narrow_dtypes():
+    hist = fixtures.gen_txn_history(40, keys=3, seed=2)
+    txns, _ = ops.collect(hist)
+    p = ops.pack_txns(txns)
+    assert p.n_txns == len(txns)
+    assert p.txn_id.dtype == np.int8          # < 128 txns
+    assert p.key_id.dtype == np.int8
+    assert p.kind.dtype == np.int8
+    assert p.wire_bytes > 0
+    # reads reconstruct from the flat code array
+    for i in range(p.n_micros):
+        if p.kind[i] == ops.KIND_READ and p.read_len[i] >= 0:
+            off, ln = int(p.read_off[i]), int(p.read_len[i])
+            codes = p.read_vals[off:off + ln]
+            kid = int(p.key_id[i])
+            vals = [p.key_vals[kid][int(c)] for c in codes]
+            assert all(isinstance(v, int) for v in vals)
+    big = fixtures.gen_txn_history(300, keys=3, seed=3)
+    tb, _ = ops.collect(big)
+    pb = ops.pack_txns(tb)
+    assert pb.txn_id.dtype == np.int16        # 300 txns > int8
+
+
+def test_edn_round_trip(tmp_path):
+    hist = fixtures.gen_txn_history(25, keys=2, seed=4)
+    path = str(tmp_path / "history.edn")
+    h.save_edn(hist, path)
+    text = open(path).read()
+    assert ":append" in text and ":r" in text and ":txn" in text
+    back = h.load_edn(path)
+    assert len(back) == len(hist)
+    for a, b in zip(hist, back):
+        assert (a.f, a.type, a.process, a.value) == \
+            (b.f, b.type, b.process, b.value)
+    # and the checker agrees across the round trip
+    assert txn.check_history(back)["valid"] is \
+        txn.check_history(hist)["valid"]
+
+
+def test_txn_workload_generator():
+    gen = g.txn_workload(keys=2, max_len=3, seed=7)
+    seen = {}
+    for _ in range(200):
+        sk = gen.op({}, 0)
+        assert sk["f"] == "txn"
+        for kind, k, v in sk["value"]:
+            assert kind in ("append", "r")
+            if kind == "append":
+                assert v not in seen.setdefault(k, set())
+                seen[k].add(v)
+            else:
+                assert v is None
+    single = g.txn_workload(keys=3, seed=7, single_key=True)
+    for _ in range(50):
+        ks = {m[1] for m in single.op({}, 0)["value"]}
+        assert len(ks) == 1
+
+
+# -- inference ---------------------------------------------------------------
+
+def test_infer_edge_rules():
+    hist = _seq_txns(
+        [("append", "a", 1)],
+        [("append", "a", 2)],
+        [("r", "a", [1]), ("append", "b", 1)],     # wr T0->T2, rw T2->T1
+        [("r", "a", [1, 2])],                       # wr T1->T3
+    )
+    txns, fails = ops.collect(hist)
+    graph = infer.infer(txns, fails)
+    edges = set(zip(graph.src.tolist(), graph.dst.tolist(),
+                    graph.et.tolist()))
+    assert (0, 1, infer.WW) in edges
+    assert (0, 2, infer.WR) in edges
+    assert (2, 1, infer.RW) in edges
+    assert (1, 3, infer.WR) in edges
+    assert not graph.direct
+
+
+def test_infer_ambiguous_appends_counted():
+    # an append nobody reads has no recoverable position: weaker
+    # edges, counted, never silent — and never a fabricated cycle
+    hist = _seq_txns([("append", "a", 1)], [("append", "a", 2)])
+    txns, fails = ops.collect(hist)
+    with obs.capture() as cap:
+        graph = infer.infer(txns, fails)
+    assert graph.e == 0
+    assert graph.counters["ambiguous_appends"] == 2
+    assert cap.counters.get("txn.infer.ambiguous_appends") == 2
+    res = txn.check_history(hist)
+    assert res["valid"] is True and res["coverage"] == "weakened"
+
+
+def test_direct_anomaly_incompatible_order():
+    hist = _seq_txns(
+        [("append", "a", 1)], [("append", "a", 2)],
+        [("r", "a", [1, 2])], [("r", "a", [2])],    # not a prefix
+    )
+    res = txn.check_history(hist)
+    assert res["valid"] is False
+    assert "incompatible-order" in res["anomalies"]
+    assert res["engine"] == "txn-infer"
+
+
+def test_direct_anomaly_duplicate_append_and_g1a():
+    dup = _seq_txns([("append", "a", 1)], [("append", "a", 1)])
+    res = txn.check_history(dup)
+    assert res["valid"] is False
+    assert "duplicate-append" in res["anomalies"]
+    aborted = h.index([
+        invoke(0, "txn", [["append", "a", 9]]),
+        fail(0, "txn", [["append", "a", 9]]),
+        invoke(1, "txn", [["r", "a", None]]),
+        ok(1, "txn", [["r", "a", [9]]]),            # observed a failed append
+    ])
+    res2 = txn.check_history(aborted)
+    assert res2["valid"] is False
+    assert "G1a" in res2["anomalies"]
+
+
+def test_derive_anomalies_minimality():
+    d = host_ref.derive_anomalies
+    assert d({"cyc_ww": True, "cyc_wwwr": True, "cyc_full": True,
+              "gsingle": False}) == ["G0"]
+    assert d({"cyc_ww": False, "cyc_wwwr": True, "cyc_full": True,
+              "gsingle": True}) == ["G1c"]
+    assert d({"cyc_ww": False, "cyc_wwwr": False, "cyc_full": True,
+              "gsingle": True}) == ["G-single"]
+    assert d({"cyc_ww": False, "cyc_wwwr": False, "cyc_full": True,
+              "gsingle": False}) == ["G2"]
+    assert d({"cyc_ww": False, "cyc_wwwr": False, "cyc_full": False,
+              "gsingle": False}) == []
+
+
+# -- device vs host differential --------------------------------------------
+
+def _differential(hist):
+    dev = txn.check_history(hist)
+    host = txn.check_history(hist, force_host=True)
+    assert dev["valid"] == host["valid"]
+    assert dev.get("anomalies") == host.get("anomalies")
+    assert dev.get("witness") == host.get("witness")
+    return dev, host
+
+
+@pytest.mark.parametrize("kind", fixtures.TXN_ANOMALY_KINDS)
+def test_injected_anomaly_classified(kind):
+    hist = fixtures.gen_txn_history(30, keys=2, seed=5) + \
+        [o.with_(index=-1) for o in fixtures.txn_anomaly_block(kind)]
+    dev, host = _differential(hist)
+    assert dev["valid"] is False
+    assert dev["anomalies"] == [kind]
+    assert dev["engine"].startswith("txn-mxu")
+    assert host["engine"] == "txn-host-scc"
+    assert dev["witness"]["cycle"]                 # a concrete cycle
+    assert len(dev["witness"]["edges"]) == len(dev["witness"]["cycle"])
+
+
+def test_fuzzed_differential():
+    import random
+    rng = random.Random(12)
+    for t in range(12):
+        hist = fixtures.gen_txn_history(
+            rng.randrange(10, 80), keys=rng.randrange(2, 4),
+            crash_p=rng.choice((0.0, 0.15)),
+            seed=rng.randrange(1 << 30))
+        if rng.random() < 0.5:
+            kind = rng.choice(fixtures.TXN_ANOMALY_KINDS)
+            hist = hist + [o.with_(index=-1)
+                           for o in fixtures.txn_anomaly_block(kind)]
+        _differential(hist)
+
+
+def test_fuzz_tool_txn_trials():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "fuzz.py")
+    spec = importlib.util.spec_from_file_location("fuzz_txn_test", path)
+    fuzz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fuzz)
+    assert fuzz.txn_trials(6, seed=9) == []
+
+
+def test_forced_kernel_failure_exactly_one_fallback(monkeypatch):
+    hist = fixtures.gen_txn_history(25, seed=8) + \
+        [o.with_(index=-1) for o in fixtures.txn_anomaly_block("G0")]
+    ref = txn.check_history(hist, force_host=True)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected closure failure")
+
+    monkeypatch.setattr(cycles, "closure_booleans", boom)
+    with obs.capture() as cap:
+        res = txn.check_history(hist)
+    fbs = [f for f in cap.fallbacks() if f["stage"] == "txn-closure"]
+    assert len(fbs) == 1
+    assert fbs[0]["cause"] == "RuntimeError"
+    assert res["engine"] == "txn-host-scc"
+    assert res["anomalies"] == ref["anomalies"]
+    assert res["witness"] == ref["witness"]
+
+
+def test_device_opt_out_is_route_not_fallback(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_NO_TXN_DEVICE", "1")
+    hist = _seq_txns([("append", "a", 1)], [("r", "a", [1])])
+    with obs.capture() as cap:
+        res = txn.check_history(hist)
+    assert res["engine"] == "txn-host-scc"
+    assert cap.fallbacks() == []
+    routes = [r for r in cap.ledger if r.get("event") == "route"
+              and r.get("stage") == "txn-closure"]
+    assert routes and routes[0]["cause"] == "host-forced"
+
+
+def test_trim_core_route():
+    hist = fixtures.gen_txn_history(60, keys=3, seed=6) + \
+        [o.with_(index=-1)
+         for o in fixtures.txn_anomaly_block("G-single")]
+    ref = txn.check_history(hist, force_host=True)
+    with obs.capture() as cap:
+        res = txn.check_history(hist, max_dense_txns=8)
+    assert res["engine"] == "txn-mxu"
+    assert res["core-txns"] < res["txns"]
+    assert res["anomalies"] == ref["anomalies"] == ["G-single"]
+    assert res["witness"] == ref["witness"]
+    assert cap.counters.get("txn.core.trimmed") == 1
+    # a clean history trims to an empty core
+    clean = fixtures.gen_txn_history(80, keys=3, seed=6)
+    res2 = txn.check_history(clean, max_dense_txns=8)
+    assert res2["valid"] is True and res2["core-txns"] == 0
+
+
+def test_trim_core_preserves_cycles_unit():
+    hist = _seq_txns(
+        [("append", "a", 1), ("append", "b", 1)],
+        [("append", "a", 2), ("append", "b", 2)],
+        [("r", "a", [1, 2]), ("r", "b", [2, 1])],   # G0 cycle T0<->T1
+        [("append", "c", 1)],                        # acyclic fringe
+        [("r", "c", [1])],
+    )
+    txns, fails = ops.collect(hist)
+    graph = infer.infer(txns, fails)
+    core_ids, core = host_ref.trim_core(graph)
+    assert set(core_ids.tolist()) == {0, 1}
+    assert host_ref.classify_booleans(core)["cyc_ww"] is True
+
+
+def test_tiled_odd_device_count_terminates():
+    # 3 devices must fall to the largest power-of-two prefix, never
+    # spin growing the (power-of-two) geometry against an odd divisor
+    devs = jax.devices()
+    assert len(devs) >= 3
+    hist = fixtures.gen_txn_history(20, seed=17) + \
+        [o.with_(index=-1) for o in fixtures.txn_anomaly_block("G0")]
+    res = txn.check_history(hist, devices=devs[:3])
+    assert res["anomalies"] == ["G0"]
+
+
+def test_tiled_closure_differential():
+    devs = jax.devices()
+    assert len(devs) > 1, "conftest forces a virtual multi-device mesh"
+    for kind in fixtures.TXN_ANOMALY_KINDS:
+        hist = fixtures.gen_txn_history(40, keys=3, seed=13) + \
+            [o.with_(index=-1) for o in fixtures.txn_anomaly_block(kind)]
+        tiled = txn.check_history(hist, devices=devs)
+        host = txn.check_history(hist, force_host=True)
+        assert tiled["engine"] == "txn-mxu-tiled"
+        assert tiled["anomalies"] == host["anomalies"] == [kind]
+        assert tiled["witness"] == host["witness"]
+    clean = fixtures.gen_txn_history(50, keys=3, seed=14)
+    assert txn.check_history(clean, devices=devs)["valid"] is True
+
+
+# -- facade / checker integration -------------------------------------------
+
+def test_auto_check_txn_selection_ledger():
+    hist = _seq_txns([("append", "a", 1)], [("r", "a", [1])])
+    with obs.capture() as cap:
+        res = facade.auto_check_txn(hist, {})
+    assert res["valid"] is True
+    sels = cap.selections()
+    assert len(sels) == 1
+    assert sels[0]["stage"].startswith("txn-")
+
+
+def test_txn_checker_composes():
+    hist = fixtures.gen_txn_history(20, seed=1) + \
+        [o.with_(index=-1) for o in fixtures.txn_anomaly_block("G1c")]
+    composed = facade.compose({"txn": txn.TxnChecker(),
+                               "stats": facade.stats()})
+    res = composed.check({}, h.index(hist))
+    assert res["valid"] is False
+    assert res["results"]["txn"]["anomalies"] == ["G1c"]
+
+
+def test_wire_accounting_counts_packed_bytes():
+    hist = _seq_txns([("append", "a", 1)], [("r", "a", [1])])
+    txns, fails = ops.collect(hist)
+    graph = infer.infer(txns, fails)
+    with obs.capture() as cap:
+        cycles.closure_booleans(graph)
+    assert cap.counters.get("transfer.packed_bytes", 0) > 0
+    # bit-packed wire is 32x under the blanket f32 reference
+    assert cap.counters["transfer.unpacked_bytes"] >= \
+        8 * cap.counters["transfer.packed_bytes"]
+
+
+# -- suite / serve / cli / web / bench ---------------------------------------
+
+def test_fake_suite_safe_mode_valid():
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import txn as txn_suite
+    t = txn_suite.txn_test(mode="linearizable", tier="fake",
+                           time_limit=0.5, seed=5, with_nemesis=True,
+                           nemesis_interval=0.2)
+    done = core.run(t)
+    r = done["results"]["results"]["txn"]
+    assert r["valid"] is True and r["txns"] > 0
+    assert r["edge-counts"]["ww"] + r["edge-counts"]["wr"] > 0
+
+
+def test_fake_cluster_sloppy_partition_anomalies():
+    from jepsen_tpu.fake import FakeCluster
+    c = FakeCluster(mode="sloppy")
+    for a in ("n1", "n2"):
+        for b in ("n3", "n4", "n5"):
+            c.drop_link(a, b)
+            c.drop_link(b, a)
+    hist = []
+    p = 0
+
+    def do(node, micros):
+        nonlocal p
+        hist.append(invoke(p, "txn", [[k, kk, None if k == "r" else v]
+                                      for k, kk, v in micros]))
+        hist.append(ok(p, "txn", c.txn(node, micros)))
+        p += 1
+
+    do("n1", [("append", "k", 1)])
+    do("n3", [("append", "k", 2)])
+    do("n1", [("r", "k", None)])        # sees [1]
+    do("n3", [("r", "k", None)])        # sees [2]: not prefix-compatible
+    res = txn.check_history(h.index(hist))
+    assert res["valid"] is False
+    assert "incompatible-order" in res["anomalies"]
+
+
+@pytest.mark.parametrize("tier", ["etcd", "redis"])
+def test_cas_tier_suite_valid(tier):
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import txn as txn_suite
+    t = txn_suite.txn_test(mode="linearizable", tier=tier,
+                           time_limit=0.5, seed=7, with_nemesis=False)
+    done = core.run(t)
+    r = done["results"]["results"]["txn"]
+    assert r["valid"] is True and r["txns"] > 0
+
+
+def test_serve_txn_route():
+    from jepsen_tpu.serve.http import Daemon
+    import urllib.error
+    import urllib.request
+    hist = fixtures.gen_txn_history(15, keys=2, seed=3) + \
+        [o.with_(index=-1) for o in fixtures.txn_anomaly_block("G0")]
+    body = json.dumps({
+        "model": "txn-list-append", "tenant": "t-a",
+        "history": [op.to_dict() for op in h.index(hist)]}).encode()
+    d = Daemon(port=0).start(dispatch=True)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{d.port}/check", data=body,
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            rid = json.loads(resp.read())["id"]
+        import time
+        deadline = time.monotonic() + 30
+        res = None
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{d.port}/check/{rid}",
+                    timeout=10) as resp:
+                res = json.loads(resp.read())
+            if res["status"] in ("done", "timeout"):
+                break
+            time.sleep(0.05)
+        assert res is not None and res["status"] == "done"
+        assert res["result"]["valid"] is False
+        assert res["result"]["anomalies"] == ["G0"]
+        assert res["result"]["engine"].startswith("txn-")
+        # malformed micro-ops are THIS client's 400 at admission, not
+        # a dispatch-time crash degrading the coalesced group
+        bad = json.dumps({
+            "model": "txn-list-append",
+            "history": [{"process": 0, "type": "invoke", "f": "txn",
+                         "value": [["bogus", "k", 1]]},
+                        {"process": 0, "type": "ok", "f": "txn",
+                         "value": [["bogus", "k", 1]]}]}).encode()
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{d.port}/check", data=bad,
+            method="POST", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req2, timeout=10)
+            assert False, "malformed txn body must be rejected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        d.shutdown()
+
+
+def test_cli_check_txn_file(tmp_path, capsys):
+    from jepsen_tpu import cli
+    hist = fixtures.gen_txn_history(20, keys=2, seed=9) + \
+        [o.with_(index=-1)
+         for o in fixtures.txn_anomaly_block("G-single")]
+    path = str(tmp_path / "history.edn")
+    h.save_edn(h.index(hist), path)
+    store_root = str(tmp_path / "store")
+    rc = cli.main(["check", path, "--store-root", store_root])
+    assert rc == 1                                  # invalid history
+    out = json.loads(capsys.readouterr().out)
+    assert out["anomalies"] == ["G-single"]
+    run_dir = out["run-dir"]
+    saved = json.load(open(os.path.join(run_dir, "results.json")))
+    assert saved["anomalies"] == ["G-single"]
+    assert saved["witness"]["cycle"]
+    # valid txn history exits 0 and auto-detects the txn route
+    clean = str(tmp_path / "clean.edn")
+    h.save_edn(fixtures.gen_txn_history(10, seed=2), clean)
+    assert cli.main(["check", clean]) == 0
+    out2 = json.loads(capsys.readouterr().out)
+    assert out2["engine"].startswith("txn-")
+
+
+def test_web_anomaly_badges(tmp_path):
+    from jepsen_tpu import web
+    assert "G0" in web._anomaly_badge("G0")
+    assert web._ANOMALY_COLORS["G0"] in web._anomaly_badge("G0")
+    # unknown anomaly strings take the existing grey badge path
+    assert "#616161" in web._anomaly_badge("G-brand-new")
+    res = {"valid": False, "anomalies": ["G1c"],
+           "witness": {"cycle": [{"txn": 0, "process": 1, "index": 2,
+                                  "value": [["append", "a", 1]]}],
+                       "edges": ["wr"]}}
+    cell = web._txn_cell(res)
+    assert "G1c" in cell and "witness cycle" in cell and "wr" in cell
+    # and the run row renders it from a persisted results.json
+    run = tmp_path / "txn-check" / "r1"
+    run.mkdir(parents=True)
+    (run / "results.json").write_text(json.dumps(res))
+    row = web._run_row(str(tmp_path), "txn-check", str(run))
+    assert "G1c" in row
+
+
+def test_bench_txn_probe_small():
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_txn_test", path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    out = bench.txn_probe(300, seed=21)
+    assert "error" not in out
+    assert out["device"]["anomalies"] == out["host"]["anomalies"]
+    assert "G-single" in out["device"]["anomalies"]
+    assert out["device"]["txns_s"] > 0 and out["host"]["txns_s"] > 0

@@ -349,6 +349,53 @@ def chunklock_trials(k: int, seed: int) -> list:
     return bad
 
 
+def txn_trials(k: int, seed: int) -> list:
+    """Transactional-checker differential: ``k`` random list-append
+    histories — roughly half with an injected ww/wr/rw cycle block of
+    a known class (``fixtures.txn_anomaly_block``) — checked by the
+    DEVICE closure engine and the host SCC reference on the same
+    inferred graph. Anomaly lists AND witness cycles must be
+    identical, and an injected class must be detected. Returns
+    mismatch dicts (empty = clean)."""
+    import random as _random
+
+    from jepsen_tpu import fixtures, txn
+
+    rng = _random.Random(seed)
+    bad = []
+    t0 = time.monotonic()
+    for t in range(k):
+        s = rng.randrange(1 << 30)
+        n_txns = rng.randrange(10, 120)
+        keys = rng.randrange(2, 5)
+        crash_p = rng.choice((0.0, 0.0, 0.1))
+        h = fixtures.gen_txn_history(n_txns, keys=keys, processes=5,
+                                     crash_p=crash_p, seed=s)
+        injected = None
+        if rng.random() < 0.5:
+            injected = rng.choice(fixtures.TXN_ANOMALY_KINDS)
+            h = h + [op.with_(index=-1) for op in
+                     fixtures.txn_anomaly_block(injected)]
+        dev = txn.check_history(h)
+        host = txn.check_history(h, force_host=True)
+        entry = {"trial": t, "seed": s, "injected": injected,
+                 "device": dev.get("anomalies"),
+                 "host": host.get("anomalies"),
+                 "engine": dev.get("engine")}
+        ok = (dev.get("valid") == host.get("valid")
+              and dev.get("anomalies") == host.get("anomalies")
+              and dev.get("witness") == host.get("witness"))
+        if injected is not None:
+            ok = ok and injected in (dev.get("anomalies") or ())
+        if not ok:
+            bad.append(entry)
+            print(f"TXN MISMATCH {entry}", file=sys.stderr)
+        if t % 25 == 24:
+            print(f"txn {t + 1}/{k} ok "
+                  f"({time.monotonic() - t0:.0f}s)", flush=True)
+    return bad
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=1000)
@@ -362,6 +409,11 @@ def main() -> int:
     ap.add_argument("--chunklock", type=int, default=0, metavar="K",
                     help="additionally run K engine-scale chunk-lockstep "
                          "trials vs the C++ WGL engine (real chip)")
+    ap.add_argument("--txn", type=int, default=0, metavar="K",
+                    help="additionally run K transactional-checker "
+                         "trials (random list-append histories with "
+                         "injected ww/wr/rw cycles; device closure vs "
+                         "host SCC every trial)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -382,6 +434,9 @@ def main() -> int:
         ckl_bad: list = []
         if args.chunklock:
             ckl_bad = chunklock_trials(args.chunklock, args.seed + 99)
+        txn_bad: list = []
+        if args.txn:
+            txn_bad = txn_trials(args.txn, args.seed + 777)
     # observability over the whole fuzz session: silent-degradation
     # counters (pallas → XLA downgrades, swallowed checker crashes,
     # lockstep → per-key fallbacks) become greppable output instead of
@@ -390,18 +445,20 @@ def main() -> int:
                     if k.startswith(("reach.", "engine.fallback.",
                                      "engine.skipped.",
                                      "checker.swallowed.",
-                                     "lockstep."))}
+                                     "lockstep.", "txn."))}
     print(json.dumps({
         "trials": args.n, "mismatches": len(mismatches),
         "invalid_histories": invalid_seen,
         "chunklock_trials": args.chunklock,
         "chunklock_mismatches": len(ckl_bad),
+        "txn_trials": args.txn,
+        "txn_mismatches": len(txn_bad),
         "swallowed_checker_crashes": sum(
             v for k, v in cap.counters.items()
             if k.startswith("checker.swallowed.")),
         "obs": obs_counters,
         "elapsed_s": round(time.monotonic() - t0, 1)}))
-    return 1 if (mismatches or ckl_bad) else 0
+    return 1 if (mismatches or ckl_bad or txn_bad) else 0
 
 
 if __name__ == "__main__":
