@@ -2039,6 +2039,14 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
 
     def _producer() -> None:
         try:
+            if os.environ.get("JEPSEN_TPU_SERVE_FAULTS"):
+                # self-nemesis hook (jepsen_tpu/serve/faults.py):
+                # injected prep-thread death — exercises the
+                # exactly-once stream-prep fallback from a REAL chaos
+                # daemon process. Env-gated so a clean run never
+                # imports the fault module here.
+                from jepsen_tpu.serve import faults as _serve_faults
+                _serve_faults.fire("prep")
             for gi, g in enumerate(groups):
                 if stop.is_set():
                     return

@@ -154,7 +154,12 @@ def _cmd_check_serve(args) -> int:
         group=args.group,
         engine_kw=engine_kw,
         store_root=args.store_root,
-        persist=not args.no_persist_runs)
+        persist=not args.no_persist_runs,
+        journal=not args.no_journal,
+        breaker=serve.CircuitBreaker(
+            threshold=args.breaker_threshold,
+            cooldown_s=args.breaker_cooldown),
+        dispatch_deadline_s=args.dispatch_deadline or None)
 
     def _term(signum, frame):
         # SIGTERM == the orchestrator's polite stop: drain, then exit
@@ -335,6 +340,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     csp.add_argument("--no-persist-runs", action="store_true",
                      help="do not write completed checks into the "
                           "store")
+    csp.add_argument("--no-journal", action="store_true",
+                     help="disable the durable admission journal "
+                          "(admitted requests then do NOT survive a "
+                          "daemon crash)")
+    csp.add_argument("--breaker-threshold", type=int, default=5,
+                     help="consecutive device-path failures that "
+                          "open the circuit breaker (degraded "
+                          "host-side serving)")
+    csp.add_argument("--breaker-cooldown", type=float, default=15.0,
+                     help="seconds an open breaker waits before its "
+                          "half-open device probe")
+    csp.add_argument("--dispatch-deadline", type=float, default=0.0,
+                     help="wall-clock cap per dispatch; a hung "
+                          "dispatch past it is aborted and its "
+                          "survivors requeued (0 = no cap)")
     csp.set_defaults(fn=_cmd_check_serve)
 
     ckp = sub.add_parser(

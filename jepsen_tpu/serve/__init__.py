@@ -23,6 +23,15 @@ Layers (one module each):
 - :mod:`http` — the stdlib HTTP protocol (``POST /check``,
   ``GET /check/<id>``, ``GET /stats``) and the :class:`Daemon`
   composition root.
+- :mod:`journal` — the durable admission journal (WAL): admitted
+  requests survive SIGKILL, replay on restart under their original
+  ids, and dedup duplicate POSTs by idempotency key.
+- :mod:`recovery` — deterministic bounded-backoff retry, group
+  bisection (poison quarantine), and the device-path circuit
+  breaker behind degraded host-side serving.
+- :mod:`faults` — the self-nemesis: test-only fault points
+  (dispatch/device/prep/persist/clock-jump) the chaos harness
+  (``tools/chaos.py``) arms against a real daemon.
 
 Quick start::
 
@@ -39,12 +48,16 @@ from jepsen_tpu.serve.coalesce import (AdmissionQueue, Backpressure,
                                        plan_admission)
 from jepsen_tpu.serve.engine import Dispatcher
 from jepsen_tpu.serve.http import Daemon, parse_check_body, resolve_model
+from jepsen_tpu.serve.journal import Journal
+from jepsen_tpu.serve.recovery import CircuitBreaker, RetryPolicy
 from jepsen_tpu.serve.request import (CANCELLED, DISPATCHED, DONE,
-                                      QUEUED, TIMEOUT, CheckRequest,
-                                      Registry)
+                                      QUARANTINED, QUEUED, TIMEOUT,
+                                      CheckRequest, Registry)
 
 __all__ = [
     "AdmissionQueue", "Backpressure", "plan_admission", "Dispatcher",
     "Daemon", "parse_check_body", "resolve_model", "CheckRequest",
-    "Registry", "QUEUED", "DISPATCHED", "DONE", "TIMEOUT", "CANCELLED",
+    "Registry", "Journal", "CircuitBreaker", "RetryPolicy",
+    "QUEUED", "DISPATCHED", "DONE", "TIMEOUT", "CANCELLED",
+    "QUARANTINED",
 ]

@@ -107,9 +107,15 @@ class AdmissionQueue:
         self.on_timeout: Optional[Callable[[rq.CheckRequest], None]] = None
 
     # -- admission -------------------------------------------------------
-    def submit(self, req: "rq.CheckRequest") -> None:
+    def submit(self, req: "rq.CheckRequest",
+               force: bool = False) -> None:
+        """Admit one request. ``force`` bypasses the depth bound —
+        used ONLY for journal replay (already-admitted work whose 202
+        was returned before the crash must not bounce off its own
+        backlog) and for hung-dispatch requeues (the request already
+        holds a queue slot's worth of accounting)."""
         with self._nonempty:
-            if len(self._queued) >= self.max_depth:
+            if not force and len(self._queued) >= self.max_depth:
                 obs.count("serve.rejected.backpressure")
                 obs.engine_fallback("serve-admit", "Backpressure",
                                     tenant=req.tenant, ops=req.packed.n,

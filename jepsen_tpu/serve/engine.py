@@ -25,8 +25,17 @@ hook: the group aborts (cleanly, at a segment boundary) once EVERY
 live member is expired or cancelled; an individual member whose
 deadline passes mid-walk keeps the group running for its co-tenants
 but reports ``timeout`` itself. A dispatch exception never kills the
-daemon — every member gets a contained ``"unknown"`` verdict and the
-crash lands in the obs ledger (``serve-dispatch`` fallback).
+daemon — it enters the recovery ladder (``serve/recovery.py``):
+deterministic bounded-backoff retry of the whole group, then group
+bisection to corner a poison member (quarantined with a structured
+error; the innocent majority completes), with a host-side rescue
+before any quarantine. Repeated device-path failures open a circuit
+breaker that routes groups to the host checkers (verdicts identical,
+slower) until a half-open probe heals it; a dispatch hung past its
+wall-clock cap aborts via the same ``should_abort`` composition and
+its survivors requeue. Every rung lands in the obs ledger
+(``serve-dispatch`` / ``serve-retry`` / ``serve-quarantine`` /
+``serve-breaker`` / ``serve-hang``).
 """
 from __future__ import annotations
 
@@ -39,6 +48,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from jepsen_tpu import obs
+from jepsen_tpu.serve import faults, recovery
 from jepsen_tpu.serve import request as rq
 from jepsen_tpu.serve.coalesce import AdmissionQueue
 
@@ -117,12 +127,27 @@ class Dispatcher:
     def __init__(self, queue: AdmissionQueue, registry: "rq.Registry",
                  *, engine_kw: Optional[Dict[str, Any]] = None,
                  store_root: Optional[str] = None,
-                 persist: bool = False) -> None:
+                 persist: bool = False,
+                 retry_policy: Optional[recovery.RetryPolicy] = None,
+                 breaker: Optional[recovery.CircuitBreaker] = None,
+                 dispatch_deadline_s: Optional[float] = None,
+                 journal: Optional[Any] = None) -> None:
         self.queue = queue
         self.registry = registry
         self.engine_kw = dict(engine_kw or {})
         self.store_root = store_root
         self.persist = persist and store_root is not None
+        # recovery discipline (serve/recovery.py): deterministic
+        # bounded retry + bisect quarantine, the device-path circuit
+        # breaker, and the hung-dispatch wall-clock cap past which the
+        # group's should_abort fires and survivors requeue
+        self.retry = retry_policy or recovery.RetryPolicy()
+        self.breaker = breaker or recovery.CircuitBreaker()
+        self.dispatch_deadline_s = dispatch_deadline_s
+        self.journal = journal          # durable WAL (set by Daemon)
+        # per-dispatch attribution flag, dispatcher-thread-only: did
+        # any engine attempt actually touch the device this iteration
+        self._device_ran = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.dispatch_counts: Dict[str, int] = {}
@@ -184,6 +209,23 @@ class Dispatcher:
             self._profile_maybe_start()
             try:
                 self._dispatch(batch)
+            except Exception as e:                      # noqa: BLE001
+                # LAST-resort containment: the recovery ladder inside
+                # _dispatch handles engine failures; anything escaping
+                # it (bookkeeping bugs, injected tick faults) must not
+                # kill the dispatcher thread or strand the batch
+                log.error("dispatch iteration crashed: %r", e,
+                          exc_info=e)
+                obs.engine_fallback("serve-dispatch",
+                                    type(e).__name__,
+                                    lanes=len(batch), iteration=True)
+                now = time.monotonic()
+                for r in batch:
+                    if not r.terminal:
+                        self._finish(r, {"valid": "unknown",
+                                         "error": f"{type(e).__name__}"
+                                                  f": {e}"},
+                                     0.0, now)
             finally:
                 self.queue.mark_done(batch)
                 obs.gauge("serve.inflight", 0)
@@ -261,9 +303,189 @@ class Dispatcher:
         self._profile_active = False
         self._profile_left = 0
 
-    def _dispatch(self, batch: List["rq.CheckRequest"]) -> None:
+    # -- engine attempts (the recovery ladder's rungs) -------------------
+    @staticmethod
+    def _is_txn(model) -> bool:
+        from jepsen_tpu.txn.ops import ListAppend
+        return isinstance(model, ListAppend)
+
+    def _padded_list(self, batch: List["rq.CheckRequest"]):
+        """Quantize the lane count to a power of two by replicating
+        the LONGEST member (its verdict is recomputed and discarded;
+        padding with the longest keeps the group's padded step count
+        unchanged): a serving daemon sees every group width 1..group
+        over its life, and each distinct H is a distinct compiled
+        kernel geometry — the pad bounds that churn to log2(group)
+        geometries a warmup can prime. JEPSEN_TPU_SERVE_NO_PAD=1
+        dispatches raw widths. Transactional groups never pad (the
+        txn closure kernel pads its own geometry internally)."""
+        packed_list = [r.packed for r in batch]
+        pad = self._pad_count(len(batch), self._is_txn(batch[0].model))
+        if pad > 0:
+            longest = max(packed_list, key=lambda p: p.n)
+            packed_list = packed_list + [longest] * pad
+        return packed_list, pad
+
+    def _pad_count(self, n_real: int, is_txn: bool) -> int:
+        if n_real <= 1 or is_txn \
+                or os.environ.get("JEPSEN_TPU_SERVE_NO_PAD"):
+            return 0
+        Hq = 1 << (n_real - 1).bit_length()
+        # never pad past the configured group width: the engine-side
+        # re-plan splits oversized groups, which would both defeat the
+        # pad and break the admission/engine plan agreement
+        cap = int(self.engine_kw.get("group") or 0) or 32
+        Hq = min(Hq, max(cap, n_real))
+        return max(0, Hq - n_real)
+
+    def _run_engine(self, batch: List["rq.CheckRequest"],
+                    kw: Dict[str, Any],
+                    feed_breaker: bool = True) -> List[Dict[str, Any]]:
+        """ONE engine attempt for the (sub)group: consult the circuit
+        breaker for the route, run it, feed the outcome back. Raises
+        on failure — recovery policy lives in :meth:`_run_recover`.
+
+        ``feed_breaker=False`` (the bisect hunt's sub-attempts) still
+        records SUCCESSES (they are honest evidence of device health)
+        but not failures: one poison request failing its way down a
+        bisect ladder is log2(n) failures from a single bad REQUEST,
+        and must not open a breaker that speaks for the DEVICE."""
+        from jepsen_tpu.checkers import facade
+        tenants = [r.tenant for r in batch]
+        # the self-nemesis "dispatch" point models a poison request
+        # that crashes the checker on EVERY route; "device" models a
+        # device-path outage (the breaker's food)
+        faults.fire("dispatch", tenants=tenants)
+        if self.breaker.route() == "host":
+            obs.count("serve.breaker.degraded_dispatches")
+            obs.decision("serve-breaker", "route", cause="host",
+                         lanes=len(batch))
+            return self._run_host(batch, kw, fire_point=False)
         req0 = batch[0]
-        model = req0.model
+        try:
+            faults.fire("device", tenants=tenants)
+            # attribution flag: some device work ran this dispatch
+            # iteration (even a failed attempt spent device time)
+            self._device_ran = True
+            with obs.span("serve.dispatch",
+                          model=req0.model_name, lanes=len(batch)):
+                if self._is_txn(req0.model):
+                    # one txn chain per member: host dependency
+                    # inference is per-history; the closure kernel
+                    # geometry is shared across members via its
+                    # power-of-two pad + jit cache
+                    results = [facade.auto_check_txn(
+                        list(r.history), kw) for r in batch]
+                elif len(batch) == 1:
+                    results = [facade.auto_check_packed(
+                        req0.model, req0.packed, kw)]
+                else:
+                    packed_list, pad = self._padded_list(batch)
+                    if pad:
+                        obs.count("serve.pad_lanes", pad)
+                    results = facade.auto_check_many_packed(
+                        req0.model, packed_list, kw)[:len(batch)]
+        except Exception:
+            if feed_breaker:
+                self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return results
+
+    def _run_host(self, batch: List["rq.CheckRequest"],
+                  kw: Dict[str, Any],
+                  fire_point: bool = True) -> List[Dict[str, Any]]:
+        """The degraded route: host-side checkers, per member —
+        verdicts identical to the device chain (the Python WGL oracle
+        / forced-host txn closure are the same reference the engines
+        are differentially tested against), just slower. Used while
+        the breaker is open and as the singleton quarantine rescue."""
+        from jepsen_tpu.checkers import facade, wgl_ref
+        if fire_point:
+            faults.fire("dispatch",
+                        tenants=[r.tenant for r in batch])
+        req0 = batch[0]
+        out = []
+        with obs.span("serve.dispatch-host",
+                      model=req0.model_name, lanes=len(batch)):
+            for r in batch:
+                if self._is_txn(r.model):
+                    res = facade.auto_check_txn(
+                        list(r.history), dict(kw, force_host=True))
+                else:
+                    res = wgl_ref.check_packed(
+                        r.model, r.packed,
+                        **facade._engine_kw(kw, facade._WGL_KW))
+                    res["engine"] = res.get("engine", "wgl-cpu")
+                res["degraded"] = True
+                out.append(res)
+        return out
+
+    def _run_recover(self, batch: List["rq.CheckRequest"],
+                     kw: Dict[str, Any],
+                     retries_left: int,
+                     top_level: bool = True) -> List[Dict[str, Any]]:
+        """The recovery ladder: attempt → deterministic bounded-backoff
+        retry → group bisect to corner the poison member → host-side
+        rescue → quarantine. Innocent members of a poisoned group
+        complete; only the member that fails ALONE (on both routes) is
+        quarantined, with a structured error and an obs record."""
+        attempt = 0
+        err: Optional[Exception] = None
+        while True:
+            try:
+                return self._run_engine(batch, kw,
+                                        feed_breaker=top_level)
+            except Exception as e:                      # noqa: BLE001
+                err = e
+                log.warning("serve dispatch failed (lanes=%d, "
+                            "attempt=%d): %r", len(batch), attempt, e,
+                            exc_info=e)
+                obs.engine_fallback("serve-dispatch",
+                                    type(e).__name__,
+                                    lanes=len(batch), attempt=attempt)
+            if self._stop.is_set():
+                return [{"valid": "unknown",
+                         "error": f"{type(err).__name__}: {err}"}
+                        for _ in batch]
+            if retries_left <= 0:
+                break
+            retries_left -= 1
+            obs.count("serve.retry.attempts")
+            time.sleep(self.retry.delay(attempt))
+            attempt += 1
+        if len(batch) > 1:
+            # isolate the poison: halves get one attempt each and
+            # bisect further on failure — O(log n) extra dispatches
+            # to corner one bad member while the rest complete
+            obs.count("serve.retry.bisects")
+            obs.decision("serve-retry", "bisect", lanes=len(batch),
+                         cause=type(err).__name__)
+            lo, hi = recovery.bisect(batch)
+            return self._run_recover(lo, kw, 0, top_level=False) \
+                + self._run_recover(hi, kw, 0, top_level=False)
+        # a singleton that failed its attempts: one last host-side
+        # rescue (device flakiness must not quarantine an innocent
+        # request), then quarantine with a structured error
+        req = batch[0]
+        try:
+            obs.decision("serve-retry", "host-rescue", id=req.id)
+            return self._run_host(batch, kw)
+        except Exception as e:                          # noqa: BLE001
+            obs.count("serve.quarantined")
+            obs.engine_fallback("serve-quarantine", type(e).__name__,
+                                id=req.id, tenant=req.tenant,
+                                ops=int(req.n_ops))
+            log.warning("quarantining request %s: %r", req.id, e)
+            return [{"valid": "unknown", "quarantined": True,
+                     "cause": "quarantined",
+                     "error": f"{type(e).__name__}: {e}"}]
+
+    def _dispatch(self, batch: List["rq.CheckRequest"]) -> None:
+        # the self-nemesis trigger clock (scheduled clock jumps fire
+        # here); never raises for the shipped fault grammar
+        faults.fire("tick")
+        req0 = batch[0]
         sig = f"{req0.model_name}/H{len(batch)}"
         with self._counts_lock:
             self.dispatch_counts[sig] = \
@@ -281,11 +503,25 @@ class Dispatcher:
                 r.tenant, "dispatched", id=r.id, group=len(batch),
                 ops=int(r.packed.n))
 
+        hang = [False]
+
         def _aborted() -> bool:
             # clean group cancellation: fires only when NO member
             # still wants the verdict (composed into the segmented
-            # walk's abort polling by the facade chain)
+            # walk's abort polling by the facade chain) — or when the
+            # dispatch itself hangs past its wall-clock cap, in which
+            # case survivors are requeued rather than finished
             if self._stop.is_set():
+                return True
+            if self.dispatch_deadline_s is not None \
+                    and time.monotonic() - t0 > self.dispatch_deadline_s:
+                if not hang[0]:
+                    hang[0] = True
+                    obs.engine_fallback("serve-hang",
+                                        "DispatchDeadline",
+                                        lanes=len(batch),
+                                        deadline_s=self
+                                        .dispatch_deadline_s)
                 return True
             now = time.monotonic()
             return all(r.cancel_requested or r.expired(now)
@@ -298,64 +534,25 @@ class Dispatcher:
         kw = dict(self.engine_kw)
         kw.update(req0.opts)
         kw["should_abort"] = _aborted
-        # quantize the lane count to a power of two by replicating the
-        # LONGEST member (its verdict is recomputed and discarded;
-        # padding with the longest keeps the group's padded step count
-        # unchanged): a serving daemon sees every group width 1..group
-        # over its life, and each distinct H is a distinct compiled
-        # kernel geometry — the pad bounds that churn to log2(group)
-        # geometries a warmup can prime. JEPSEN_TPU_SERVE_NO_PAD=1
-        # dispatches raw widths.
         n_real = len(batch)
-        packed_list = [r.packed for r in batch]
-        # transactional groups: the txn chain is host inference + the
-        # closure kernel (whose geometry pads to a power of two
-        # INTERNALLY), so the lane-count pad below — a dense-walk
-        # geometry concern — does not apply
-        from jepsen_tpu.txn.ops import ListAppend as _ListAppend
-        is_txn = isinstance(model, _ListAppend)
-        pad = 0
-        if n_real > 1 and not is_txn \
-                and not os.environ.get("JEPSEN_TPU_SERVE_NO_PAD"):
-            Hq = 1 << (n_real - 1).bit_length()
-            # never pad past the configured group width: the
-            # engine-side re-plan splits oversized groups, which would
-            # both defeat the pad and break the admission/engine plan
-            # agreement
-            cap = int(self.engine_kw.get("group") or 0) or 32
-            Hq = min(Hq, max(cap, n_real))
-            longest = max(packed_list, key=lambda p: p.n)
-            pad = max(0, Hq - n_real)
-            if pad > 0:
-                packed_list = packed_list + [longest] * pad
-                obs.count("serve.pad_lanes", pad)
+        # pad for ATTRIBUTION (the engine attempts compute their own
+        # replication pad per subgroup; this is the full-group value,
+        # so device_s + pad_waste_s == dispatch_wall_s by construction)
+        pad = self._pad_count(n_real, self._is_txn(req0.model))
         # the dispatcher thread's own obs records (fallbacks, engine
-        # selections from the facade chain, the serve-dispatch crash
-        # containment) are captured here and re-emitted into every
-        # member request's stitched trace below — ledgers are
-        # thread-isolated, so without this a client-side
+        # selections from the facade chain, retry/bisect/quarantine
+        # records from the recovery ladder) are captured here and
+        # re-emitted into every member request's stitched trace below
+        # — ledgers are thread-isolated, so without this a client-side
         # obs.capture() around submit/poll would never see them
+        self._device_ran = False
         with obs.capture() as cap:
             try:
-                from jepsen_tpu.checkers import facade
-                with obs.span("serve.dispatch",
-                              model=req0.model_name,
-                              lanes=len(batch)):
-                    if is_txn:
-                        # one txn chain per member: host dependency
-                        # inference is per-history; the closure
-                        # kernel geometry is shared across members
-                        # via its power-of-two pad + jit cache
-                        results = [facade.auto_check_txn(
-                            list(r.history), kw) for r in batch]
-                    elif len(batch) == 1:
-                        results = [facade.auto_check_packed(
-                            model, req0.packed, kw)]
-                    else:
-                        results = facade.auto_check_many_packed(
-                            model, packed_list, kw)[:n_real]
+                results = self._run_recover(batch, kw,
+                                            self.retry.max_retries)
             except Exception as e:                      # noqa: BLE001
-                log.warning("serve dispatch crashed: %r", e,
+                # the ladder itself must be crash-contained too
+                log.warning("serve recovery ladder crashed: %r", e,
                             exc_info=e)
                 obs.engine_fallback("serve-dispatch",
                                     type(e).__name__,
@@ -363,6 +560,15 @@ class Dispatcher:
                 err = {"valid": "unknown",
                        "error": f"{type(e).__name__}: {e}"}
                 results = [dict(err) for _ in batch]
+        if len(results) != len(batch):
+            # alignment is the publish contract: a short list would
+            # silently strand the tail members un-finished forever
+            obs.engine_fallback("serve-dispatch", "ResultMisaligned",
+                                lanes=len(batch), got=len(results))
+            results = (list(results)
+                       + [{"valid": "unknown",
+                           "error": "result misaligned"}]
+                      * len(batch))[:len(batch)]
         t_collect = time.monotonic()
         elapsed = t_collect - t0
 
@@ -373,11 +579,19 @@ class Dispatcher:
         # attributed device-seconds reconcile with dispatch wall by
         # construction (asserted within 2% in tests).
         lanes = n_real + pad
-        share = elapsed / lanes
-        waste = share * pad
-        obs.histogram("serve.dispatch_wall_s", elapsed)
-        obs.count("serve.device_s", share * n_real)
-        obs.count("serve.pad_waste_s", waste)
+        if self._device_ran:
+            share = elapsed / lanes
+            waste = share * pad
+            obs.histogram("serve.dispatch_wall_s", elapsed)
+            obs.count("serve.device_s", share * n_real)
+            obs.count("serve.pad_waste_s", waste)
+        else:
+            # breaker-open dispatch served entirely host-side: no
+            # kernel wall, no pad lanes — booking it as device time
+            # would corrupt the attribution operators read during a
+            # degraded period (its wall gets its own counter)
+            share = waste = 0.0
+            obs.count("serve.breaker.host_wall_s", elapsed)
 
         # stitched per-request trace: the group-level dispatch record
         # plus every ledger record the dispatch produced, re-emitted
@@ -407,14 +621,42 @@ class Dispatcher:
                         req.tenant, f"engine-{r['event']}",
                         id=req.id, stage=r.get("stage"),
                         cause=r.get("cause"))
-            self._finish(req, res, elapsed, now)
+            if (hang[0] and res.get("valid") not in (True, False)
+                    and not res.get("quarantined")
+                    and not req.cancel_requested
+                    and not req.expired(now)
+                    and req.requeues < self.retry.max_requeues):
+                # a hung dispatch was aborted past its wall-clock cap:
+                # this survivor still wants its verdict — requeue it
+                # (bounded by the shared retry policy) instead of
+                # publishing the abort's "unknown"
+                self._requeue(req)
+            else:
+                self._finish(req, res, elapsed, now)
 
     # -- completion ------------------------------------------------------
+    def _requeue(self, req: "rq.CheckRequest") -> None:
+        req.requeues += 1
+        req.status = rq.QUEUED
+        req.t_coalesce = req.t_dispatch = req.t_collect = None
+        obs.count("serve.retry.requeued")
+        obs.decision("serve-retry", "requeued", id=req.id,
+                     requeues=req.requeues)
+        self.registry.ledger_record(req.tenant, "requeued", id=req.id,
+                                    requeues=req.requeues)
+        self.queue.submit(req, force=True)
+
     def _finish(self, req: "rq.CheckRequest", res: Dict[str, Any],
                 elapsed: float, now: float) -> None:
         if req.cancel_requested:
             status = rq.CANCELLED
             obs.count("serve.cancelled")
+        elif res.get("quarantined"):
+            # the bisect ladder cornered this member as the poison:
+            # structured terminal state, never a silent "unknown"
+            # (counters/ledger records bumped where the quarantine
+            # decision was made, in _run_recover)
+            status = rq.QUARANTINED
         elif req.expired(now) and res.get("valid") not in (True, False):
             # the walk was aborted (or still unknown) past the
             # deadline: a timeout, not a verdict
@@ -446,8 +688,12 @@ class Dispatcher:
                 req.t_done = now
                 req.run_dir = self._persist(req, res)
             except Exception as e:                      # noqa: BLE001
+                # never silent: the verdict still publishes, but the
+                # missing run dir is a recorded degradation
                 log.warning("serve persist failed for %s: %s",
                             req.id, e)
+                obs.engine_fallback("serve-persist",
+                                    type(e).__name__, id=req.id)
         self.registry.finish(req, status, res)
         self.registry.ledger_record(
             req.tenant, status, id=req.id,
@@ -479,6 +725,7 @@ class Dispatcher:
         ``web.py`` results browser renders daemon traffic exactly
         like CLI runs."""
         from jepsen_tpu import store
+        faults.fire("persist", tenants=[req.tenant])
         assert self.store_root is not None
         out = dict(res)
         out["serve"] = {"id": req.id, "tenant": req.tenant,
@@ -518,7 +765,15 @@ class Dispatcher:
                            if k.startswith("serve.")},
             "timeseries": self.ring.points(),
             "profile": self.profile_state(),
+            # degradation surface: breaker state + retry policy, so
+            # /stats, stats.json, and the /engine dashboard all see
+            # the same health the chaos harness asserts on
+            "breaker": self.breaker.to_json(),
+            "degraded": self.breaker.degraded,
+            "retry": self.retry.to_json(),
         }
+        if self.journal is not None:
+            out["journal"] = self.journal.stats()
         out.update(self.registry.stats())
         return out
 

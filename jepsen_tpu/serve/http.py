@@ -7,6 +7,11 @@
        "history": [{"process":0,"type":"invoke","f":"read"}, ...],
        "tenant": "team-a",                 # or X-Tenant header
        "timeout-s": 30.0,                  # optional deadline
+       "idempotency-key": "job-17",        # optional dedup key:
+                                           # duplicate POSTs return
+                                           # the ORIGINAL id (the
+                                           # window survives restarts
+                                           # via the journal)
        "options": {"max_states": 100000}}  # engine kw (allow-listed)
 
   ``Content-Type: application/edn`` parses the SAME shape from EDN
@@ -18,10 +23,17 @@
   checker verdict (witness included) once ``status`` is terminal,
   plus the stage ``waterfall`` (admit→coalesce→walk→publish), the
   stitched dispatcher ``trace``, and the request's attributed
-  ``device-s``. ``DELETE /check/<id>`` cancels a queued request.
+  ``device-s``. A quarantined request (the isolated poison member of
+  a crashed dispatch group) answers a structured **500**. Verdicts
+  published just before a crash answer from the journal's completion
+  marker after restart. ``DELETE /check/<id>`` cancels a queued
+  request (journal-only entries get their cancelled marker, so a
+  restart cannot resurrect them).
 - ``GET /stats`` — queue depths, per-tenant ledger counts, cache
   counters, per-geometry dispatch counts, latency-histogram digests,
-  and the rolling time-series ring. ``GET /healthz`` — liveness.
+  breaker/journal state, and the rolling time-series ring.
+  ``GET /healthz`` — liveness + degradation (breaker state, journal
+  backlog).
 - ``GET /metrics`` — Prometheus text exposition (every counter,
   numeric gauge, and latency histogram with ``_bucket``/``_sum``/
   ``_count`` series; scrape-ready).
@@ -32,16 +44,24 @@
 from __future__ import annotations
 
 import json
+import logging
 import threading
+import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from jepsen_tpu import edn
 from jepsen_tpu import history as h
+from jepsen_tpu import obs
 from jepsen_tpu.op import Op
+from jepsen_tpu.serve import faults, recovery
+from jepsen_tpu.serve import journal as jr
 from jepsen_tpu.serve import request as rq
 from jepsen_tpu.serve.coalesce import AdmissionQueue, Backpressure
 from jepsen_tpu.serve.engine import Dispatcher
+
+log = logging.getLogger("jepsen.serve")
 
 # engine options a client may set per request — bounded to the knobs
 # that cannot destabilize co-tenants (no devices=, no interpret=)
@@ -73,9 +93,10 @@ def resolve_model(name: str):
 def parse_check_body(body: bytes, content_type: str,
                      default_tenant: str = "anonymous"
                      ) -> Tuple[str, str, list, Dict[str, Any],
-                                Optional[float]]:
+                                Optional[float], Optional[str]]:
     """Decode a POST /check body -> (tenant, model_name, ops,
-    options, timeout_s). Raises ValueError on malformed input."""
+    options, timeout_s, idempotency_key). Raises ValueError on
+    malformed input."""
     text = body.decode("utf-8")
     if "edn" in (content_type or ""):
         vals = edn.loads_all(text)
@@ -104,7 +125,12 @@ def parse_check_body(body: bytes, content_type: str,
         timeout_s = float(timeout_s)
         if timeout_s <= 0:
             raise ValueError("'timeout-s' must be positive")
-    return tenant, model_name, ops, options, timeout_s
+    # client-supplied idempotency key: duplicate POSTs with the same
+    # key dedup to the original request id (bounded-length, like
+    # tenant names — it keys bounded daemon state)
+    idem = data.get("idempotency-key", data.get("idempotency_key"))
+    idem = str(idem)[:128] if idem is not None else None
+    return tenant, model_name, ops, options, timeout_s, idem
 
 
 class Daemon:
@@ -124,16 +150,46 @@ class Daemon:
                  engine_kw: Optional[Dict[str, Any]] = None,
                  store_root: Optional[str] = None,
                  persist: bool = False,
-                 max_body_bytes: int = 32 << 20) -> None:
+                 max_body_bytes: int = 32 << 20,
+                 journal: bool = True,
+                 journal_keep_terminal: int = 256,
+                 retry_policy: Optional[recovery.RetryPolicy] = None,
+                 breaker: Optional[recovery.CircuitBreaker] = None,
+                 dispatch_deadline_s: Optional[float] = None) -> None:
         # the queue bounds request COUNT; this bounds request BYTES —
         # both are needed for "backpressure, never OOM": worst-case
         # queued history memory is queue_depth * max_body_bytes-ish
         self.max_body_bytes = int(max_body_bytes)
+        # self-nemesis faults arm from the environment here so a
+        # chaos-harness daemon subprocess carries its fault schedule
+        faults.arm_from_env()
         self.registry = rq.Registry()
         self.queue = AdmissionQueue(
             max_depth=queue_depth,
             max_inflight_per_tenant=max_inflight_per_tenant,
             group=group)
+        # durable admission journal (WAL): admitted requests are
+        # journaled before their 202 and replayed on restart — only
+        # with a store root (durability needs somewhere durable)
+        self.journal: Optional[jr.Journal] = None
+        if journal and store_root is not None:
+            from jepsen_tpu import store
+            self.journal = jr.Journal(
+                store.serve_journal_dir(store_root),
+                keep_terminal=journal_keep_terminal)
+        # (tenant, idempotency key) -> request id (bounded; seeded
+        # from the journal so the dedup window survives restarts;
+        # tenant-scoped so one tenant's key cannot map onto — or leak
+        # the status of — another tenant's request)
+        self._idem_lock = threading.Lock()
+        self._idem: "OrderedDict[Any, str]" = OrderedDict()
+        # ids whose admission is IN FLIGHT on some HTTP worker thread:
+        # a concurrent duplicate that hits the index before the winner
+        # finishes journaling must dedup to the winner, not race past
+        # it (check-then-act would admit both)
+        self._admitting: set = set()
+        if self.journal is not None:
+            self._idem.update(self.journal.idempotency_index())
         # the coalescer's group width rides into the engine-side
         # re-plan (facade filters it to check_many's `group=`): both
         # planners must agree on the dispatch width or the admission
@@ -143,7 +199,19 @@ class Daemon:
         self.dispatcher = Dispatcher(self.queue, self.registry,
                                      engine_kw=ekw,
                                      store_root=store_root,
-                                     persist=persist)
+                                     persist=persist,
+                                     retry_policy=retry_policy,
+                                     breaker=breaker,
+                                     dispatch_deadline_s=
+                                     dispatch_deadline_s,
+                                     journal=self.journal)
+        if self.journal is not None:
+            # every terminal transition — dispatcher publish, queued
+            # timeout, cancel — marks the WAL entry complete, so a
+            # restart never resurrects finished (or cancelled) work
+            jnl = self.journal
+            self.registry.on_terminal = (
+                lambda req: jnl.finish(req.id, req.status, req.result))
         handler = type("Handler", (_Handler,), {"daemon_ref": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._serve_thread: Optional[threading.Thread] = None
@@ -159,6 +227,7 @@ class Daemon:
         engine behind the queue."""
         if dispatch:
             self.dispatcher.start()
+            self.replay_journal()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http",
             daemon=True)
@@ -169,6 +238,7 @@ class Daemon:
         """Foreground mode (the CLI): blocks until interrupted, then
         shuts down gracefully."""
         self.dispatcher.start()
+        self.replay_journal()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -180,21 +250,161 @@ class Daemon:
         self.accepting = False
         drained = self.dispatcher.drain(timeout=drain_timeout)
         self.dispatcher.stop()
-        self.httpd.shutdown()
+        if self._serve_thread is not None:
+            # BaseServer.shutdown() handshakes with the serve loop; on
+            # a daemon whose HTTP side never started (replay-only
+            # tests, failed startups) it would wait forever
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._serve_thread is not None:
             self._serve_thread.join(5.0)
         self.dispatcher._write_stats_file()
         return drained
 
+    # -- journal replay --------------------------------------------------
+    def replay_journal(self) -> int:
+        """Feed unfinished journal entries back through the admission
+        queue under their ORIGINAL ids (restart recovery). Deadlines
+        re-derive from the wall clock: a deadline that passed while
+        the daemon was dead replays as an immediate timeout. A corrupt
+        entry is quarantined (marked terminal with a structured
+        error), never looped on. Returns how many entries replayed."""
+        if self.journal is None:
+            return 0
+        n = 0
+        for rid in self.journal.pending_ids():
+            if self.registry.get(rid) is not None:
+                continue            # already live (double replay call)
+            entry = self.journal.load_entry(rid)
+            try:
+                if entry is None:
+                    raise ValueError("unreadable journal entry")
+                ops = jr.history_from_edn(entry["history-edn"])
+                if not ops:
+                    raise ValueError("empty journaled history")
+                if ops[0].index < 0:
+                    ops = h.index(ops)
+                model = resolve_model(str(entry["model"]))
+                packed = h.pack(ops)
+            except Exception as e:                      # noqa: BLE001
+                log.warning("journal entry %s unreplayable: %s",
+                            rid, e)
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, id=rid,
+                                    replay=True)
+                self.journal.finish(
+                    rid, rq.QUARANTINED,
+                    {"valid": "unknown", "quarantined": True,
+                     "cause": "journal-corrupt",
+                     "error": f"{type(e).__name__}: {e}"})
+                continue
+            deadline = None
+            timeout_s = entry.get("timeout-s")
+            if timeout_s:
+                elapsed = time.time() - float(
+                    entry.get("submitted-at") or time.time())
+                deadline = time.monotonic() \
+                    + max(0.0, float(timeout_s) - elapsed)
+            opts = {k: v
+                    for k, v in (entry.get("options") or {}).items()
+                    if k in _CLIENT_OPTS}
+            req = rq.CheckRequest(
+                id=rid, tenant=str(entry.get("tenant") or "anonymous"),
+                model_name=str(entry["model"]), model=model,
+                packed=packed, history=ops, n_ops=int(packed.n),
+                opts=opts, deadline=deadline,
+                idem_key=entry.get("idempotency-key"),
+                journaled=True)
+            self.registry.add(req)
+            # force past the depth bound: this work was ALREADY
+            # admitted (its 202 is in a client's hands)
+            self.queue.submit(req, force=True)
+            self.registry.ledger_record(req.tenant, "replayed",
+                                        id=rid, ops=int(packed.n))
+            obs.count("serve.journal.replayed")
+            # (the dedup index already carries this entry's key:
+            # __init__ seeds it from journal.idempotency_index())
+            n += 1
+        if n:
+            log.info("journal replay: %d request(s) readmitted", n)
+        return n
+
     # -- request handling (called from HTTP worker threads) -------------
+    def _reserve_idem(self, tenant: str, idem: str,
+                      req_id: str) -> Optional[str]:
+        """Atomically claim (tenant, key) for ``req_id``. Returns the
+        ALREADY-known id on a hit (the caller dedups), None when this
+        request now owns the key. The reservation happens before any
+        journaling or queue admission, so concurrent duplicate POSTs
+        cannot both pass a check-then-act window."""
+        with self._idem_lock:
+            known = self._idem.get((tenant, idem))
+            if known is not None:
+                return known
+            self._idem[(tenant, idem)] = req_id
+            self._admitting.add(req_id)
+            while len(self._idem) > 4096:
+                self._idem.popitem(last=False)
+            return None
+
+    def _settle_idem(self, tenant: str, idem: Optional[str],
+                     req_id: str, admitted: bool) -> None:
+        """Resolve a reservation: keep the mapping on success, retract
+        it (index + in-flight mark) when admission failed."""
+        if idem is None:
+            return
+        with self._idem_lock:
+            self._admitting.discard(req_id)
+            if not admitted and self._idem.get((tenant, idem)) \
+                    == req_id:
+                self._idem.pop((tenant, idem), None)
+
+    def _dedup_response(self, tenant: str, idem: str,
+                        known: str) -> Optional[Tuple[int, Dict]]:
+        """Map a duplicate POST onto the original request: live ones
+        report their current status, journaled terminal ones their
+        recorded one. Scoped by tenant. A reservation whose admission
+        is still in flight on another worker thread is WAITED OUT
+        (admission is a journal write + queue insert, milliseconds) —
+        returning its id early would hand the client a 202 that
+        dangles if the winner's admission then fails."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            req = self.registry.get(known)
+            if req is not None:
+                obs.count("serve.journal.deduped")
+                return 202, {"id": known, "status": req.status,
+                             "tenant": req.tenant, "deduped": True}
+            term = (self.journal.lookup_terminal(known)
+                    if self.journal is not None else None)
+            if term is not None:
+                obs.count("serve.journal.deduped")
+                return 202, {"id": known,
+                             "status": term.get("status", "done"),
+                             "deduped": True}
+            with self._idem_lock:
+                if known not in self._admitting:
+                    # not mid-admission and resolvable on no tier:
+                    # either the winner's admission failed (its
+                    # retraction already popped the index) or the
+                    # entry fell out of retention — admit fresh
+                    if self._idem.get((tenant, idem)) == known:
+                        self._idem.pop((tenant, idem), None)
+                    return None
+            if time.monotonic() >= deadline:
+                # pathological stall of the winner: fail THIS
+                # duplicate loudly rather than dangle or double-admit
+                return 503, {"error": "idempotent admission of "
+                             f"{known!r} still in flight"}
+            time.sleep(0.002)
+
     def submit(self, body: bytes, content_type: str,
                header_tenant: Optional[str]) -> Tuple[int, Dict]:
         import time as _time
         if not self.accepting:
             return 503, {"error": "shutting down"}
         try:
-            tenant, model_name, ops, options, timeout_s = \
+            tenant, model_name, ops, options, timeout_s, idem = \
                 parse_check_body(body, content_type,
                                  default_tenant=header_tenant
                                  or "anonymous")
@@ -215,17 +425,54 @@ class Daemon:
             model_name=model_name, model=model, packed=packed,
             history=ops, n_ops=int(packed.n), opts=options,
             deadline=(_time.monotonic() + timeout_s
-                      if timeout_s else None))
+                      if timeout_s else None),
+            idem_key=idem)
+        if idem is not None:
+            known = self._reserve_idem(tenant, idem, req.id)
+            if known is not None:
+                dup = self._dedup_response(tenant, idem, known)
+                if dup is not None:
+                    return dup
+                # the known id was stale on every tier and has been
+                # retracted: claim the key for this request
+                if self._reserve_idem(tenant, idem, req.id) is not None:
+                    # lost the re-claim race to another fresh POST:
+                    # let that one win, admit this without a key
+                    idem = None
+                    req.idem_key = None
+        if self.journal is not None:
+            # durable BEFORE the 202: a client holding this id holds
+            # a claim that survives SIGKILL. Append precedes queue
+            # entry so a crash between the two replays the request
+            # (at-least-once) instead of losing it.
+            try:
+                self.journal.append(
+                    req_id=req.id, tenant=tenant,
+                    model_name=model_name, options=options,
+                    timeout_s=timeout_s, idempotency_key=idem,
+                    history=ops)
+                req.journaled = True
+            except OSError as e:
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, append=True)
+                self._settle_idem(tenant, idem, req.id,
+                                  admitted=False)
+                return 500, {"error": f"journal write failed: {e}"}
         try:
             self.registry.add(req)
             self.queue.submit(req)
         except Backpressure as e:
             # the id was never returned to the client: retract it so
-            # rejected requests cannot accumulate in the registry
+            # rejected requests cannot accumulate in the registry —
+            # or resurrect from the journal
             self.registry.remove(req.id)
+            if self.journal is not None:
+                self.journal.discard(req.id)
+            self._settle_idem(tenant, idem, req.id, admitted=False)
             self.registry.ledger_record(tenant, "rejected",
                                         cause="backpressure")
             return 429, {"error": str(e), "retry-after-s": 1.0}
+        self._settle_idem(tenant, idem, req.id, admitted=True)
         self.registry.ledger_record(tenant, "admitted", id=req.id,
                                     ops=int(packed.n))
         return 202, {"id": req.id, "status": req.status,
@@ -234,8 +481,26 @@ class Daemon:
     def lookup(self, req_id: str) -> Tuple[int, Dict]:
         req = self.registry.get(req_id)
         if req is None:
+            # a request that completed just before a crash: its
+            # registry state died with the process, but the journal's
+            # completion marker carries the verdict
+            term = (self.journal.lookup_terminal(req_id)
+                    if self.journal is not None else None)
+            if term is not None:
+                out: Dict[str, Any] = {
+                    "id": req_id,
+                    "status": term.get("status", "done"),
+                    "recovered-from-journal": True}
+                if term.get("result") is not None:
+                    out["result"] = term["result"]
+                code = (500 if out["status"] == rq.QUARANTINED
+                        else 200)
+                return code, out
             return 404, {"error": f"unknown request {req_id!r}"}
-        return 200, req.to_json()
+        # a quarantined request is a structured 500: the daemon is
+        # healthy, THIS request poisoned its dispatches
+        code = 500 if req.status == rq.QUARANTINED else 200
+        return code, req.to_json()
 
     def profile(self, body: bytes) -> Tuple[int, Dict]:
         """Arm on-demand profiling: the next N dispatches run under
@@ -261,10 +526,17 @@ class Daemon:
     def cancel(self, req_id: str) -> Tuple[int, Dict]:
         req = self.registry.get(req_id)
         if req is None:
+            # journaled but not (yet) replayed into the registry — a
+            # crash-recovery window: write the cancelled marker so a
+            # restart cannot resurrect cancelled work
+            if self.journal is not None \
+                    and self.journal.cancel_pending(req_id):
+                obs.count("serve.cancelled")
+                return 200, {"id": req_id, "status": rq.CANCELLED,
+                             "cancelled-in-journal": True}
             return 404, {"error": f"unknown request {req_id!r}"}
         queued = self.queue.cancel(req_id)
         if queued is not None:
-            from jepsen_tpu import obs
             obs.count("serve.cancelled")
             obs.count(f"serve.tenant."
                       f"{self.registry.bucket_tenant(req.tenant)}"
@@ -282,6 +554,18 @@ class Daemon:
 
     def stats(self) -> Dict[str, Any]:
         return self.dispatcher.stats()
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness + degradation: ``ok`` means the daemon serves;
+        ``degraded`` means it serves from the host path while the
+        device-path breaker is open (or probing half-open)."""
+        breaker = self.dispatcher.breaker
+        out: Dict[str, Any] = {"ok": True,
+                               "degraded": breaker.degraded,
+                               "breaker": breaker.to_json()}
+        if self.journal is not None:
+            out["journal"] = {"pending": self.journal.pending_count()}
+        return out
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -345,7 +629,7 @@ class _Handler(BaseHTTPRequestHandler):
                             "charset=utf-8")
             return
         if path.rstrip("/") == "/healthz":
-            self._reply(200, {"ok": True})
+            self._reply(200, self.daemon_ref.health())
             return
         self._reply(404, {"error": f"no route {path!r}"})
 

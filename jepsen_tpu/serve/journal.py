@@ -1,0 +1,284 @@
+"""Durable admission journal: the daemon's write-ahead log of admitted
+checks, so a crash (SIGKILL included) loses no admitted request.
+
+Contract (the Jepsen discipline applied to ourselves):
+
+- **Append before the 202.** Every admitted request is journaled —
+  EDN history, engine options, tenant, deadline, client-supplied
+  idempotency key — *before* the client sees its 202. A client
+  holding an id therefore holds a durable claim on a verdict.
+- **Completion marker.** Terminal transitions write a ``done`` marker
+  carrying the final status AND the result payload, so a client
+  polling ``GET /check/<id>`` across a restart gets its verdict even
+  when the request completed just before the crash (the in-memory
+  registry died with the process).
+- **Replay.** On daemon start, entries without markers are fed back
+  through the admission queue under their ORIGINAL ids. Deadlines
+  are re-derived from the wall clock (a request whose deadline passed
+  while the daemon was dead replays as an immediate ``timeout``, not
+  as free extra time).
+- **Idempotency.** Duplicate ``POST /check`` with the same
+  idempotency key dedups to the original id; the key->id index is
+  rebuilt from the journal at start, so the dedup window survives
+  restarts (bounded by journal retention).
+- **Cancellation sticks.** ``DELETE /check/<id>`` on a
+  journaled-but-unreplayed entry writes its ``cancelled`` marker so a
+  restart cannot resurrect cancelled work.
+- **Size-bounded.** Terminal entry/marker pairs past
+  ``keep_terminal`` are garbage-collected oldest-first
+  (``serve.journal.gc``); pending entries are never collected.
+
+Layout: one ``<id>.req.json`` (meta + ``history-edn``) plus one
+``<id>.done.json`` marker per request under
+``<store-root>/serve/journal/``. Writes go tmp-file + ``os.replace``
+with an fsync, so a torn write is an absent entry (the client never
+got its 202), never a corrupt one; a corrupt entry found anyway is
+quarantined at replay, not looped on.
+
+Pure host-side stdlib — no jax, unit-testable in microseconds.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from jepsen_tpu import edn
+from jepsen_tpu import obs
+from jepsen_tpu.op import Op
+
+log = logging.getLogger("jepsen.serve.journal")
+
+_REQ_SUFFIX = ".req.json"
+_DONE_SUFFIX = ".done.json"
+
+
+def history_to_edn(history) -> str:
+    """One EDN op map per line — the same shape ``history.edn`` run
+    artifacts use, so journal entries are readable by upstream
+    tooling."""
+    return "\n".join(edn.dumps(op.to_dict()) for op in history)
+
+
+def history_from_edn(text: str) -> List[Op]:
+    vals = edn.loads_all(text)
+    return [Op.from_dict(edn.to_plain(d)) for d in vals]
+
+
+class Journal:
+    """The write-ahead log. Thread-safe: HTTP worker threads append,
+    the dispatcher thread marks completion, ``/stats`` reads counts."""
+
+    def __init__(self, root: str, *, keep_terminal: int = 256,
+                 fsync: bool = True, gc_every: int = 32) -> None:
+        self.root = root
+        self.keep_terminal = int(keep_terminal)
+        self.fsync = bool(fsync)
+        self.gc_every = max(1, int(gc_every))
+        self._lock = threading.Lock()
+        self._finishes = 0
+        os.makedirs(root, exist_ok=True)
+
+    # -- low-level -------------------------------------------------------
+    def _write(self, path: str, payload: Dict[str, Any]) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=str)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if self.fsync:
+            # the rename itself must be durable: without a directory
+            # fsync a host crash (not just SIGKILL) after the 202 can
+            # lose the entry's directory metadata — the one failure
+            # mode tmp+replace+file-fsync does not cover
+            try:
+                dfd = os.open(self.root, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass            # platform without dir-fsync: best effort
+
+    def _req_path(self, req_id: str) -> str:
+        return os.path.join(self.root, req_id + _REQ_SUFFIX)
+
+    def _done_path(self, req_id: str) -> str:
+        return os.path.join(self.root, req_id + _DONE_SUFFIX)
+
+    # -- append / finish -------------------------------------------------
+    def append(self, *, req_id: str, tenant: str, model_name: str,
+               options: Dict[str, Any], timeout_s: Optional[float],
+               idempotency_key: Optional[str], history) -> None:
+        """Durably record one admitted request (called BEFORE the 202
+        is returned). Raises on IO failure — an unjournalable request
+        must not be admitted as if it were durable."""
+        entry = {
+            "id": req_id, "tenant": tenant, "model": model_name,
+            "options": dict(options or {}),
+            "timeout-s": timeout_s,
+            "idempotency-key": idempotency_key,
+            "submitted-at": round(time.time(), 6),
+            "history-edn": history_to_edn(history),
+        }
+        self._write(self._req_path(req_id), entry)
+        obs.count("serve.journal.appended")
+
+    def finish(self, req_id: str, status: str,
+               result: Optional[Dict[str, Any]] = None) -> None:
+        """Mark a journaled request terminal (idempotent; the first
+        marker wins — the exists-check and the write share the lock,
+        so a concurrent cancel cannot clobber a published verdict's
+        marker). Unknown ids are a no-op — requests admitted while
+        journaling was off, or already collected."""
+        done = self._done_path(req_id)
+        payload = {"id": req_id, "status": status,
+                   "ts": round(time.time(), 6)}
+        if result is not None:
+            try:
+                payload["result"] = json.loads(
+                    json.dumps(result, default=str))
+            except (TypeError, ValueError):
+                pass
+        with self._lock:
+            if not os.path.exists(self._req_path(req_id)) \
+                    or os.path.exists(done):
+                return
+            try:
+                self._write(done, payload)
+            except OSError as e:
+                # a failed marker means the entry replays after a
+                # crash — at-least-once, never lost; record, don't
+                # raise into the dispatcher
+                log.warning("journal finish failed for %s: %s",
+                            req_id, e)
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, id=req_id)
+                return
+            self._finishes += 1
+            due = self._finishes % self.gc_every == 0
+        if due:
+            self.gc()
+
+    def discard(self, req_id: str) -> None:
+        """Remove an entry that was never admitted (backpressure
+        retraction after the append)."""
+        for p in (self._req_path(req_id), self._done_path(req_id)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def cancel_pending(self, req_id: str) -> bool:
+        """Write a ``cancelled`` marker for a pending (unreplayed /
+        unfinished) entry so a restart cannot resurrect it. Returns
+        True when this call cancelled it (finish itself re-checks
+        under the lock, so a racing verdict marker wins or we do —
+        never a clobber)."""
+        if not os.path.exists(self._req_path(req_id)) \
+                or os.path.exists(self._done_path(req_id)):
+            return False
+        self.finish(req_id, "cancelled",
+                    {"valid": "unknown", "cause": "cancelled"})
+        term = self.lookup_terminal(req_id)
+        return bool(term) and term.get("status") == "cancelled"
+
+    # -- views -----------------------------------------------------------
+    def _ids(self) -> Dict[str, bool]:
+        """id -> has-done-marker, from one directory scan."""
+        out: Dict[str, bool] = {}
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        done = {n[:-len(_DONE_SUFFIX)] for n in names
+                if n.endswith(_DONE_SUFFIX)}
+        for n in names:
+            if n.endswith(_REQ_SUFFIX):
+                rid = n[:-len(_REQ_SUFFIX)]
+                out[rid] = rid in done
+        return out
+
+    def pending_ids(self) -> List[str]:
+        """Unfinished entries, oldest first (by entry mtime)."""
+        ids = [rid for rid, fin in self._ids().items() if not fin]
+
+        def _mtime(rid: str) -> float:
+            try:
+                return os.path.getmtime(self._req_path(rid))
+            except OSError:
+                return 0.0
+        return sorted(ids, key=lambda rid: (_mtime(rid), rid))
+
+    def pending_count(self) -> int:
+        # hot path (/healthz, per-dispatch stats): one listdir, no
+        # per-entry mtime stats — pending_ids' sort order is only
+        # needed by replay
+        return sum(1 for fin in self._ids().values() if not fin)
+
+    def load_entry(self, req_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._req_path(req_id)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def lookup_terminal(self, req_id: str) -> Optional[Dict[str, Any]]:
+        """The done marker (status + persisted result), or None."""
+        try:
+            with open(self._done_path(req_id)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def idempotency_index(self) -> Dict[Any, str]:
+        """(tenant, key) -> request id over every journaled entry
+        (pending and terminal) — rebuilt at daemon start so dedup
+        survives restarts. Keys are TENANT-scoped: one tenant's
+        idempotency key must never map onto (or leak the status of)
+        another tenant's request."""
+        out: Dict[Any, str] = {}
+        for rid in self._ids():
+            e = self.load_entry(rid)
+            if e and e.get("idempotency-key"):
+                out[(str(e.get("tenant") or "anonymous"),
+                     str(e["idempotency-key"]))] = rid
+        return out
+
+    # -- GC --------------------------------------------------------------
+    def gc(self) -> int:
+        """Collect terminal entry/marker pairs past ``keep_terminal``,
+        oldest marker first. Pending entries are never touched.
+        Returns how many requests were collected."""
+        pairs = [(rid, self._done_path(rid))
+                 for rid, fin in self._ids().items() if fin]
+        excess = len(pairs) - self.keep_terminal
+        if excess <= 0:
+            return 0
+
+        def _mtime(p: str) -> float:
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+        pairs.sort(key=lambda t: (_mtime(t[1]), t[0]))
+        n = 0
+        for rid, _ in pairs[:excess]:
+            self.discard(rid)
+            n += 1
+        if n:
+            obs.count("serve.journal.gc", n)
+        return n
+
+    def stats(self) -> Dict[str, Any]:
+        ids = self._ids()
+        pending = sum(1 for fin in ids.values() if not fin)
+        return {"pending": pending,
+                "terminal": len(ids) - pending,
+                "keep_terminal": self.keep_terminal,
+                "root": self.root}

@@ -1,0 +1,178 @@
+"""Shared recovery policy for the serving layer: deterministic
+bounded-backoff retries, group bisection, and the device-path circuit
+breaker.
+
+Three failure disciplines, one module, so the dispatcher cannot grow
+divergent ad-hoc copies:
+
+- :class:`RetryPolicy` — a deterministic backoff schedule (no jitter:
+  the chaos harness replays fault schedules and must get the same
+  attempt sequence every run). Shared by the group retry and the
+  hung-dispatch requeue cap.
+- :func:`bisect` — split a dispatch group in half to isolate a poison
+  member: a group that fails, then fails its retry, is bisected; each
+  half gets one attempt and bisects further on failure, so a single
+  poison request is cornered in O(log n) extra dispatches while the
+  innocent majority completes.
+- :class:`CircuitBreaker` — repeated device-path failures open the
+  breaker and route subsequent dispatch groups to the host-side
+  checkers (verdicts identical, slower) instead of feeding every
+  group to a dying device; after a cooldown, a half-open probe sends
+  ONE group back to the device and the result closes or re-opens it.
+  States follow the classic pattern::
+
+      closed --(N consecutive failures)--> open
+      open   --(cooldown elapsed)-------> half-open (one probe)
+      half-open --success--> closed
+      half-open --failure--> open (cooldown restarts)
+
+  The breaker is consulted and driven by the single dispatcher
+  thread, so the state machine needs no compare-and-swap subtlety —
+  the lock only guards cross-thread readers (``/healthz``,
+  ``/stats``).
+
+Counters: ``serve.retry.attempts`` / ``serve.retry.bisects`` /
+``serve.retry.requeued`` / ``serve.quarantined`` (bumped by the
+dispatcher at the corresponding transitions), ``serve.breaker.opened``
+/ ``serve.breaker.half_open`` / ``serve.breaker.closed`` and the
+numeric gauge ``serve.breaker.state`` (0 closed, 1 open, 2 half-open)
+from here.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Sequence, Tuple
+
+from jepsen_tpu import obs
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+class RetryPolicy:
+    """Deterministic bounded exponential backoff.
+
+    ``max_retries`` full-group retries per dispatch, ``max_requeues``
+    times a hung-dispatch survivor may be requeued before it times
+    out. ``delay(attempt)`` is a pure function of the attempt index —
+    identical schedules replay identically."""
+
+    def __init__(self, *, max_retries: int = 1, base_s: float = 0.05,
+                 factor: float = 2.0, cap_s: float = 1.0,
+                 max_requeues: int = 2) -> None:
+        self.max_retries = int(max_retries)
+        self.base_s = float(base_s)
+        self.factor = float(factor)
+        self.cap_s = float(cap_s)
+        self.max_requeues = int(max_requeues)
+
+    def delay(self, attempt: int) -> float:
+        return min(self.cap_s,
+                   self.base_s * (self.factor ** max(0, attempt)))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"max_retries": self.max_retries,
+                "base_s": self.base_s, "factor": self.factor,
+                "cap_s": self.cap_s,
+                "max_requeues": self.max_requeues}
+
+
+def bisect(batch: Sequence) -> Tuple[List, List]:
+    """Deterministic half split preserving order (the poison hunt's
+    step). Requires ``len(batch) >= 2``."""
+    mid = max(1, len(batch) // 2)
+    return list(batch[:mid]), list(batch[mid:])
+
+
+class CircuitBreaker:
+    """Device-path health, summarized into a route decision.
+
+    ``route()`` answers "where should the NEXT engine attempt run" —
+    ``"device"`` normally (and for the half-open probe), ``"host"``
+    while open. ``record_failure()`` / ``record_success()`` must be
+    called with the outcome of every DEVICE-route attempt (host
+    attempts say nothing about device health)."""
+
+    def __init__(self, *, threshold: int = 5,
+                 cooldown_s: float = 15.0) -> None:
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive = 0
+        self._opened_at: float = 0.0
+        obs.gauge("serve.breaker.state", 0)
+
+    # -- routing ---------------------------------------------------------
+    def route(self) -> str:
+        with self._lock:
+            if self._state == OPEN and \
+                    time.monotonic() - self._opened_at >= self.cooldown_s:
+                self._to(HALF_OPEN)
+            return "device" if self._state in (CLOSED, HALF_OPEN) \
+                else "host"
+
+    # -- outcomes --------------------------------------------------------
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._state == HALF_OPEN \
+                    or (self._state == CLOSED
+                        and self._consecutive >= self.threshold):
+                self._to(OPEN)
+            elif self._state == OPEN:
+                # still failing while open (shouldn't normally be fed,
+                # but a racing probe may land late): restart cooldown
+                self._opened_at = time.monotonic()
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            if self._state != CLOSED:
+                self._to(CLOSED)
+
+    def _to(self, state: str) -> None:
+        # callers hold the lock
+        if state == self._state:
+            return
+        self._state = state
+        if state == OPEN:
+            self._opened_at = time.monotonic()
+            obs.count("serve.breaker.opened")
+        elif state == HALF_OPEN:
+            obs.count("serve.breaker.half_open")
+        else:
+            obs.count("serve.breaker.closed")
+        obs.gauge("serve.breaker.state", _STATE_CODE[state])
+        obs.decision("serve-breaker", "transition", cause=state,
+                     consecutive=self._consecutive)
+
+    # -- views -----------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def degraded(self) -> bool:
+        """True while the daemon is NOT serving from the device path
+        at full health (open or probing)."""
+        with self._lock:
+            return self._state != CLOSED
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "threshold": self.threshold,
+                "cooldown_s": self.cooldown_s,
+            }
+            if self._state == OPEN:
+                out["open_for_s"] = round(
+                    time.monotonic() - self._opened_at, 3)
+            return out

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from jepsen_tpu import history as h
 from jepsen_tpu.models import Model
 from jepsen_tpu.op import Op
+from jepsen_tpu.serve import faults
 
 # request lifecycle states (strings: they go straight into JSON)
 QUEUED = "queued"
@@ -37,8 +38,10 @@ DISPATCHED = "dispatched"
 DONE = "done"
 TIMEOUT = "timeout"
 CANCELLED = "cancelled"
+QUARANTINED = "quarantined"     # isolated poison member of a group
+                                # (bisect retry exhausted on it alone)
 
-_TERMINAL = (DONE, TIMEOUT, CANCELLED)
+_TERMINAL = (DONE, TIMEOUT, CANCELLED, QUARANTINED)
 
 # stitched per-request trace records are bounded: a pathological
 # dispatch (deep fallback chains) must not grow retained terminal
@@ -63,6 +66,9 @@ class CheckRequest:
     n_ops: int = 0              # survives the terminal payload drop
     opts: Dict[str, Any] = field(default_factory=dict)
     deadline: Optional[float] = None        # time.monotonic() instant
+    idem_key: Optional[str] = None          # client idempotency key
+    requeues: int = 0                       # hung-dispatch requeues
+    journaled: bool = False                 # has a durable WAL entry
     # stage timestamps (time.monotonic): admit -> coalesce (selected
     # into a dispatch group) -> dispatch (engine call starts) ->
     # collect (engine call returned) -> done (verdict published).
@@ -100,8 +106,11 @@ class CheckRequest:
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:
             return False
+        # the self-nemesis clock-jump fault skews the deadline clock
+        # here (0.0 unless armed), so BOTH expiry sites — the queue
+        # scan and the dispatch abort hook — see the same jumped clock
         return (now if now is not None else time.monotonic()) \
-            >= self.deadline
+            + faults.clock_skew() >= self.deadline
 
     @property
     def terminal(self) -> bool:
@@ -187,6 +196,10 @@ class Registry:
                  ledger_depth: int = 512,
                  max_tenants: int = 1024) -> None:
         self._lock = threading.Lock()
+        # terminal-transition hook (the daemon wires the durable
+        # journal's completion marker here); called OUTSIDE the lock,
+        # exactly once per request, from whichever thread finished it
+        self.on_terminal: Optional[Any] = None
         self._by_id: "OrderedDict[str, CheckRequest]" = OrderedDict()
         self._done_order: "deque[str]" = deque()
         self._keep_done = keep_done
@@ -236,6 +249,16 @@ class Registry:
             while len(self._done_order) > self._keep_done:
                 old = self._done_order.popleft()
                 self._by_id.pop(old, None)
+        cb = self.on_terminal
+        if cb is not None:
+            try:
+                cb(req)
+            except Exception as e:                      # noqa: BLE001
+                # the hook is durability bookkeeping; a failure there
+                # must never lose the in-memory terminal transition
+                import logging
+                logging.getLogger("jepsen.serve").warning(
+                    "on_terminal hook failed for %s: %s", req.id, e)
         req.done_event.set()
 
     def bucket_tenant(self, tenant: str) -> str:

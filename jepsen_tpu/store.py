@@ -250,6 +250,16 @@ def save_check(root: str, name: str, run_id: str, history: List[Op],
                  "results": results}, run_dir=d)
 
 
+def serve_journal_dir(root: str) -> str:
+    """The check-serve daemon's durable admission journal —
+    ``<root>/serve/journal/``, beside its ``stats.json`` and profile
+    captures: the WAL of admitted requests that makes the daemon's
+    202s survive SIGKILL (see :mod:`jepsen_tpu.serve.journal`)."""
+    d = os.path.join(root, "serve", "journal")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def serve_profile_dir(root: str) -> str:
     """Create (and return) a fresh capture directory for the
     check-serve daemon's on-demand profiler —

@@ -224,6 +224,40 @@ def _engine_html(root: str) -> str:
     except Exception as e:                              # noqa: BLE001
         return head + f"<p>stats unreadable: {html.escape(str(e))}</p>"
     counters = st.get("counters", {})
+    # degradation banner: breaker state (amber while not closed —
+    # reusing the verdict badges' color path, "unknown" == amber) and
+    # quarantined-request count, surfaced ABOVE the tables so a
+    # degraded daemon is unmissable on the dashboard
+    breaker = st.get("breaker") or {}
+    bstate = breaker.get("state", "closed")
+    n_quar = int(counters.get("serve.quarantined", 0))
+
+    def _state_span(label: str, color: str) -> str:
+        # same badge element/colors as the verdict badges (amber =
+        # the "unknown" path, green = valid, red = INVALID)
+        return (f"<span class='badge' style='background:{color}'>"
+                f"{html.escape(label)}</span>")
+
+    banner = ""
+    if st.get("degraded") or bstate != "closed":
+        banner += (
+            "<p>" + _state_span(f"DEGRADED: breaker {bstate}",
+                                "#b07d2b")
+            + " device path unhealthy (consecutive failures: "
+            f"{breaker.get('consecutive_failures', '?')}) — serving "
+            "host-side, verdicts identical but slower</p>")
+    elif breaker:
+        banner += (f"<p>{_state_span('breaker closed', '#2e7d32')} "
+                   f"device path healthy</p>")
+    if n_quar:
+        banner += (f"<p>{_state_span(f'{n_quar} quarantined', '#c62828')} "
+                   f"poison member(s) isolated by the bisect retry; "
+                   f"each answered a structured 500</p>")
+    jstats = st.get("journal") or {}
+    if jstats:
+        banner += (f"<p>journal: {jstats.get('pending', 0)} pending, "
+                   f"{jstats.get('terminal', 0)} terminal entries"
+                   f"</p>")
     serve_rows = "".join(
         f"<tr><td>{html.escape(k)}</td><td>{v}</td></tr>"
         for k, v in sorted(counters.items())
@@ -258,6 +292,7 @@ def _engine_html(root: str) -> str:
         for t, v in sorted(st.get("device-seconds", {}).items()))
     q = st.get("queue", {})
     return (head
+            + banner
             + f"<p>queue depth {q.get('depth', '?')} / "
               f"{q.get('max_depth', '?')}, group width "
               f"{q.get('group', '?')}, per-tenant in-flight cap "

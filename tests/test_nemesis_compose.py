@@ -1,0 +1,139 @@
+"""Nemesis composition against the fake cluster (ISSUE 10 satellite):
+``nemesis.py``'s partitioner + hammer-time machinery driven as a
+COMPOSED nemesis — unit-level against a live FakeCluster, and a full
+``core.run`` of the register suite in sloppy mode where the composed
+``partition_random_halves`` + ``hammer_time`` schedule provokes the
+violation and the ONLINE checker flags it mid-run. (The nemesis module
+was previously exercised only incidentally through suite defaults.)"""
+import time
+
+import pytest
+
+from jepsen_tpu import core, generators as g, nemesis
+from jepsen_tpu.fake.cluster import FakeTimeout
+from jepsen_tpu.op import INFO, Op
+from jepsen_tpu.suites import register
+
+
+def _nem_op(f, value=None):
+    return Op(process="nemesis", type="invoke", f=f, value=value)
+
+
+def _composed(seed=3):
+    part = nemesis.partition_random_halves(seed=seed)
+    ham = nemesis.hammer_time(seed=seed + 1)
+    comp = nemesis.compose({
+        "partition-start": (part, "start"),
+        "partition-stop": (part, "stop"),
+        "hammer-start": (ham, "start"),
+        "hammer-stop": (ham, "stop"),
+    })
+    return comp, part, ham
+
+
+def test_composed_partition_and_hammer_drive_fake_cluster():
+    """Each composed f routes to its sub-nemesis with the rename
+    applied, and the faults REALLY land on the fake cluster: a
+    partitioned minority loses quorum, a hammered node times out,
+    and both heal on their stop ops."""
+    t = register.register_test(mode="linearizable", seed=5,
+                               with_nemesis=False)
+    cluster = t["cluster"]
+    comp, part, ham = _composed(seed=5)
+
+    res = comp.invoke(t, _nem_op("partition-start"))
+    assert res.type == INFO and res.f == "partition-start"
+    isolated = res.value["isolated"]
+    assert isolated                         # a real grudge was applied
+    # a minority-side node (cut from a majority of peers) cannot
+    # serve a quorum operation
+    majority = len(t["nodes"]) // 2 + 1
+    minority_node = next(n for n, cut in isolated.items()
+                         if len(cut) >= majority)
+    from jepsen_tpu.fake.cluster import Unavailable
+    with pytest.raises(Unavailable):
+        cluster.read(minority_node, "r")
+    res = comp.invoke(t, _nem_op("partition-stop"))
+    assert res.type == INFO and res.value == "network healed"
+    # healed: every node answers again
+    for n in t["nodes"]:
+        cluster.read(n, "r")
+
+    res = comp.invoke(t, _nem_op("hammer-start"))
+    paused = res.value["paused"]
+    assert len(paused) == 1 and paused[0] in t["nodes"]
+    with pytest.raises(FakeTimeout):
+        cluster.read(paused[0], "r")        # SIGSTOPped: unresponsive
+    res = comp.invoke(t, _nem_op("hammer-stop"))
+    assert res.value["resumed"] == paused
+    cluster.read(paused[0], "r")            # resumed
+
+    # an op no sub-nemesis handles is an explicit info, not a crash
+    res = comp.invoke(t, _nem_op("mystery"))
+    assert res.type == INFO and "no nemesis handles" in str(res.value)
+
+
+def test_composed_schedule_sloppy_run_flagged_by_online_checker():
+    """The full harness: register suite in sloppy mode under a
+    composed partition+hammer schedule. The partitions make the
+    sloppy cluster serve stale reads; the ONLINE checker must flag
+    the violation mid-run (fail-fast), and the post-hoc verdict must
+    agree."""
+    t = register.register_test(mode="sloppy", time_limit=8.0, seed=11,
+                               with_nemesis=False, concurrency=5)
+    comp, part, ham = _composed(seed=11)
+    # hammer first: the online checker fail-fasts on the FIRST
+    # partition-provoked stale read, so the hammer ops must already
+    # be in the history by then
+    nem_gen = g.Seq([
+        {"sleep": 0.05},
+        g.cycle(lambda: g.Seq([
+            {"f": "hammer-start"},
+            {"sleep": 0.15},
+            {"f": "hammer-stop"},
+            {"f": "partition-start"},
+            {"sleep": 0.3},
+            {"f": "partition-stop"},
+            {"sleep": 0.15},
+        ]))])
+    t["nemesis"] = comp
+    t["generator"] = g.clients_gen(t["generator"], nem_gen)
+    t["online-check"] = True
+    t["online-opts"] = {"interval_s": 0.3, "min_new_ops": 64}
+    done = core.run(t)
+    online = done["results"]["online-check"]
+    assert online["valid"] is False         # flagged mid-run
+    assert done["results"]["valid"] is False
+    history = done["history"]
+    # BOTH composed fault families actually fired in the schedule
+    fs = {op.f for op in history if op.process == "nemesis"}
+    assert "partition-start" in fs and "hammer-start" in fs
+    # and the hammer really paused something at least once
+    hammered = [op for op in history
+                if op.process == "nemesis"
+                and op.f == "hammer-start" and op.type == INFO]
+    assert any((op.value or {}).get("paused") for op in hammered)
+
+
+def test_composed_schedule_safe_mode_stays_valid():
+    """Soundness guard for the composition: the same partition+hammer
+    schedule over the LINEARIZABLE cluster must not manufacture a
+    false violation (faults may fail ops, never corrupt verdicts)."""
+    t = register.register_test(mode="linearizable", time_limit=2.0,
+                               seed=7, with_nemesis=False,
+                               concurrency=5)
+    comp, _, _ = _composed(seed=7)
+    nem_gen = g.Seq([
+        {"sleep": 0.1},
+        g.cycle(lambda: g.Seq([
+            {"f": "partition-start"},
+            {"f": "hammer-start"},
+            {"sleep": 0.25},
+            {"f": "hammer-stop"},
+            {"f": "partition-stop"},
+            {"sleep": 0.25},
+        ]))])
+    t["nemesis"] = comp
+    t["generator"] = g.clients_gen(t["generator"], nem_gen)
+    done = core.run(t)
+    assert done["results"]["results"]["linear"]["valid"] is True

@@ -310,10 +310,10 @@ def test_parse_check_body_edn():
                 b':history [{:process 0 :type :invoke :f :write '
                 b':value 1} {:process 0 :type :ok :f :write '
                 b':value 1}]}')
-    tenant, model_name, ops, options, timeout_s = parse_check_body(
-        edn_body, "application/edn")
-    assert (tenant, model_name, timeout_s) == ("e", "cas-register",
-                                               None)
+    tenant, model_name, ops, options, timeout_s, idem = \
+        parse_check_body(edn_body, "application/edn")
+    assert (tenant, model_name, timeout_s, idem) == \
+        ("e", "cas-register", None, None)
     assert [o.type for o in ops] == ["invoke", "ok"]
 
 

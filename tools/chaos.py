@@ -1,0 +1,441 @@
+"""Self-nemesis chaos harness: Jepsen's discipline pointed at our own
+checker-as-a-service daemon.
+
+Starts a REAL ``check-serve`` daemon subprocess armed with a seeded
+fault schedule (``jepsen_tpu/serve/faults.py`` via
+``JEPSEN_TPU_SERVE_FAULTS``), drives known-ground-truth load at it
+over real HTTP, SIGKILLs the process mid-load, restarts it on the
+same store root, and asserts the invariants we demand of etcd on
+ourselves:
+
+1. **No lost acknowledgements.** Every request that got its 202 — in
+   particular those queued/in-flight at the SIGKILL — reaches a
+   terminal state after the journal replay, under its original id.
+2. **No divergent verdicts.** Every ``done`` verdict equals the
+   known ground truth AND the standalone facade differential
+   recomputed in this process (witness op included for violations) —
+   through injected dispatch crashes, device outages, persist
+   failures, clock jumps, bisect retries, and degraded host-side
+   serving.
+3. **No silent faults.** Every injected fault type that fired shows
+   up in the obs ledger (``serve.fault.*`` counters) WITH its
+   visible consequence (``serve.retry.*``, ``serve.quarantined``,
+   breaker transitions, ``serve-persist`` fallback) — and every
+   scheduled core fault actually fired.
+4. **Poison isolation.** The request from the poison tenant (its
+   dispatch crashes every route) is quarantined with a structured
+   500 while every co-tenant of its coalesced groups completes.
+5. **Recovery.** The journal fully drains (no pending entries on
+   disk, ``/healthz`` agrees) and the daemon ends non-degraded
+   (breaker closed) — and the report carries the measured
+   recovery-time-to-first-verdict across the kill.
+
+Usage::
+
+    python tools/chaos.py --quick        # CI: one dispatch fault +
+                                         # one SIGKILL/restart
+    python tools/chaos.py --seed 7       # full gauntlet: dispatch,
+                                         # device-outage (breaker),
+                                         # persist, clock-jump,
+                                         # poison, SIGKILL
+
+Exit 0 iff every invariant held. The JSON report goes to stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# one JSON-over-HTTP client per toolbox, not per tool: the loadgen's
+# helpers already preserve structured error bodies (the quarantined
+# 500) and classify transport failures as code -1
+import loadgen as _lg  # noqa: E402
+
+_TERMINAL = ("done", "timeout", "cancelled", "quarantined")
+
+_get = _lg._get
+
+
+def _post(url: str, payload: Dict) -> Tuple[int, Dict]:
+    return _lg._post(url, json.dumps(payload).encode())
+
+
+def _wait_ready(url: str, timeout: float = 120.0) -> bool:
+    return _lg.wait_ready(url, timeout=timeout)
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- daemon process management -------------------------------------------
+
+class DaemonProc:
+    def __init__(self, store_root: str, *, faults_env: str = "",
+                 log_path: str, breaker_threshold: int = 3,
+                 breaker_cooldown: float = 1.0,
+                 group: int = 8) -> None:
+        self.port = _free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if faults_env:
+            env["JEPSEN_TPU_SERVE_FAULTS"] = faults_env
+        else:
+            env.pop("JEPSEN_TPU_SERVE_FAULTS", None)
+        self.log = open(log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "jepsen_tpu", "check-serve",
+             "--port", str(self.port), "--store-root", store_root,
+             "--group", str(group),
+             "--breaker-threshold", str(breaker_threshold),
+             "--breaker-cooldown", str(breaker_cooldown)],
+            cwd=REPO, env=env, stdout=self.log, stderr=self.log)
+
+    def sigkill(self) -> None:
+        # the hard crash: no drain, no atexit, no flush — exactly the
+        # fault the durable journal exists for
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait(30)
+        self.log.close()
+
+    def sigterm(self) -> int:
+        self.proc.send_signal(signal.SIGTERM)
+        rc = self.proc.wait(120)
+        self.log.close()
+        return rc
+
+
+# -- workload ------------------------------------------------------------
+
+def build_cases(*, seed: int, n: int, sizes, violation_frac: float,
+                deadline_frac: float = 0.0,
+                tenant_prefix: str = "chaos") -> List[Dict]:
+    """Known-ground-truth payloads: each case carries its expected
+    verdict and its ops (for the standalone differential)."""
+    from jepsen_tpu import fixtures
+    cases = []
+    for i in range(n):
+        n_ops = sizes[i % len(sizes)]
+        hist = fixtures.gen_history("cas", n_ops=n_ops, processes=3,
+                                    seed=seed + i)
+        expect = True
+        if (i * 997 % 101) / 101.0 < violation_frac:
+            hist = fixtures.corrupt(hist, seed=seed + i)
+            expect = False
+        payload: Dict[str, Any] = {
+            "model": "cas-register",
+            "tenant": f"{tenant_prefix}-{i % 3}",
+            "history": [op.to_dict() for op in hist],
+            "idempotency-key": f"{tenant_prefix}-key-{seed}-{i}",
+        }
+        if deadline_frac and (i * 31 % 17) / 17.0 < deadline_frac:
+            # generous deadline: only an injected clock JUMP (not real
+            # latency) can expire it
+            payload["timeout-s"] = 600.0
+        cases.append({"payload": payload, "expect": expect,
+                      "ops": hist, "id": None, "final": None})
+    return cases
+
+
+def submit_cases(url: str, cases: List[Dict]) -> int:
+    n = 0
+    for c in cases:
+        code, resp = _post(url, c["payload"])
+        if code == 202:
+            c["id"] = resp["id"]
+            n += 1
+        else:
+            c["final"] = {"status": f"error-{code}", "resp": resp}
+    return n
+
+
+def poll_terminal(url: str, cases: List[Dict],
+                  timeout: float = 300.0) -> Optional[float]:
+    """Poll every admitted case to a terminal state; returns the
+    monotonic instant the first ``done`` verdict was observed (the
+    recovery clock's far edge), or None."""
+    first_done = None
+    end = time.monotonic() + timeout
+    pending = [c for c in cases if c["id"] and c["final"] is None]
+    while pending and time.monotonic() < end:
+        for c in list(pending):
+            code, st = _get(url, f"/check/{c['id']}")
+            if code in (200, 500) and st.get("status") in _TERMINAL:
+                c["final"] = st
+                if st["status"] == "done" and first_done is None:
+                    first_done = time.monotonic()
+                pending.remove(c)
+        time.sleep(0.1)
+    return first_done
+
+
+# -- the harness ---------------------------------------------------------
+
+def run_chaos(opts: Dict[str, Any]) -> Dict[str, Any]:
+    quick = bool(opts.get("quick"))
+    seed = int(opts.get("seed", 7))
+    keep_store = bool(opts.get("keep_store"))
+    root = opts.get("store_root") or tempfile.mkdtemp(
+        prefix="jepsen-chaos-")
+    os.makedirs(root, exist_ok=True)
+    log_path = os.path.join(root, "chaos-daemon.log")
+    report: Dict[str, Any] = {"store_root": root, "seed": seed,
+                              "quick": quick, "violations": []}
+
+    def violate(msg: str) -> None:
+        report["violations"].append(msg)
+
+    # seeded fault schedule: invocation indices derived from the seed,
+    # kept low so short CI runs reach them
+    import random
+    rng = random.Random(seed)
+    if quick:
+        schedule = f"dispatch@{rng.randint(2, 3)}"
+        expected_faults = ["dispatch"]
+        poison = False
+    else:
+        schedule = ";".join([
+            f"dispatch@{rng.randint(2, 4)}",
+            f"device@{rng.randint(5, 7)}x{opts.get('device_burst', 6)}",
+            f"persist@{rng.randint(1, 3)}",
+            f"clock-jump@{rng.randint(6, 9)}:3600",
+            "poison=chaos-poison",
+        ])
+        expected_faults = ["dispatch", "device", "persist",
+                           "clock_jump", "poison"]
+        poison = True
+    report["fault_schedule"] = schedule
+
+    n_wave1 = 6 if quick else 14
+    wave1 = build_cases(seed=seed, n=n_wave1,
+                        sizes=[8, 12] if quick else [8, 12, 16],
+                        violation_frac=0.3,
+                        deadline_frac=0.0 if quick else 0.25)
+    poison_case = None
+    if poison:
+        poison_case = build_cases(seed=seed + 500, n=1, sizes=[8],
+                                  violation_frac=0.0,
+                                  tenant_prefix="chaos-poison")[0]
+        poison_case["payload"]["tenant"] = "chaos-poison"
+
+    # ---- phase 1: armed daemon, drive the fault gauntlet ----
+    d1 = DaemonProc(root, faults_env=schedule, log_path=log_path)
+    try:
+        if not _wait_ready(d1.url):
+            violate("daemon 1 never became ready")
+            return report
+        submit_cases(d1.url, wave1)
+        if poison_case is not None:
+            submit_cases(d1.url, [poison_case])
+        poll_terminal(d1.url, wave1, timeout=600)
+        if poison_case is not None:
+            poll_terminal(d1.url, [poison_case], timeout=120)
+
+        # keep feeding filler dispatches until every scheduled fault's
+        # invocation index has been reached (bounded)
+        def fault_counters() -> Dict[str, float]:
+            code, stats = _get(d1.url, "/stats")
+            if code != 200:
+                return {}
+            return {k: v for k, v in stats.get("counters", {}).items()
+                    if k.startswith("serve.fault.")}
+        filler_budget = 12
+        while filler_budget > 0:
+            fc = fault_counters()
+            missing = [f for f in expected_faults
+                       if fc.get(f"serve.fault.{f}", 0) < 1]
+            if not missing:
+                break
+            filler = build_cases(seed=seed + 900 + filler_budget, n=2,
+                                 sizes=[8], violation_frac=0.0,
+                                 tenant_prefix="filler")
+            submit_cases(d1.url, filler)
+            poll_terminal(d1.url, filler, timeout=120)
+            wave1.extend(filler)
+            filler_budget -= 1
+        report["fault_counters"] = fault_counters()
+        for f in expected_faults:
+            if report["fault_counters"].get(f"serve.fault.{f}", 0) < 1:
+                violate(f"scheduled fault {f!r} never fired")
+
+        # fault CONSEQUENCES must be in the ledger too (no silent
+        # recovery): scrape the counters the recovery machinery bumps
+        code, stats1 = _get(d1.url, "/stats")
+        c1 = stats1.get("counters", {}) if code == 200 else {}
+        report["pre_kill_counters"] = {
+            k: v for k, v in c1.items()
+            if k.startswith(("serve.retry.", "serve.quarantined",
+                             "serve.breaker.", "serve.journal."))}
+        if c1.get("serve.retry.attempts", 0) < 1:
+            violate("dispatch fault fired but no retry was recorded")
+        if not quick:
+            persist_falls = [k for k in c1
+                            if k.startswith("engine.fallback."
+                                            "serve-persist.")]
+            if not persist_falls:
+                violate("persist fault fired but no serve-persist "
+                        "fallback recorded")
+        if poison_case is not None:
+            st = poison_case["final"] or {}
+            if st.get("status") != "quarantined":
+                violate(f"poison member not quarantined: {st}")
+            if c1.get("serve.quarantined", 0) < 1:
+                violate("no serve.quarantined counter")
+
+        # ---- phase 2: wave 2 posts, then SIGKILL mid-load ----
+        wave2 = build_cases(seed=seed + 1000, n=4 if quick else 8,
+                            sizes=[10, 14], violation_frac=0.3,
+                            tenant_prefix="wave2")
+        admitted2 = submit_cases(d1.url, wave2)
+        report["wave2_admitted"] = admitted2
+        t_kill = time.monotonic()
+        d1.sigkill()
+        report["killed_pid"] = d1.proc.pid
+    except Exception as e:                              # noqa: BLE001
+        violate(f"phase 1 crashed: {type(e).__name__}: {e}")
+        try:
+            d1.sigkill()
+        except Exception:                               # noqa: BLE001
+            pass
+        return report
+
+    # ---- phase 3: restart (no faults), journal replay recovers ----
+    d2 = DaemonProc(root, faults_env="", log_path=log_path)
+    try:
+        if not _wait_ready(d2.url):
+            violate("daemon 2 never became ready after restart")
+            return report
+        # a duplicate POST with a wave-2 idempotency key must dedup to
+        # the ORIGINAL id (the index survived the restart via the WAL)
+        dup_target = next((c for c in wave2 if c["id"]), None)
+        if dup_target is not None:
+            code, resp = _post(d2.url, dup_target["payload"])
+            if code != 202 or resp.get("id") != dup_target["id"] \
+                    or not resp.get("deduped"):
+                violate(f"idempotent re-POST did not dedup to the "
+                        f"original id: {code} {resp}")
+            report["dedup_across_restart"] = resp
+        first_done = poll_terminal(d2.url, wave2, timeout=600)
+        if first_done is not None:
+            report["recovery_to_first_verdict_s"] = round(
+                first_done - t_kill, 3)
+
+        # invariant 1: every 202 reached a terminal state
+        for c in wave1 + wave2 + ([poison_case] if poison_case
+                                  else []):
+            if c["id"] and (c["final"] is None
+                            or c["final"].get("status")
+                            not in _TERMINAL):
+                violate(f"request {c['id']} never reached a terminal "
+                        f"state: {c['final']}")
+
+        # invariant 2: verdicts equal ground truth AND the standalone
+        # facade differential (bit-identical valid + witness op)
+        from jepsen_tpu import history as h
+        from jepsen_tpu import models
+        from jepsen_tpu.checkers import facade
+        mismatches = 0
+        for c in wave1 + wave2:
+            st = c["final"] or {}
+            if st.get("status") != "done":
+                continue
+            valid = (st.get("result") or {}).get("valid")
+            if valid is not c["expect"]:
+                mismatches += 1
+                violate(f"verdict mismatch for {c['id']}: got "
+                        f"{valid!r}, ground truth {c['expect']!r}")
+                continue
+            stand = facade.auto_check_packed(
+                models.cas_register(), h.pack(c["ops"]), {})
+            if stand["valid"] is not valid:
+                mismatches += 1
+                violate(f"daemon verdict diverges from standalone "
+                        f"facade for {c['id']}")
+            elif valid is False and \
+                    st["result"].get("op") != stand.get("op"):
+                mismatches += 1
+                violate(f"witness op diverges for {c['id']}: "
+                        f"{st['result'].get('op')} vs "
+                        f"{stand.get('op')}")
+        report["verdict_mismatches"] = mismatches
+        report["checked_done"] = sum(
+            1 for c in wave1 + wave2
+            if (c["final"] or {}).get("status") == "done")
+
+        # invariant 5: journal fully drained + non-degraded health
+        code, hz = _get(d2.url, "/healthz")
+        report["final_healthz"] = hz
+        if code != 200 or hz.get("ok") is not True:
+            violate(f"final /healthz not ok: {code} {hz}")
+        if hz.get("degraded") is not False:
+            violate(f"daemon still degraded after recovery: "
+                    f"{hz.get('breaker')}")
+        if (hz.get("journal") or {}).get("pending") != 0:
+            violate(f"journal not drained: {hz.get('journal')}")
+        jdir = os.path.join(root, "serve", "journal")
+        pending_files = [f for f in os.listdir(jdir)
+                         if f.endswith(".req.json")
+                         and not os.path.exists(os.path.join(
+                             jdir, f[:-len(".req.json")]
+                             + ".done.json"))]
+        if pending_files:
+            violate(f"pending journal entries on disk: "
+                    f"{pending_files}")
+        rc = d2.sigterm()
+        if rc != 0:
+            violate(f"daemon 2 SIGTERM exit code {rc}")
+    except Exception as e:                              # noqa: BLE001
+        violate(f"phase 3 crashed: {type(e).__name__}: {e}")
+        try:
+            d2.sigkill()
+        except Exception:                               # noqa: BLE001
+            pass
+
+    report["ok"] = not report["violations"]
+    if not keep_store and report["ok"] and not opts.get("store_root"):
+        shutil.rmtree(root, ignore_errors=True)
+        report["store_root"] = None
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="self-nemesis chaos harness for the check-serve "
+                    "daemon (seeded faults + SIGKILL/restart)")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI smoke: one dispatch fault + one "
+                         "SIGKILL/restart")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--store-root", default=None,
+                    help="use (and keep) this store root instead of "
+                         "a temp dir")
+    ap.add_argument("--keep-store", action="store_true",
+                    help="keep the temp store root for inspection")
+    args = ap.parse_args(argv)
+    report = run_chaos({"quick": args.quick, "seed": args.seed,
+                        "store_root": args.store_root,
+                        "keep_store": args.keep_store})
+    print(json.dumps(report, indent=2, default=str))
+    return 0 if report.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

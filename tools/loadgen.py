@@ -102,7 +102,12 @@ def _get(url: str, path: str) -> Tuple[int, Dict]:
         with urllib.request.urlopen(url + path, timeout=30) as r:
             return r.status, json.loads(r.read())
     except urllib.error.HTTPError as e:
-        return e.code, {}
+        try:
+            # error statuses can carry structured bodies (e.g. the
+            # 500 of a quarantined request) — keep them
+            return e.code, json.loads(e.read())
+        except Exception:                               # noqa: BLE001
+            return e.code, {}
     except Exception:                                   # noqa: BLE001
         return -1, {}
 
@@ -245,8 +250,8 @@ def _await_ids(url: str, ids: List[str], poll_timeout: float) -> None:
     while pending and time.monotonic() < end:
         for rid in list(pending):
             code, st = _get(url, f"/check/{rid}")
-            if code == 200 and st.get("status") in (
-                    "done", "timeout", "cancelled"):
+            if code in (200, 500) and st.get("status") in (
+                    "done", "timeout", "cancelled", "quarantined"):
                 pending.discard(rid)
         time.sleep(poll)
         poll = min(_POLL_MAX_S, poll * 1.5)
@@ -294,11 +299,38 @@ def warmup(url: str, pool: List[Dict], *, burst: int = 8,
 
 def run_load(url: str, *, rate: float, duration: float,
              pool: List[Dict], poll_s: float = 0.01,
-             poll_timeout: float = 120.0) -> Dict[str, Any]:
-    """Drive the open-loop schedule; returns the report dict."""
+             poll_timeout: float = 120.0,
+             chaos_tolerant: bool = False) -> Dict[str, Any]:
+    """Drive the open-loop schedule; returns the report dict.
+
+    ``chaos_tolerant`` (the chaos harness's mode): a connection
+    refusal during a scripted daemon kill/restart is expected, not a
+    failure — POSTs retry until the daemon returns, refusals are
+    recorded as ``error-restart`` (distinct from ``error-net``) only
+    when the daemon never comes back, pollers keep polling across the
+    gap, and the report carries ``recovery``: the time from the first
+    refusal to the first verdict observed after it
+    (recovery-time-to-first-verdict)."""
     records: List[Dict] = []
     rec_lock = threading.Lock()
     threads: List[threading.Thread] = []
+    # restart-recovery bookkeeping (chaos-tolerant mode): first
+    # connection refusal seen, and the first verdict after it
+    chaos = {"first_refusal": None, "first_verdict_after": None,
+             "refusals": 0}
+    chaos_lock = threading.Lock()
+
+    def _saw_refusal() -> None:
+        with chaos_lock:
+            chaos["refusals"] += 1
+            if chaos["first_refusal"] is None:
+                chaos["first_refusal"] = time.monotonic()
+
+    def _saw_verdict() -> None:
+        with chaos_lock:
+            if chaos["first_refusal"] is not None \
+                    and chaos["first_verdict_after"] is None:
+                chaos["first_verdict_after"] = time.monotonic()
 
     def one(payload: Dict, t_sched: float) -> None:
         rec = {"tenant": payload["tenant"], "ops": payload["ops"],
@@ -306,10 +338,21 @@ def run_load(url: str, *, rate: float, duration: float,
                "status": "lost", "latency_s": None, "match": None}
         t0 = time.monotonic()
         code, resp = _post(url, payload["body"])
+        if chaos_tolerant and code == -1:
+            # the daemon is (presumably) mid-restart: keep trying
+            # until it answers or the poll budget runs out
+            _saw_refusal()
+            end_post = time.monotonic() + poll_timeout
+            while code == -1 and time.monotonic() < end_post:
+                time.sleep(0.25)
+                code, resp = _post(url, payload["body"])
+            if code == -1:
+                rec["status"] = "error-restart"
         if code == 429:
             rec["status"] = "rejected"
         elif code == -1:
-            rec["status"] = "error-net"
+            rec["status"] = ("error-restart" if chaos_tolerant
+                             else "error-net")
         elif code != 202:
             rec["status"] = f"error-{code}"
         else:
@@ -321,14 +364,22 @@ def run_load(url: str, *, rate: float, duration: float,
             poll = poll_s
             while time.monotonic() < end:
                 code, st = _get(url, f"/check/{rid}")
-                if code == 200 and st.get("status") in (
-                        "done", "timeout", "cancelled"):
+                if code == -1 and chaos_tolerant:
+                    # daemon gap mid-poll: note it, keep polling —
+                    # the journal replay owes us this verdict under
+                    # the same id
+                    _saw_refusal()
+                if code in (200, 500) and st.get("status") in (
+                        "done", "timeout", "cancelled",
+                        "quarantined"):
                     rec["status"] = st["status"]
                     rec["latency_s"] = time.monotonic() - t0
                     valid = (st.get("result") or {}).get("valid")
                     rec["match"] = (valid == payload["expect"]
                                     if st["status"] == "done"
                                     else None)
+                    if st["status"] == "done":
+                        _saw_verdict()
                     # the daemon's stamped stage split (queue wait vs
                     # service) — reported beside the client-side wall
                     rec["queue_wait_s"] = st.get("queue-wait-s")
@@ -394,6 +445,19 @@ def run_load(url: str, *, rate: float, duration: float,
                   if isinstance(r.get("service_s"),
                                 (int, float))]))},
     }
+    with chaos_lock:
+        if chaos["refusals"]:
+            rec_s = None
+            if chaos["first_verdict_after"] is not None:
+                rec_s = round(chaos["first_verdict_after"]
+                              - chaos["first_refusal"], 3)
+            report["recovery"] = {
+                "refusals": chaos["refusals"],
+                "restart_errors": sum(
+                    1 for r in records
+                    if r["status"] == "error-restart"),
+                "recovery_to_first_verdict_s": rec_s,
+            }
     code, stats = _get(url, "/stats")
     if code == 200:
         report["stats"] = stats
@@ -444,8 +508,9 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
         # scrape the e2e histogram around the measured run: the delta
         # is the measured window's distribution, warmup excluded
         hist_before = fetch_hist_buckets(url)
-        report.update(run_load(url, rate=rate, duration=duration,
-                               pool=pool))
+        report.update(run_load(
+            url, rate=rate, duration=duration, pool=pool,
+            chaos_tolerant=bool(opts.get("chaos_tolerant"))))
         hist_after = fetch_hist_buckets(url)
         xc = crosscheck_quantiles(
             {"p50": report.get("p50_s"), "p99": report.get("p99_s")},
@@ -485,6 +550,12 @@ def main(argv=None) -> int:
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip the cold-start warmup phase (measure "
                          "the compile wall inside the windows)")
+    ap.add_argument("--chaos-tolerant", action="store_true",
+                    help="expect a scripted daemon kill/restart: "
+                         "retry refused POSTs, record refusals as "
+                         "error-restart (not error-net), keep "
+                         "polling across the gap, and report "
+                         "recovery-time-to-first-verdict")
     args = ap.parse_args(argv)
     if args.self_host and args.url:
         ap.error("--self-host and --url are mutually exclusive")
@@ -494,6 +565,7 @@ def main(argv=None) -> int:
         "model": args.model, "violation_frac": args.violation_frac,
         "seed": args.seed, "store_root": args.store_root,
         "quick": args.quick, "warmup": not args.no_warmup,
+        "chaos_tolerant": args.chaos_tolerant,
     })
     print(json.dumps(report, default=str))
     if report.get("error"):
