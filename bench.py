@@ -52,7 +52,12 @@ checker-as-a-service daemon (ISSUE 6) driven by the open-loop load
 generator (``tools/loadgen.py``) — sustained req/s, p50/p99 verdict
 latency across two measurement windows (the second runs entirely on
 warm caches), backpressure/timeout counts, and the daemon's final
-``serve.*`` counter snapshot.
+``serve.*`` counter snapshot — plus a ``"session"`` sub-object (ISSUE
+11): the streaming-session rung, sustained append ops/s and p50/p99
+append-to-verdict latency of the device-resident carried-frontier
+engine vs the host ``OnlineLinearizable`` monitor at its production
+flush cadence, with the jax ``platform`` named so the device-vs-host
+comparison reads honestly on CPU-only runs.
 
 Usage: python bench.py [--ops N] [--repeat K]
        [--engine reach|chunked|batch|wgl-cpu|wgl-native]
@@ -403,6 +408,102 @@ def serve_probe(quick: bool = True) -> dict:
     return out
 
 
+def session_probe(n_ops: int = 100_000, seed: int = 42,
+                  block: int = 4096, quick: bool = False) -> dict:
+    """The streaming-session rung (ISSUE 11): one cas op stream fed
+    twice — once through the device-resident session engine
+    (``serve.session.Session``: carried frontier advanced in place
+    per append block, donated buffers) and once through the host
+    ``OnlineLinearizable`` monitor at its production flush cadence —
+    reporting sustained append ops/s and the p50/p99
+    append-to-verdict latency for both. ``platform`` names the jax
+    backend the session walk actually ran on: the device-resident
+    path exists to beat the host monitor where there IS a device
+    (the post-hoc walk does 8.9M ops/s there); on a CPU-only jax the
+    same XLA program is thunk-overhead-bound and the C++ host monitor
+    keeps the crown — the honest number either way."""
+    import jax
+
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import online
+    from jepsen_tpu.serve.session import Session
+
+    if quick:
+        n_ops = min(n_ops, 20_000)
+    hist = fixtures.gen_history("cas", n_ops=n_ops, processes=5,
+                                seed=seed)
+    model = models.cas_register()
+    blocks = [hist[i:i + block] for i in range(0, len(hist), block)]
+
+    def drive_session() -> dict:
+        s = Session("bench", "bench", "cas-register", model)
+        lats = []
+        t0 = time.monotonic()
+        verdict = True
+        for i, b in enumerate(blocks):
+            t1 = time.monotonic()
+            r = s.advance_block(b, seq=i + 1)
+            lats.append(time.monotonic() - t1)
+            verdict = verdict and r["valid-so-far"]
+        wall = time.monotonic() - t0
+        lats.sort()
+        return {"wall_s": round(wall, 3),
+                "ops_s": round(len(hist) / wall),
+                "engine": s.engine_name,
+                "valid": verdict,
+                "appends": len(blocks),
+                "append_p50_s": round(lats[len(lats) // 2], 4),
+                "append_p99_s": round(
+                    lats[min(len(lats) - 1,
+                             int(len(lats) * 0.99))], 4)}
+
+    def drive_host() -> dict:
+        mon = online.OnlineLinearizable(model)
+        lats = []
+        t0 = time.monotonic()
+        n = 0
+        for op in hist:
+            mon.observe(op)
+            n += 1
+            if n % 256 == 0:        # the monitor's production cadence
+                t1 = time.monotonic()
+                mon.flush()
+                lats.append(time.monotonic() - t1)
+        res = mon.stop()
+        wall = time.monotonic() - t0
+        lats.sort()
+        return {"wall_s": round(wall, 3),
+                "ops_s": round(len(hist) / wall),
+                "engine": ("online-native"
+                           if type(mon._engine).__name__
+                           == "NativeStreamEngine" else "online-py"),
+                "valid": res.get("valid"),
+                "flush_p50_s": (round(lats[len(lats) // 2], 5)
+                                if lats else None),
+                "flush_p99_s": (round(
+                    lats[min(len(lats) - 1,
+                             int(len(lats) * 0.99))], 5)
+                    if lats else None)}
+
+    sess_cold = drive_session()     # compile wall included
+    sess_warm = drive_session()     # the steady state a daemon lives in
+    host = drive_host()
+    out = {
+        "ops": len(hist), "block": block,
+        "platform": jax.default_backend(),
+        "session": sess_warm,
+        "session_cold": sess_cold,
+        "host_monitor": host,
+        "session_vs_host": round(
+            sess_warm["ops_s"] / max(host["ops_s"], 1), 3),
+        "beats_host": sess_warm["ops_s"] > host["ops_s"],
+    }
+    if sess_warm["valid"] is not True or host["valid"] is not True:
+        out["error"] = (f"verdict drift: session "
+                        f"{sess_warm['valid']} host {host['valid']}")
+    return out
+
+
 def txn_probe(n_txns: int, seed: int) -> dict:
     """The transactional rung (ISSUE 9): a ``n_txns`` list-append
     history (key-rotated, the real Jepsen workload shape) with one
@@ -738,6 +839,16 @@ def main() -> int:
                 out["serve"] = serve_probe(quick=args.quick)
         except Exception as e:                          # noqa: BLE001
             out["serve"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            # the streaming-session rung rides --serve: sustained
+            # appends/s + p99 append-to-verdict vs the host online
+            # monitor on the same op stream
+            with obs.span("bench.session_probe"):
+                out["session"] = session_probe(
+                    n_ops=min(args.ops, 100_000), seed=args.seed,
+                    quick=args.quick)
+        except Exception as e:                          # noqa: BLE001
+            out["session"] = {"error": f"{type(e).__name__}: {e}"}
     if args.txn:
         try:
             with obs.span("bench.txn_probe", txns=args.ops):

@@ -638,24 +638,32 @@ class NativeStreamEngine:
 
     # -- the walk -------------------------------------------------------------
 
+    def _resolve_stragglers(self) -> None:
+        """The run is over: every still-pending invocation resolves
+        as crashed, making the final incremental verdict the exact
+        full-history one. Shared with the device session engine
+        (``serve.session.DeviceFrontierEngine``) so the two advance
+        paths cannot drift."""
+        if not self._live_inv:
+            return
+        items = list(self._live_inv.items())
+        self._live_inv.clear()
+        k = len(items)
+        types = np.full(k, 3, np.int32)
+        procs = np.empty(k, np.int64)
+        oids = np.empty(k, np.int32)
+        for i, (p, (bid, inv)) in enumerate(items):
+            procs[i] = self._pkey(p)
+            oids[i] = self._oid(inv.f, inv.value)
+            self._bind_val[bid] = inv.value
+        self._feed_native(types, procs, oids)
+
     def advance(self, run_over: bool = False) -> Optional[Dict[str, Any]]:
         if self.violation is not None:
             return self.violation
         self._drain()
-        if run_over and self._live_inv:
-            # the run is over: every straggler resolves as crashed,
-            # making the final verdict the exact full-history one
-            items = list(self._live_inv.items())
-            self._live_inv.clear()
-            k = len(items)
-            types = np.full(k, 3, np.int32)
-            procs = np.empty(k, np.int64)
-            oids = np.empty(k, np.int32)
-            for i, (p, (bid, inv)) in enumerate(items):
-                procs[i] = self._pkey(p)
-                oids[i] = self._oid(inv.f, inv.value)
-                self._bind_val[bid] = inv.value
-            self._feed_native(types, procs, oids)
+        if run_over:
+            self._resolve_stragglers()
         if self.memo is None:
             return None
         # one long-pending op blocks the whole settle queue; skip the

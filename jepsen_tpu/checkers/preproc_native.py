@@ -67,6 +67,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.jt_mon_tail.restype = ctypes.c_int64
     lib.jt_mon_tail.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, _I32P, _I32P, _I32P]
+    lib.jt_mon_drain.restype = ctypes.c_int64
+    lib.jt_mon_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, _I32P, _I32P, _I32P]
     lib.jt_mon_stats.restype = ctypes.c_int64
     lib.jt_mon_stats.argtypes = [ctypes.c_void_p, _I64P]
     lib.jt_mon_live.restype = ctypes.c_int64
@@ -226,6 +229,20 @@ class Monitor:
             self._h, _p(T), S, n_ops,
             R_words.ctypes.data_as(_U64P), R_words.shape[1], _p(dead)))
         return walked, int(dead[0])
+
+    def drain(self, cap: int, W: int):
+        """Pop every currently-settleable queued return WITHOUT
+        walking it: ``(rows[n, W], slots[n], binds[n])``. The
+        device-resident session engine walks the drained block on the
+        accelerator (the settle discipline stays the monitor's; only
+        the walk moves) and owns death handling — the native settled
+        counter is advanced by the drain itself."""
+        rows = np.empty((max(cap, 1), max(W, 1)), np.int32)
+        slots = np.empty(max(cap, 1), np.int32)
+        binds = np.empty(max(cap, 1), np.int32)
+        n = int(self._lib.jt_mon_drain(self._h, cap, _p(rows),
+                                       _p(slots), _p(binds)))
+        return rows[:n], slots[:n], binds[:n]
 
     def tail(self, K: int, W: int):
         """First ≤K unsettled items as ``(rows[K, W], slots, binds)``

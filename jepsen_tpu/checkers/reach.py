@@ -61,6 +61,7 @@ import numpy as np
 from jepsen_tpu import history as h
 from jepsen_tpu import obs
 from jepsen_tpu.checkers import events as ev
+from jepsen_tpu.checkers import transfer
 from jepsen_tpu.models import Model
 from jepsen_tpu.models.memo import (
     Memo, StateExplosion, memo as build_memo, memo_ops)
@@ -331,6 +332,328 @@ def _jitted_basis_returns():
                      in_axes=(None, None, None, None, None, 0))
     outer = jax.vmap(inner, in_axes=(None, None, None, 0, 0, 0))
     return jax.jit(outer)
+
+
+# -- carried-frontier advance (streaming check sessions) ---------------------
+#
+# A long-lived check session (jepsen_tpu/serve/session.py) keeps its
+# reachable-config frontier R ON DEVICE across appends: each append
+# block's settled returns advance the carried set in ONE dispatch.
+# The dense body's carry is DONATED so XLA recycles the [S, M] buffer
+# in place (the transfer-diet donation applied to a frontier that
+# lives for the whole session, not just a pipeline); the word-packed
+# body's carry is a few machine words and is deliberately NOT donated
+# (see _jitted_word_walk). Only the per-block (ret_slot, slot_ops)
+# operands cross the wire per append — narrow ints on the standard
+# diet — and the verdict fetch is the walk's one alive bool.
+#
+# Two kernel bodies share the carry protocol:
+#
+# - **Word-packed** (M <= 64, i.e. W <= 6 — the repo-default workload
+#   shape): the mask axis lives in ONE machine word per state
+#   (uint32/uint64 [S]), a fire pass is pure bitwise algebra
+#   (`(R & ~colmask_j) << 2^j`, OR-scattered through the transition
+#   column), and the whole scan body fuses into straight-line code —
+#   measured ~1 µs/return on XLA:CPU, 33x the dense einsum step whose
+#   gather/einsum chain is thunk-dispatch-bound there (a first
+#   instance of ROADMAP item 3's bit-parallel kernel bodies). Death
+#   indices are exact per step (no unroll-window refine).
+# - **Dense** [S, M] einsum walk (`_walk_returns`): the wide-geometry
+#   fallback, the same program the post-hoc engines run.
+
+@functools.cache
+def _jitted_advance_frontier():
+    """Donated-carry unrolled returns walk: the dense session append
+    path. The carried set is argument 5 (R0); donating it makes the
+    in-place advance free — the returned R aliases the carry's
+    buffer."""
+    import jax
+    return jax.jit(functools.partial(_walk_returns, unroll=_UNROLL),
+                   donate_argnums=(5,))
+
+
+def _word_masks(W: int, dtype) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-slot mask-axis constants of the word-packed walk:
+    ``cmask[j]`` has bit m set iff mask m has bit j set; ``shift[j]``
+    is ``2^j`` (firing slot j moves config bit m to m | 1<<j, a left
+    shift by 2^j on the bit-j-clear half)."""
+    M = 1 << W
+    m = np.arange(M)
+    cmask = np.array(
+        [sum(1 << int(x) for x in m[(m >> j) & 1 == 1])
+         for j in range(W)], dtype)
+    shift = np.array([1 << j for j in range(W)], np.uint32)
+    return cmask, shift
+
+
+def _word_walk(Tpad, R0, ret_slot, slot_ops):
+    """Word-packed returns walk: ``Tpad`` i32[S, O+1] (col O = -1
+    sentinel), ``R0`` uint32/uint64[S] (bit m of R[s] = config (s, m)
+    reachable), blocks of (ret_slot, slot_ops) as in
+    :func:`_walk_returns`. Returns ``(R, any_dead, first_dead)`` with
+    the EXACT step index of the first death (pads — ret_slot -1 —
+    cannot kill a live set). Fire semantics are `_ret_step`'s: W
+    simultaneous-slot passes reach the closure between returns,
+    projection keeps the fired half of the returning slot."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = Tpad.shape[0]
+    O1 = Tpad.shape[1] - 1
+    W = slot_ops.shape[1]
+    dt = R0.dtype
+    cmask_np, shift_np = _word_masks(W, dt)
+    cmask = jnp.asarray(cmask_np)
+    mult = (jnp.asarray(np.uint64(1) if dt == jnp.uint64
+                        else np.uint32(1)).astype(dt)
+            << jnp.asarray(shift_np).astype(dt))
+    s_idx = jnp.arange(S)
+
+    def step(R, inp):
+        j, ops_row = inp
+        o = jnp.where(ops_row < 0, O1, ops_row)
+        tcols = Tpad[:, o]                       # [S, W]
+        tgt = jnp.where(tcols < 0, S, tcols)     # row S = discard
+        for _ in range(W):
+            lo = R[:, None] & (~cmask)[None, :]
+            shifted = lo * mult[None, :]         # << 2^j, bitexact
+            oh = s_idx[:, None, None] == tgt[None, :, :]
+            contrib = jnp.where(oh, shifted[None, :, :],
+                                jnp.zeros((), dt))
+            fired = lax.reduce(contrib, np.zeros((), dt)[()],
+                               lax.bitwise_or, (1, 2))
+            R = R | fired
+        jj = jnp.maximum(j, 0)
+        # projection: keep the bit-j-set half, clearing the bit — an
+        # exact right shift by 2^j (unsigned // by a power of two)
+        proj = (R & cmask[jj]) // mult[jj]
+        R = jnp.where(j >= 0, proj, R)
+        return R, R.max() == jnp.zeros((), dt)[()]
+
+    R, deads = lax.scan(step, R0, (ret_slot, slot_ops))
+    return R, deads.any(), deads.argmax()
+
+
+@functools.cache
+def _jitted_word_walk():
+    # deliberately NOT donated: the word-packed carry is a few machine
+    # words (S * 4 bytes), so donation saves nothing — and donating it
+    # was measured to CORRUPT the carry under concurrent jax activity
+    # on the CPU client (garbage bits appearing in the aliased output
+    # while another thread dispatches; reproduced ~30%/run by a
+    # facade-hammer thread, never without donation — the regression
+    # test in tests/test_session.py pins this). The DENSE carry keeps
+    # its donation: that buffer is the one worth recycling, and the
+    # dense path is unaffected under the same hammer.
+    import jax
+    return jax.jit(_word_walk)
+
+
+class FrontierCarry:
+    """Device-resident reachable-config frontier for ONE session
+    geometry ``(S, M=2^W)``: holds the carried R and the
+    device-cached transition operand. A geometry change (memo
+    rebuild, slot growth) discards the carry — the session engine
+    re-encodes host-side and seeds a fresh one.
+
+    The walk body is the word-packed kernel whenever ``M <= 64``
+    (one uint32/uint64 word per state; exact per-step death) and the
+    dense ``_walk_returns`` einsum program otherwise. ``advance``
+    pads each block to a power-of-two length (identity steps:
+    ``ret_slot = -1``) so a session compiles log2-many walk
+    geometries, not one per block size. ``JEPSEN_TPU_NO_WORD_WALK=1``
+    forces the dense body (differential tests pin the two
+    bit-identical)."""
+
+    _MIN_BLOCK = 64
+
+    def __init__(self, P_np: Optional[np.ndarray], W: int, M: int,
+                 R0_host: np.ndarray,
+                 table: Optional[np.ndarray] = None,
+                 p_build=None) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.W, self.M = int(W), int(M)
+        self.S = int(R0_host.shape[0])
+        self.advanced_returns = 0
+        if self.M <= 32:
+            self._word_dt = np.uint32
+        elif self.M <= 64:
+            # uint64 words need x64 mode — jax silently downcasts
+            # 64-bit arrays to 32 otherwise, which would truncate the
+            # mask axis
+            self._word_dt = (np.uint64 if jax.config.jax_enable_x64
+                             else None)
+        else:
+            self._word_dt = None
+        self.words = (self._word_dt is not None
+                      and table is not None
+                      and not os.environ.get(
+                          "JEPSEN_TPU_NO_WORD_WALK"))
+        if self.words:
+            # word-packed body: the transition TABLE (with a -1
+            # sentinel column for pad slots) is the only operand —
+            # the O(O*S^2) dense P tensor is never materialized on
+            # this path (callers pass it lazily via p_build)
+            S_t = table.shape[0]
+            Tpad = np.concatenate(
+                [table, -np.ones((S_t, 1), table.dtype)],
+                axis=1).astype(np.int32)
+            # plain device_put, NOT transfer.cached_put: the host
+            # array is rebuilt per carry seed, so the identity-keyed
+            # cache could never hit — it would only pin dead copies
+            self._T = jax.device_put(Tpad)
+            # the [S, M] bool seed packs to S words — fewer wire
+            # bytes than even the bit-packed dense seed
+            words = _pack_frontier_words(R0_host[:S_t], self.M,
+                                         self._word_dt)
+            transfer.count_put(int(words.nbytes),
+                               int(R0_host.size * 4))
+            self._R = jax.device_put(words)
+            self.S = S_t
+            return
+        if P_np is None:
+            P_np = p_build()
+        xor_np, bit_np = _xor_bitmask(self.W, self.M)
+        self._xor = jnp.asarray(xor_np)
+        self._bit = jnp.asarray(bit_np)
+        # plain device_put (see the word branch: per-seed host arrays
+        # cannot hit the identity-keyed operand cache)
+        self._P = jax.device_put(P_np)
+        # seed crosses bit-packed (8 configs/byte) and unpacks where
+        # bandwidth is free; the advance itself ships no config set
+        if transfer.packed_enabled():
+            packed = transfer.pack_bool(R0_host)
+            transfer.count_put(int(packed.nbytes),
+                               int(R0_host.size * 4))
+            self._R = _jitted_unpack_seed()(
+                jnp.asarray(packed), self.S, self.M)
+        else:
+            transfer.count_put(int(R0_host.size),
+                               int(R0_host.size * 4))
+            self._R = jax.device_put(
+                np.ascontiguousarray(R0_host, bool))
+
+    def _pad_block(self, ret_slot: np.ndarray, slot_ops: np.ndarray):
+        n = len(ret_slot)
+        n_pad = max(self._MIN_BLOCK, _next_pow2(n))
+        rs = np.full(n_pad, -1, np.int32)
+        so = np.full((n_pad, self.W), -1, np.int32)
+        rs[:n] = ret_slot
+        so[:n] = slot_ops
+        return rs, so
+
+    def advance(self, ret_slot: np.ndarray,
+                slot_ops: np.ndarray) -> int:
+        """Advance the carried frontier through one settled block.
+        Returns the exact index of the first dead return, or -1 when
+        the set survived. On death the carry is left at the walk's
+        final (empty) set — death is terminal for a session."""
+        import jax.numpy as jnp
+
+        n = len(ret_slot)
+        if n == 0:
+            return -1
+        rs, so = self._pad_block(ret_slot, slot_ops)
+        nb = int(so.nbytes + rs.nbytes)
+        transfer.count_put(nb, int((rs.size + so.size) * 4))
+        if self.words:
+            R, any_dead, first = _jitted_word_walk()(
+                self._T, self._R, jnp.asarray(rs), jnp.asarray(so))
+            self._R = R
+            if not bool(any_dead):
+                self.advanced_returns += n
+                return -1
+            dead = min(int(first), n - 1)
+            self.advanced_returns += dead + 1
+            return dead
+        ptr, R, alive, R_block = _jitted_advance_frontier()(
+            self._P, self._xor, self._bit, jnp.asarray(rs),
+            jnp.asarray(so), self._R)
+        self._R = R
+        if bool(alive):
+            self.advanced_returns += n
+            return -1
+        dead = self._refine(rs, so, int(ptr), R_block, n)
+        self.advanced_returns += dead + 1
+        return dead
+
+    def _refine(self, rs, so, ptr: int, R_block, n: int) -> int:
+        """Exact dead index of the dense body: u1 re-walk of the
+        dying unroll window from the carried block-start set
+        (identity pads cannot die, so the refined index always lands
+        on a real return)."""
+        import jax.numpy as jnp
+        start = max(0, ptr - _UNROLL)
+        ptr1, _, alive1, _ = _jitted_walk_returns_u1()(
+            self._P, self._xor, self._bit,
+            jnp.asarray(rs[start:start + _UNROLL]),
+            jnp.asarray(so[start:start + _UNROLL]), R_block)
+        dead = (start + int(ptr1) - 1) if not bool(alive1) \
+            else min(ptr, n) - 1
+        return min(dead, n - 1)
+
+    def probe(self, ret_slot: np.ndarray,
+              slot_ops: np.ndarray) -> int:
+        """Tail-alarm walk from the carried set WITHOUT touching it
+        (the plain non-donating jit): returns the exact dead index or
+        -1. Sound over-approximation semantics are the caller's (it
+        passes unresolved ops as crashed wildcards)."""
+        import jax.numpy as jnp
+
+        n = len(ret_slot)
+        if n == 0:
+            return -1
+        rs, so = self._pad_block(ret_slot, slot_ops)
+        if self.words:
+            _R, any_dead, first = _jitted_word_walk()(
+                self._T, self._R, jnp.asarray(rs), jnp.asarray(so))
+            if not bool(any_dead):
+                return -1
+            return min(int(first), n - 1)
+        ptr, _R, alive, R_block = _jitted_walk_returns()(
+            self._P, self._xor, self._bit, jnp.asarray(rs),
+            jnp.asarray(so), self._R)
+        if bool(alive):
+            return -1
+        return self._refine(rs, so, int(ptr), R_block, n)
+
+    def fetch(self) -> np.ndarray:
+        """The carried set back on host as bool [S, M] (geometry
+        re-encode before a memo rebuild / slot growth; counted as an
+        eager fetch)."""
+        obs.count("fetch.eager")
+        if self.words:
+            return _unpack_frontier_words(np.asarray(self._R), self.M)
+        return np.asarray(self._R).astype(bool)
+
+
+def _pack_frontier_words(R: np.ndarray, M: int, dt) -> np.ndarray:
+    """bool [S, M] -> one word per state (bit m = config (s, m))."""
+    S = R.shape[0]
+    out = np.zeros(S, dt)
+    for j in range(M):
+        out |= (R[:, j].astype(dt) << dt(j))
+    return out
+
+
+def _unpack_frontier_words(words: np.ndarray, M: int) -> np.ndarray:
+    m = np.arange(M).astype(words.dtype)
+    return ((words[:, None] >> m[None, :]) & 1).astype(bool)
+
+
+@functools.cache
+def _jitted_unpack_seed():
+    """Bit-packed seed -> dense bool [S, M] on device (static S/M)."""
+    import jax
+    import jax.numpy as jnp
+
+    def unpack(packed, S: int, M: int):
+        return jnp.unpackbits(packed, count=S * M).reshape(S, M) \
+                  .astype(jnp.bool_)
+
+    return jax.jit(unpack, static_argnums=(1, 2))
 
 
 # fast path applies while the fire-pass intermediate [S, W, M] AND the

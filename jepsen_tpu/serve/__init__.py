@@ -32,6 +32,12 @@ Layers (one module each):
 - :mod:`faults` — the self-nemesis: test-only fault points
   (dispatch/device/prep/persist/clock-jump) the chaos harness
   (``tools/chaos.py``) arms against a real daemon.
+- :mod:`session` — streaming check sessions: long-lived checks whose
+  reachable-config frontier stays device-resident across
+  ``POST /session/<id>/append`` blocks (donated in-place advance),
+  with incremental one-bool verdicts per append, journaled replay
+  across SIGKILL, and an exact close differential-identical to the
+  one-shot facade chain.
 
 Quick start::
 
@@ -53,11 +59,16 @@ from jepsen_tpu.serve.recovery import CircuitBreaker, RetryPolicy
 from jepsen_tpu.serve.request import (CANCELLED, DISPATCHED, DONE,
                                       QUARANTINED, QUEUED, TIMEOUT,
                                       CheckRequest, Registry)
+from jepsen_tpu.serve.session import (DeviceFrontierEngine, Session,
+                                      SessionRegistry,
+                                      TxnSessionEngine)
 
 __all__ = [
     "AdmissionQueue", "Backpressure", "plan_admission", "Dispatcher",
     "Daemon", "parse_check_body", "resolve_model", "CheckRequest",
     "Registry", "Journal", "CircuitBreaker", "RetryPolicy",
+    "Session", "SessionRegistry", "DeviceFrontierEngine",
+    "TxnSessionEngine",
     "QUEUED", "DISPATCHED", "DONE", "TIMEOUT", "CANCELLED",
     "QUARANTINED",
 ]

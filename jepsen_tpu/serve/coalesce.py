@@ -63,11 +63,22 @@ def plan_admission(requests: Sequence["rq.CheckRequest"], *,
     Returns index lists into ``requests``. Fairness ordering: tenants
     are ranked by their oldest member request's submit time, requests
     within a tenant by their own submit time — so the tenant who has
-    waited longest heads every group it appears in."""
+    waited longest heads every group it appears in.
+
+    Session blocks (append/close) are the exception to length
+    bucketing: a session's compatibility signature is its id, so a
+    call here only ever sees ONE session's blocks — they become a
+    single dispatch group in strict seq order (splitting them across
+    length buckets could dispatch block 3 before block 2, and a
+    carried frontier cannot be advanced out of order)."""
     from jepsen_tpu.checkers import reach_batch
 
     if not requests:
         return []
+    if requests[0].session is not None:
+        return [sorted(range(len(requests)),
+                       key=lambda i: (requests[i].seq,
+                                      requests[i].t_submit, i))]
     lens = [max(1, int(r.packed.n)) for r in requests]
     groups = reach_batch.plan_buckets(lens, w_hint, group=group)
     oldest_of: Dict[str, float] = {}
@@ -118,7 +129,7 @@ class AdmissionQueue:
             if not force and len(self._queued) >= self.max_depth:
                 obs.count("serve.rejected.backpressure")
                 obs.engine_fallback("serve-admit", "Backpressure",
-                                    tenant=req.tenant, ops=req.packed.n,
+                                    tenant=req.tenant, ops=req.n_ops,
                                     depth=len(self._queued))
                 raise Backpressure(
                     f"admission queue at bound ({self.max_depth})")
@@ -246,7 +257,7 @@ class AdmissionQueue:
     def _timeout_queued(self, req: "rq.CheckRequest") -> None:
         obs.count("serve.timeout")
         obs.engine_fallback("serve-timeout", "DeadlineExpired",
-                            tenant=req.tenant, ops=req.packed.n,
+                            tenant=req.tenant, ops=req.n_ops,
                             queued_s=round(
                                 time.monotonic() - req.t_submit, 6))
         cb = self.on_timeout

@@ -145,6 +145,7 @@ class Dispatcher:
         self.breaker = breaker or recovery.CircuitBreaker()
         self.dispatch_deadline_s = dispatch_deadline_s
         self.journal = journal          # durable WAL (set by Daemon)
+        self.sessions = None            # SessionRegistry (set by Daemon)
         # per-dispatch attribution flag, dispatcher-thread-only: did
         # any engine attempt actually touch the device this iteration
         self._device_ran = False
@@ -481,10 +482,89 @@ class Dispatcher:
                      "cause": "quarantined",
                      "error": f"{type(e).__name__}: {e}"}]
 
+    def _dispatch_session(self, batch: List["rq.CheckRequest"]) -> None:
+        """Session blocks: advance the carried frontier through each
+        append (seq order — the coalescer sorted the group), resolve
+        the close. No recovery ladder, no breaker, no lane pad: the
+        session owns its own fallback contract (exactly one
+        ``session-advance`` obs fallback → host monitor), so a block
+        that fails here still produces its verdict — host-side. No
+        device-time attribution either: the advance wall gets its own
+        counter so serve.device_s stays the one-shot walks' number."""
+        from jepsen_tpu.serve.session import SessionClosed
+        req0 = batch[0]
+        sess = req0.session
+        sig = f"session/{req0.model_name}/A{len(batch)}"
+        with self._counts_lock:
+            self.dispatch_counts[sig] = \
+                self.dispatch_counts.get(sig, 0) + 1
+        obs.count("serve.dispatched", len(batch))
+        obs.gauge("serve.inflight", len(batch))
+        t0 = time.monotonic()
+        for r in batch:
+            r.t_dispatch = time.monotonic()
+            obs.histogram("serve.queue_wait_s",
+                          max(0.0, (r.t_coalesce or t0) - r.t_submit))
+            self.registry.ledger_record(
+                r.tenant, "dispatched", id=r.id, group=len(batch),
+                ops=int(r.n_ops), session=sess.id, kind=r.kind)
+            with obs.capture() as cap:
+                try:
+                    if r.kind == "session-close":
+                        res = sess.close()
+                        if self.sessions is not None:
+                            self.sessions.mark_closed(sess)
+                        if self.journal is not None:
+                            self.journal.session_close_marker(
+                                sess.id, res)
+                    else:
+                        res = sess.advance_block(list(r.history),
+                                                 seq=r.seq)
+                except SessionClosed as e:
+                    res = {"valid": "unknown", "cause": "closed",
+                           "error": str(e)}
+                except Exception as e:                  # noqa: BLE001
+                    # the session's own ladder should have contained
+                    # this; a residual crash is recorded, never fatal
+                    log.warning("session block %s crashed: %r",
+                                r.id, e, exc_info=e)
+                    obs.engine_fallback("serve-dispatch",
+                                        type(e).__name__,
+                                        session=sess.id, id=r.id)
+                    if r.kind == "session-close" and not sess.closed:
+                        # a close that crashed must not wedge the
+                        # session: clearing the in-flight flag lets
+                        # the client retry (appends stay refused only
+                        # while a close is genuinely pending)
+                        sess.closing = False
+                    res = {"valid": "unknown",
+                           "error": f"{type(e).__name__}: {e}"}
+            now = time.monotonic()
+            r.t_collect = now
+            r.stitch([{"ts": round(time.time(), 6),
+                       "stage": "session-advance", "event": "advance",
+                       "session": sess.id, "seq": r.seq,
+                       "wall_s": round(now - r.t_dispatch, 6)}]
+                     + [rec for rec in cap.ledger
+                        if rec.get("event") in ("fallback", "route",
+                                                "selected")])
+            for rec in cap.ledger:
+                if rec.get("event") == "fallback":
+                    self.registry.ledger_record(
+                        r.tenant, "engine-fallback", id=r.id,
+                        stage=rec.get("stage"), cause=rec.get("cause"))
+            obs.histogram("serve.session.append_s", now - r.t_submit)
+            self._finish(r, res, now - r.t_dispatch, now)
+        obs.count("serve.session.advance_wall_s",
+                  time.monotonic() - t0)
+
     def _dispatch(self, batch: List["rq.CheckRequest"]) -> None:
         # the self-nemesis trigger clock (scheduled clock jumps fire
         # here); never raises for the shipped fault grammar
         faults.fire("tick")
+        if batch[0].session is not None:
+            self._dispatch_session(batch)
+            return
         req0 = batch[0]
         sig = f"{req0.model_name}/H{len(batch)}"
         with self._counts_lock:
@@ -665,7 +745,7 @@ class Dispatcher:
                    **{k: v for k, v in res.items() if k != "valid"}}
             obs.count("serve.timeout")
             obs.engine_fallback("serve-timeout", "DeadlineExpired",
-                                tenant=req.tenant, ops=req.packed.n,
+                                tenant=req.tenant, ops=req.n_ops,
                                 dispatched=True)
         else:
             # a conclusive verdict that merely finished late is still
@@ -680,7 +760,11 @@ class Dispatcher:
             obs.histogram("serve.service_s",
                           now - (req.t_coalesce or req.t_dispatch
                                  or req.t_submit))
-        if self.persist and status == rq.DONE:
+        if self.persist and status == rq.DONE \
+                and req.session is None:
+            # session blocks are not persisted as store runs: their
+            # durable record is the session journal (replayable), and
+            # a browsable run per append would bury real runs
             try:
                 # provisional done stamp so the PERSISTED waterfall
                 # carries its publish stage (registry.finish re-stamps
@@ -774,6 +858,10 @@ class Dispatcher:
         }
         if self.journal is not None:
             out["journal"] = self.journal.stats()
+        if self.sessions is not None:
+            # open-session census: count, oldest age, per-tenant —
+            # the /engine dashboard's "open sessions" row
+            out["sessions"] = self.sessions.census()
         out.update(self.registry.stats())
         return out
 

@@ -40,6 +40,16 @@
 - ``POST /profile`` — ``{"dispatches": N}`` arms ``jax.profiler``
   around the next N dispatches; the capture persists under
   ``<store-root>/serve/profile-<ts>/``.
+- ``POST /session`` — open a streaming check session (long-lived
+  check, device-resident carried frontier);
+  ``POST /session/<id>/append`` ships one event block and returns
+  the incremental verdict + tail-alarm status synchronously (202 +
+  request id past ``wait-s``); ``POST /session/<id>/close``
+  resolves the tail and returns the exact final verdict + witness
+  (differential-identical to the one-shot chain);
+  ``GET /session/<id>`` is the status view. Opens and appends are
+  journaled before their acknowledgement, so sessions ride a
+  SIGKILL: replay re-derives the frontier under the original id.
 """
 from __future__ import annotations
 
@@ -58,6 +68,7 @@ from jepsen_tpu.op import Op
 from jepsen_tpu.serve import faults, recovery
 from jepsen_tpu.serve import journal as jr
 from jepsen_tpu.serve import request as rq
+from jepsen_tpu.serve import session as sn
 from jepsen_tpu.serve.coalesce import AdmissionQueue, Backpressure
 from jepsen_tpu.serve.engine import Dispatcher
 
@@ -212,6 +223,10 @@ class Daemon:
             jnl = self.journal
             self.registry.on_terminal = (
                 lambda req: jnl.finish(req.id, req.status, req.result))
+        # streaming check sessions: long-lived checks whose carried
+        # frontier the dispatcher advances per append block
+        self.sessions = sn.SessionRegistry()
+        self.dispatcher.sessions = self.sessions
         handler = type("Handler", (_Handler,), {"daemon_ref": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._serve_thread: Optional[threading.Thread] = None
@@ -228,6 +243,7 @@ class Daemon:
         if dispatch:
             self.dispatcher.start()
             self.replay_journal()
+            self.replay_sessions()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http",
             daemon=True)
@@ -239,6 +255,7 @@ class Daemon:
         shuts down gracefully."""
         self.dispatcher.start()
         self.replay_journal()
+        self.replay_sessions()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -328,6 +345,319 @@ class Daemon:
         if n:
             log.info("journal replay: %d request(s) readmitted", n)
         return n
+
+    def replay_sessions(self) -> int:
+        """Re-create every open (unclosed) journaled session and
+        replay its append blocks in seq order THROUGH THE ENGINE —
+        the carried frontier re-derives deterministically from the
+        stream, so a session rides a SIGKILL keeping its id, its seq
+        counter, and its verdict. Corrupt session metadata gets a
+        structured close marker (quarantine analog), never a loop."""
+        if self.journal is None:
+            return 0
+        n = 0
+        for sid in self.journal.open_session_ids():
+            if self.sessions.get(sid) is not None:
+                continue            # already live (double replay call)
+            meta = self.journal.load_session(sid)
+            try:
+                if meta is None:
+                    raise ValueError("unreadable session entry")
+                model_name = str(meta["model"])
+                model = resolve_model(model_name)
+                opts = {k: v
+                        for k, v in (meta.get("options") or {}).items()
+                        if k in _CLIENT_OPTS}
+            except Exception as e:                      # noqa: BLE001
+                log.warning("session %s unreplayable: %s", sid, e)
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, session=sid,
+                                    replay=True)
+                self.journal.session_close_marker(
+                    sid, {"valid": "unknown",
+                          "cause": "session-journal-corrupt",
+                          "error": f"{type(e).__name__}: {e}"})
+                continue
+            sess = sn.Session(
+                sid, str(meta.get("tenant") or "anonymous"),
+                model_name, model, opts)
+            blocks = self.journal.session_appends(sid)
+            for seq, entry in blocks:
+                if seq != sess.seq + 1:
+                    # a seq GAP (missing/unreadable block file):
+                    # replay TRUNCATES here — advancing past the hole
+                    # would derive a frontier from a stream missing a
+                    # block AND falsely dedup the client's retry of
+                    # it. The client's retries re-apply from the
+                    # truncation point.
+                    obs.engine_fallback("serve-journal", "SeqGap",
+                                        session=sid, seq=seq,
+                                        expected=sess.seq + 1)
+                    break
+                try:
+                    ops = jr.history_from_edn(entry["history-edn"])
+                    sess.advance_block(ops, seq=seq)
+                except Exception as e:                  # noqa: BLE001
+                    # a torn block was never acknowledged: stop HERE
+                    # (same truncation argument — sess.seq must not
+                    # move past an unapplied block)
+                    obs.engine_fallback("serve-journal",
+                                        type(e).__name__, session=sid,
+                                        seq=seq)
+                    break
+                sess.seq = seq
+                sess.replayed += 1
+            try:
+                self.sessions.add(sess)
+            except RuntimeError as e:
+                # past the open-session bound: leave the session
+                # journaled (a later restart, after closes/GC, can
+                # still replay it) — a full registry must degrade a
+                # session, never abort the daemon's boot
+                log.warning("session %s not replayed: %s", sid, e)
+                obs.engine_fallback("serve-journal", "SessionBound",
+                                    session=sid, replay=True)
+                continue
+            self.registry.ledger_record(sess.tenant,
+                                        "session-replayed",
+                                        session=sid,
+                                        appends=len(blocks))
+            obs.count("serve.session.replayed")
+            n += 1
+        if n:
+            log.info("session replay: %d session(s) re-derived", n)
+        return n
+
+    # -- streaming sessions (called from HTTP worker threads) ------------
+    def session_open(self, body: bytes, content_type: str,
+                     header_tenant: Optional[str]) -> Tuple[int, Dict]:
+        if not self.accepting:
+            return 503, {"error": "shutting down"}
+        try:
+            text = body.decode("utf-8") if body else "{}"
+            if "edn" in (content_type or ""):
+                vals = edn.loads_all(text)
+                data = edn.to_plain(vals[0]) if vals else {}
+            else:
+                data = json.loads(text) if text.strip() else {}
+            if not isinstance(data, dict):
+                raise ValueError("body must be a map")
+            model_name = str(data.get("model", "cas-register"))
+            model = resolve_model(model_name)
+            tenant = str(data.get("tenant") or header_tenant
+                         or "anonymous")[:64]
+            options = {k: v
+                       for k, v in (data.get("options") or {}).items()
+                       if k in _CLIENT_OPTS}
+        except Exception as e:                          # noqa: BLE001
+            return 400, {"error": f"{type(e).__name__}: {e}"}
+        sid = sn.new_session_id()
+        if self.journal is not None:
+            try:
+                # durable BEFORE the id is returned: the journaled
+                # appends need a session entry to replay into
+                self.journal.session_open(sid, tenant=tenant,
+                                          model_name=model_name,
+                                          options=options)
+            except OSError as e:
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, session=sid)
+                return 500, {"error": f"journal write failed: {e}"}
+        sess = sn.Session(sid, tenant, model_name, model, options)
+        try:
+            self.sessions.add(sess)
+        except RuntimeError as e:
+            if self.journal is not None:
+                self.journal.discard_session(sid)
+            return 429, {"error": str(e), "retry-after-s": 1.0}
+        self.registry.ledger_record(tenant, "session-opened",
+                                    session=sid, model=model_name)
+        return 201, {"session": sid, "status": "open",
+                     "tenant": tenant, "model": model_name,
+                     "engine": sess.engine_name}
+
+    def _parse_append(self, body: bytes, content_type: str
+                      ) -> Tuple[list, Optional[int], Optional[float],
+                                 float]:
+        text = body.decode("utf-8")
+        if "edn" in (content_type or ""):
+            vals = edn.loads_all(text)
+            if len(vals) != 1:
+                raise ValueError("expected one EDN map")
+            data = edn.to_plain(vals[0])
+        else:
+            data = json.loads(text)
+        if not isinstance(data, dict):
+            raise ValueError("body must be a map")
+        raw = data.get("history")
+        if not isinstance(raw, list) or not raw:
+            raise ValueError("'history' must be a non-empty list of "
+                             "ops")
+        ops = [Op.from_dict(edn.to_plain(d) if not isinstance(d, dict)
+                            else d) for d in raw]
+        seq = data.get("seq")
+        seq = int(seq) if seq is not None else None
+        timeout_s = data.get("timeout-s", data.get("timeout_s"))
+        timeout_s = float(timeout_s) if timeout_s is not None else None
+        wait_s = float(data.get("wait-s", 30.0))
+        return ops, seq, timeout_s, wait_s
+
+    def session_append(self, sid: str, body: bytes,
+                       content_type: str) -> Tuple[int, Dict]:
+        if not self.accepting:
+            return 503, {"error": "shutting down"}
+        sess = self.sessions.get(sid)
+        if sess is None:
+            term = (self.journal.session_lookup_closed(sid)
+                    if self.journal is not None else None)
+            if term is not None:
+                return 409, {"error": f"session {sid!r} is closed",
+                             "session": sid, "status": "closed"}
+            return 404, {"error": f"unknown session {sid!r}"}
+        try:
+            ops, seq, timeout_s, wait_s = self._parse_append(
+                body, content_type)
+        except Exception as e:                          # noqa: BLE001
+            return 400, {"error": f"{type(e).__name__}: {e}"}
+        with sess.lock:
+            # closed/closing re-checked UNDER the lock: an append
+            # racing a concurrent close must get its 409, not journal
+            # a block into a closing session
+            if sess.closed or sess.closing:
+                return 409, {"error": f"session {sid!r} is closed",
+                             "session": sid, "status": "closed"}
+            if seq is not None and seq <= sess.seq:
+                # at-least-once on the client side, exactly-once on
+                # the frontier: a retried block (response lost to a
+                # crash/restart) dedups to the already-applied seq
+                obs.count("serve.session.deduped")
+                out = sess.status()
+                out.update({"deduped": True, "seq": seq})
+                return 200, out
+            if seq is not None and seq != sess.seq + 1:
+                # a seq GAP is a protocol error, never silently
+                # renumbered: accepting block k+2 as k+1 would break
+                # the dedup contract (a later retry of the true k+1
+                # would then double-advance the frontier)
+                return 409, {"error": f"seq gap: expected "
+                                      f"{sess.seq + 1}, got {seq}",
+                             "session": sid, "seq": sess.seq}
+            this_seq = sess.seq + 1
+            if self.journal is not None:
+                # durable BEFORE the verdict: the replay re-derives
+                # the frontier from journaled blocks in seq order
+                try:
+                    self.journal.session_append_entry(sid, this_seq,
+                                                      ops)
+                except OSError as e:
+                    obs.engine_fallback("serve-journal",
+                                        type(e).__name__, session=sid)
+                    return 500, {"error":
+                                 f"journal write failed: {e}"}
+            # NO deadline on an append: a journaled block is part of
+            # the session's durable stream — expiring it queued would
+            # leave a hole in the carried frontier while seq already
+            # advanced past it (the client bounds its own wait with
+            # wait-s and polls GET /check/<id> for slow dispatches)
+            del timeout_s
+            req = rq.CheckRequest(
+                id=rq.new_request_id(), tenant=sess.tenant,
+                model_name=sess.model_name, model=sess.model,
+                packed=None, history=ops, n_ops=len(ops),
+                opts=dict(sess.opts),
+                kind="session-append", session=sess, seq=this_seq)
+            try:
+                self.registry.add(req)
+                self.queue.submit(req)
+            except Backpressure as e:
+                self.registry.remove(req.id)
+                if self.journal is not None:
+                    self.journal.discard_session_append(sid, this_seq)
+                self.registry.ledger_record(sess.tenant, "rejected",
+                                            cause="backpressure",
+                                            session=sid)
+                return 429, {"error": str(e), "retry-after-s": 1.0}
+            sess.seq = this_seq
+        # synchronous by default: the append's whole point is a
+        # verdict seconds after the ops ran. A slow dispatch returns
+        # 202 + the request id; the verdict arrives via GET /check/<id>
+        if req.done_event.wait(wait_s) and req.result is not None:
+            out = dict(req.result)
+            out["id"] = req.id
+            out["status"] = req.status
+            return 200, out
+        return 202, {"id": req.id, "session": sid, "seq": this_seq,
+                     "status": req.status}
+
+    def session_close(self, sid: str, body: bytes = b""
+                      ) -> Tuple[int, Dict]:
+        sess = self.sessions.get(sid)
+        if sess is None:
+            term = (self.journal.session_lookup_closed(sid)
+                    if self.journal is not None else None)
+            if term is not None:
+                out = {"session": sid, "status": "closed",
+                       "recovered-from-journal": True}
+                if term.get("result") is not None:
+                    out["result"] = term["result"]
+                return 200, out
+            return 404, {"error": f"unknown session {sid!r}"}
+        if sess.closed:
+            return 200, {"session": sid, "status": "closed",
+                         "result": dict(sess.result or {})}
+        try:
+            wait_s = float((json.loads(body.decode() or "{}")
+                            or {}).get("wait-s", 120.0)) \
+                if body else 120.0
+        except Exception:                               # noqa: BLE001
+            wait_s = 120.0
+        with sess.lock:
+            if sess.closing:
+                return 409, {"error": f"close of {sid!r} already in "
+                                      f"flight"}
+            sess.closing = True
+            req = rq.CheckRequest(
+                id=rq.new_request_id(), tenant=sess.tenant,
+                model_name=sess.model_name, model=sess.model,
+                packed=None, history=(), n_ops=len(sess.ops),
+                opts=dict(sess.opts),
+                kind="session-close", session=sess,
+                seq=sess.seq + 1)
+            try:
+                self.registry.add(req)
+                self.queue.submit(req)
+            except Backpressure as e:
+                sess.closing = False
+                self.registry.remove(req.id)
+                return 429, {"error": str(e), "retry-after-s": 1.0}
+        if req.done_event.wait(wait_s) and req.result is not None:
+            if not sess.closed:
+                # the close dispatch crashed (closing was cleared so
+                # a retry can succeed): report the TRUTH — the
+                # session is still open — not a fabricated "closed"
+                return 500, {"session": sid, "status": "open",
+                             "id": req.id,
+                             "error": "close failed; retry",
+                             "result": dict(req.result)}
+            out = {"session": sid, "status": "closed",
+                   "id": req.id, "result": dict(req.result)}
+            return 200, out
+        return 202, {"id": req.id, "session": sid,
+                     "status": req.status}
+
+    def session_status(self, sid: str) -> Tuple[int, Dict]:
+        sess = self.sessions.get(sid)
+        if sess is not None:
+            return 200, sess.status()
+        term = (self.journal.session_lookup_closed(sid)
+                if self.journal is not None else None)
+        if term is not None:
+            out = {"session": sid, "status": "closed",
+                   "recovered-from-journal": True}
+            if term.get("result") is not None:
+                out["result"] = term["result"]
+            return 200, out
+        return 404, {"error": f"unknown session {sid!r}"}
 
     # -- request handling (called from HTTP worker threads) -------------
     def _reserve_idem(self, tenant: str, idem: str,
@@ -600,9 +930,32 @@ class _Handler(BaseHTTPRequestHandler):
             code, payload = self.daemon_ref.profile(body)
             self._reply(code, payload)
             return
+        if path == "/session":
+            body = self.rfile.read(n) if n else b""
+            code, payload = self.daemon_ref.session_open(
+                body, self.headers.get("Content-Type", ""),
+                self.headers.get("X-Tenant"))
+            self._reply(code, payload)
+            return
+        if path.startswith("/session/"):
+            rest = path[len("/session/"):]
+            sid, _, action = rest.partition("/")
+            body = self.rfile.read(n) if n else b""
+            if action == "append":
+                code, payload = self.daemon_ref.session_append(
+                    sid, body, self.headers.get("Content-Type", ""))
+            elif action == "close":
+                code, payload = self.daemon_ref.session_close(
+                    sid, body)
+            else:
+                code, payload = 404, {
+                    "error": "POST /session/<id>/append or .../close"}
+            self._reply(code, payload)
+            return
         if path != "/check":
             self._reply(404,
-                        {"error": "POST /check or /profile only"})
+                        {"error": "POST /check, /session or "
+                                  "/profile only"})
             return
         body = self.rfile.read(n) if n else b""
         code, payload = self.daemon_ref.submit(
@@ -615,6 +968,11 @@ class _Handler(BaseHTTPRequestHandler):
         if path.startswith("/check/"):
             code, payload = self.daemon_ref.lookup(
                 path[len("/check/"):].strip("/"))
+            self._reply(code, payload)
+            return
+        if path.startswith("/session/"):
+            code, payload = self.daemon_ref.session_status(
+                path[len("/session/"):].strip("/"))
             self._reply(code, payload)
             return
         if path.rstrip("/") == "/stats":

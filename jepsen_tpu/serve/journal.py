@@ -44,7 +44,7 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from jepsen_tpu import edn
 from jepsen_tpu import obs
@@ -54,6 +54,14 @@ log = logging.getLogger("jepsen.serve.journal")
 
 _REQ_SUFFIX = ".req.json"
 _DONE_SUFFIX = ".done.json"
+# streaming check sessions: one .sess.json (open meta) + one
+# .a<seq>.sapp.json per append block + one .sdone.json close marker
+# per session. Disjoint suffixes keep the one-shot views
+# (_ids/pending_count/idempotency_index) blind to session files.
+_SESS_SUFFIX = ".sess.json"
+_SAPP_MID = ".a"
+_SAPP_SUFFIX = ".sapp.json"
+_SDONE_SUFFIX = ".sdone.json"
 
 
 def history_to_edn(history) -> str:
@@ -129,16 +137,17 @@ class Journal:
         self._write(self._req_path(req_id), entry)
         obs.count("serve.journal.appended")
 
-    def finish(self, req_id: str, status: str,
-               result: Optional[Dict[str, Any]] = None) -> None:
-        """Mark a journaled request terminal (idempotent; the first
-        marker wins — the exists-check and the write share the lock,
-        so a concurrent cancel cannot clobber a published verdict's
-        marker). Unknown ids are a no-op — requests admitted while
-        journaling was off, or already collected."""
-        done = self._done_path(req_id)
-        payload = {"id": req_id, "status": status,
-                   "ts": round(time.time(), 6)}
+    def _write_marker(self, entry_path: str, done_path: str,
+                      payload: Dict[str, Any],
+                      result: Optional[Dict[str, Any]],
+                      **obs_kw: Any) -> bool:
+        """Shared terminal-marker writer (one-shot ``finish`` and the
+        session close marker): JSON-sanitize the result, and — UNDER
+        the lock — exists-check the entry, first-marker-wins check
+        the done path, then write. A failed write means the entry
+        replays after a crash (at-least-once, never lost): recorded,
+        never raised into the dispatcher. Returns True iff THIS call
+        wrote the marker."""
         if result is not None:
             try:
                 payload["result"] = json.loads(
@@ -146,20 +155,33 @@ class Journal:
             except (TypeError, ValueError):
                 pass
         with self._lock:
-            if not os.path.exists(self._req_path(req_id)) \
-                    or os.path.exists(done):
-                return
+            if not os.path.exists(entry_path) \
+                    or os.path.exists(done_path):
+                return False
             try:
-                self._write(done, payload)
+                self._write(done_path, payload)
             except OSError as e:
-                # a failed marker means the entry replays after a
-                # crash — at-least-once, never lost; record, don't
-                # raise into the dispatcher
-                log.warning("journal finish failed for %s: %s",
-                            req_id, e)
+                log.warning("journal marker failed for %s: %s",
+                            done_path, e)
                 obs.engine_fallback("serve-journal",
-                                    type(e).__name__, id=req_id)
-                return
+                                    type(e).__name__, **obs_kw)
+                return False
+            return True
+
+    def finish(self, req_id: str, status: str,
+               result: Optional[Dict[str, Any]] = None) -> None:
+        """Mark a journaled request terminal (idempotent; the first
+        marker wins — the exists-check and the write share the lock,
+        so a concurrent cancel cannot clobber a published verdict's
+        marker). Unknown ids are a no-op — requests admitted while
+        journaling was off, or already collected."""
+        wrote = self._write_marker(
+            self._req_path(req_id), self._done_path(req_id),
+            {"id": req_id, "status": status,
+             "ts": round(time.time(), 6)}, result, id=req_id)
+        if not wrote:
+            return
+        with self._lock:
             self._finishes += 1
             due = self._finishes % self.gc_every == 0
         if due:
@@ -187,6 +209,177 @@ class Journal:
                     {"valid": "unknown", "cause": "cancelled"})
         term = self.lookup_terminal(req_id)
         return bool(term) and term.get("status") == "cancelled"
+
+    # -- streaming sessions ----------------------------------------------
+    def _sess_path(self, sid: str) -> str:
+        return os.path.join(self.root, sid + _SESS_SUFFIX)
+
+    def _sapp_path(self, sid: str, seq: int) -> str:
+        return os.path.join(self.root,
+                            f"{sid}{_SAPP_MID}{seq:06d}{_SAPP_SUFFIX}")
+
+    def _sdone_path(self, sid: str) -> str:
+        return os.path.join(self.root, sid + _SDONE_SUFFIX)
+
+    def session_open(self, sid: str, *, tenant: str, model_name: str,
+                     options: Dict[str, Any]) -> None:
+        """Durably record an opened session (BEFORE its id is
+        returned): the open itself must survive a SIGKILL or the
+        journaled appends have no session to replay into."""
+        self._write(self._sess_path(sid), {
+            "session": sid, "tenant": tenant, "model": model_name,
+            "options": dict(options or {}),
+            "opened-at": round(time.time(), 6)})
+        obs.count("serve.journal.session_opened")
+
+    def session_append_entry(self, sid: str, seq: int,
+                             history) -> None:
+        """Durably record one append block (BEFORE its verdict is
+        computed, let alone returned): a crash mid-advance replays
+        the block and re-derives the frontier from seq order."""
+        self._write(self._sapp_path(sid, seq), {
+            "session": sid, "seq": int(seq),
+            "appended-at": round(time.time(), 6),
+            "history-edn": history_to_edn(history)})
+        obs.count("serve.journal.session_appended")
+
+    def discard_session_append(self, sid: str, seq: int) -> None:
+        """Retract a block whose admission bounced (backpressure after
+        the journal write — the client got a 429, not a verdict)."""
+        try:
+            os.unlink(self._sapp_path(sid, seq))
+        except OSError:
+            pass
+
+    def session_close_marker(self, sid: str,
+                             result: Optional[Dict[str, Any]] = None
+                             ) -> None:
+        """Mark a session closed (idempotent, first marker wins — the
+        shared :meth:`_write_marker` discipline): a restart neither
+        replays nor resurrects it, and the close verdict survives.
+        Closes drive the GC cadence too — a session-dominated daemon
+        (finish() no-ops for session ids) must still collect its
+        terminal files."""
+        wrote = self._write_marker(
+            self._sess_path(sid), self._sdone_path(sid),
+            {"session": sid, "ts": round(time.time(), 6)}, result,
+            session=sid)
+        if not wrote:
+            return
+        with self._lock:
+            self._finishes += 1
+            due = self._finishes % self.gc_every == 0
+        if due:
+            self.gc()
+
+    def open_session_ids(self) -> List[str]:
+        """Sessions with an open entry and no close marker (replay
+        candidates), oldest first."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        done = {n[:-len(_SDONE_SUFFIX)] for n in names
+                if n.endswith(_SDONE_SUFFIX)}
+        sids = [n[:-len(_SESS_SUFFIX)] for n in names
+                if n.endswith(_SESS_SUFFIX)
+                and n[:-len(_SESS_SUFFIX)] not in done]
+
+        def _mtime(sid: str) -> float:
+            try:
+                return os.path.getmtime(self._sess_path(sid))
+            except OSError:
+                return 0.0
+        return sorted(sids, key=lambda s: (_mtime(s), s))
+
+    def load_session(self, sid: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._sess_path(sid)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def session_lookup_closed(self, sid: str
+                              ) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._sdone_path(sid)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def session_appends(self, sid: str
+                        ) -> List[Tuple[int, Dict[str, Any]]]:
+        """Journaled append blocks of one session, ``(seq, entry)``
+        in seq order. Corrupt entries are skipped with a recorded
+        fallback (the replayer re-derives what it can; a torn append
+        was never acknowledged)."""
+        prefix = sid + _SAPP_MID
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        out: List[Tuple[int, Dict[str, Any]]] = []
+        for n in sorted(names):
+            if not (n.startswith(prefix)
+                    and n.endswith(_SAPP_SUFFIX)):
+                continue
+            try:
+                with open(os.path.join(self.root, n)) as f:
+                    entry = json.load(f)
+                out.append((int(entry["seq"]), entry))
+            except (OSError, ValueError, KeyError) as e:
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, session=sid,
+                                    entry=n)
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def discard_session(self, sid: str) -> None:
+        """Remove every file of one session (GC of closed sessions)."""
+        for seq, _e in self.session_appends(sid):
+            self.discard_session_append(sid, seq)
+        for p in (self._sess_path(sid), self._sdone_path(sid)):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    @staticmethod
+    def _gc_oldest(ids: List[str], path_of, excess: int,
+                   discard) -> int:
+        """Shared oldest-marker-first collection (one-shot pairs and
+        closed sessions): mtime-sort the marker paths, discard the
+        ``excess`` oldest. Counters are the callers'."""
+        if excess <= 0:
+            return 0
+
+        def _mtime(x: str) -> float:
+            try:
+                return os.path.getmtime(path_of(x))
+            except OSError:
+                return 0.0
+        ids.sort(key=lambda x: (_mtime(x), x))
+        n = 0
+        for x in ids[:excess]:
+            discard(x)
+            n += 1
+        return n
+
+    def _gc_sessions(self) -> int:
+        """Collect CLOSED sessions past ``keep_terminal``, oldest
+        close marker first; open sessions are never touched."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        closed = [n[:-len(_SDONE_SUFFIX)] for n in names
+                  if n.endswith(_SDONE_SUFFIX)]
+        n = self._gc_oldest(closed, self._sdone_path,
+                            len(closed) - self.keep_terminal,
+                            self.discard_session)
+        if n:
+            obs.count("serve.journal.session_gc", n)
+        return n
 
     # -- views -----------------------------------------------------------
     def _ids(self) -> Dict[str, bool]:
@@ -220,6 +413,18 @@ class Journal:
         # per-entry mtime stats — pending_ids' sort order is only
         # needed by replay
         return sum(1 for fin in self._ids().values() if not fin)
+
+    def open_session_count(self) -> int:
+        # hot path (per-dispatch stats): one listdir, no mtime sort —
+        # open_session_ids' ordering is only needed by replay
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        done = {n[:-len(_SDONE_SUFFIX)] for n in names
+                if n.endswith(_SDONE_SUFFIX)}
+        return sum(1 for n in names if n.endswith(_SESS_SUFFIX)
+                   and n[:-len(_SESS_SUFFIX)] not in done)
 
     def load_entry(self, req_id: str) -> Optional[Dict[str, Any]]:
         try:
@@ -255,30 +460,19 @@ class Journal:
         """Collect terminal entry/marker pairs past ``keep_terminal``,
         oldest marker first. Pending entries are never touched.
         Returns how many requests were collected."""
-        pairs = [(rid, self._done_path(rid))
-                 for rid, fin in self._ids().items() if fin]
-        excess = len(pairs) - self.keep_terminal
-        if excess <= 0:
-            return 0
-
-        def _mtime(p: str) -> float:
-            try:
-                return os.path.getmtime(p)
-            except OSError:
-                return 0.0
-        pairs.sort(key=lambda t: (_mtime(t[1]), t[0]))
-        n = 0
-        for rid, _ in pairs[:excess]:
-            self.discard(rid)
-            n += 1
+        ids = [rid for rid, fin in self._ids().items() if fin]
+        n = self._gc_oldest(ids, self._done_path,
+                            len(ids) - self.keep_terminal,
+                            self.discard)
         if n:
             obs.count("serve.journal.gc", n)
-        return n
+        return n + self._gc_sessions()
 
     def stats(self) -> Dict[str, Any]:
         ids = self._ids()
         pending = sum(1 for fin in ids.values() if not fin)
         return {"pending": pending,
                 "terminal": len(ids) - pending,
+                "sessions-open": self.open_session_count(),
                 "keep_terminal": self.keep_terminal,
                 "root": self.root}

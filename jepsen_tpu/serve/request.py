@@ -69,6 +69,15 @@ class CheckRequest:
     idem_key: Optional[str] = None          # client idempotency key
     requeues: int = 0                       # hung-dispatch requeues
     journaled: bool = False                 # has a durable WAL entry
+    # streaming check sessions: an append/close block rides the same
+    # queue as one-shot checks, but its coalescing signature is the
+    # SESSION id (same-session blocks coalesce into one ordered
+    # dispatch group; the dispatcher advances the carried frontier in
+    # seq order) and its journal entry is the session's, not a
+    # .req.json (kind: "check" | "session-append" | "session-close")
+    kind: str = "check"
+    session: Optional[Any] = None           # serve.session.Session
+    seq: int = 0                            # per-session append order
     # stage timestamps (time.monotonic): admit -> coalesce (selected
     # into a dispatch group) -> dispatch (engine call starts) ->
     # collect (engine call returned) -> done (verdict published).
@@ -99,7 +108,14 @@ class CheckRequest:
         signature may ride one dispatch group — same model (the
         union-alphabet stage A is built per model identity) AND same
         engine options (a group shares one walk, so differing caps
-        cannot both be honored; clients who set none share freely)."""
+        cannot both be honored; clients who set none share freely).
+        Session blocks key on the SESSION id instead: a session's
+        appends must advance its carried frontier in order, so they
+        coalesce only with each other (queued appends of one session
+        batch into one ordered dispatch — the continuous-batching win
+        applied to a stream)."""
+        if self.session is not None:
+            return ("session", self.session.id)
         return (type(self.model).__name__, repr(self.model),
                 tuple(sorted(self.opts.items())))
 

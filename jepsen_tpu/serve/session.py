@@ -1,0 +1,658 @@
+"""Streaming check sessions: device-resident online verification.
+
+A *session* is a long-lived check (ROADMAP item 2): ``POST /session``
+opens one, each ``POST /session/<id>/append`` ships an event block and
+returns the incremental one-bool verdict seconds after the ops ran —
+not at teardown — and ``POST /session/<id>/close`` resolves the
+unsettled tail and returns the exact final verdict + witness,
+differential-identical to ``facade.auto_check_packed`` (or
+``auto_check_txn``) on the concatenated history.
+
+The two incremental engines:
+
+- **Register-family models** (:class:`DeviceFrontierEngine`): the
+  settled-prefix/unsettled-tail discipline and the
+  fail-fast-is-permanent semantics are *exactly*
+  :mod:`jepsen_tpu.checkers.online`'s — this engine IS
+  ``online.NativeStreamEngine`` (the C++ monitor core does slot
+  assignment, settle-queue snapshots, and wildcard interning) with
+  one substitution: the settled-returns walk happens on the
+  accelerator through :class:`jepsen_tpu.checkers.reach.FrontierCarry`
+  — the reachable-config frontier ``R [S, M]`` stays device-resident
+  across appends (the dense body's carry donated so XLA advances it
+  in place; the word-packed body's carry is a few machine words and
+  deliberately not donated), and each append ships only its block's
+  narrow ``(ret_slot, slot_ops)`` operands plus one alive-bool
+  fetch. The
+  unsettled-tail alarm walks a bounded tail from the carried set
+  without touching it (non-donating probe).
+- **``txn-list-append``** (:class:`TxnSessionEngine`): the inferred
+  ww/wr/rw adjacency grows incrementally
+  (:class:`jepsen_tpu.txn.infer.IncrementalInfer` — reads settle once
+  every observed value has a known appender, so edges are monotone
+  and an early cycle alarm is sound) and the boolean-matmul closure
+  re-closes only the dirty row/column blocks per append batch
+  (:class:`jepsen_tpu.txn.cycles.IncrementalClosure`), making
+  ``txn/cycles.py`` an online anomaly detector.
+
+Fallback contract (the engine-stack discipline): any device-path
+death records exactly ONE ``session-advance`` obs fallback and the
+session falls PERMANENTLY back to the host path —
+:class:`~jepsen_tpu.checkers.online.OnlineLinearizable` replaying the
+accumulated stream for register models, the host SCC booleans over
+the accumulated graph for txn — with identical verdicts. Capacity
+declines (dense overflow, no native lib) are recorded route
+decisions, not fallbacks.
+
+Sessions ride the daemon's existing machinery: appends are
+:class:`~jepsen_tpu.serve.request.CheckRequest`s whose coalescing
+signature is the session id (so queued appends of one session
+coalesce into ONE ordered dispatch group — continuous batching of
+appends — while one-shot checks flow around them in the same
+dispatcher loop), they are journaled before their response so a
+SIGKILL'd daemon replays the stream and re-derives the frontier, and
+every verdict lands in the standard registry/ledger plumbing.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Sequence
+
+from jepsen_tpu import history as h
+from jepsen_tpu import obs
+from jepsen_tpu.checkers import online
+from jepsen_tpu.models import Model
+from jepsen_tpu.op import Op
+from jepsen_tpu.serve import faults
+
+log = logging.getLogger("jepsen.serve.session")
+
+# engine options a session forwards to its incremental engines and the
+# close-time exact check (the daemon already allow-lists client opts)
+_ENG_KW = ("max_states", "max_slots", "max_dense")
+
+
+def new_session_id() -> str:
+    import uuid
+    return "s" + uuid.uuid4().hex[:15]
+
+
+class SessionClosed(RuntimeError):
+    """Appends after close are a client error (HTTP 409)."""
+
+
+# -- register-family device engine ----------------------------------------
+
+class DeviceFrontierEngine(online.NativeStreamEngine):
+    """``online.NativeStreamEngine`` with the settled-returns walk on
+    the accelerator: feed/settle bookkeeping stays in the C++ monitor
+    core (``jt_mon_feed`` / the new ``jt_mon_drain``), the carried
+    frontier lives device-resident in a
+    :class:`reach.FrontierCarry`. Geometry changes (memo rebuild on
+    a fresh alphabet entry, slot growth) sync the frontier host-side,
+    re-encode exactly like the host engine, and reseed the carry —
+    rare events that stabilize once the alphabet does."""
+
+    def __init__(self, model: Model, **kw: Any) -> None:
+        super().__init__(model, **kw)
+        self._carry = None                  # reach.FrontierCarry
+
+    # -- geometry-change sync (device -> host mirror first) -------------
+    def _sync_host(self) -> None:
+        if self._carry is not None and self.R is not None:
+            self.R = self._carry.fetch()
+            self._carry = None
+
+    def _rebuild_memo(self) -> None:
+        self._sync_host()
+        super()._rebuild_memo()
+        self._carry = None
+
+    def _grow_W(self, W2: int) -> None:
+        self._sync_host()
+        super()._grow_W(W2)
+        self._carry = None
+
+    def _ensure_carry(self):
+        if self._carry is None:
+            from jepsen_tpu.checkers import reach
+            S = self.R.shape[0]
+            # P is built LAZILY: the word-packed body only needs the
+            # flat table, and materializing the O(O*S^2) dense tensor
+            # for it would burn memory the walk never touches
+            self._carry = reach.FrontierCarry(
+                None, self.W, 1 << self.W, self.R,
+                table=self.memo.table,
+                p_build=lambda: reach._build_P(self.memo, S))
+        return self._carry
+
+    # -- the walks (device) ----------------------------------------------
+    def advance(self, run_over: bool = False
+                ) -> Optional[Dict[str, Any]]:
+        if self.violation is not None:
+            return self.violation
+        self._drain()
+        if run_over:
+            # base-class semantics: stragglers resolve as crashed,
+            # making the final incremental verdict the exact one
+            self._resolve_stragglers()
+        if self.memo is None:
+            return None
+        _s, queued, _l, _w, front_ok = self._mon.stats()
+        if queued == 0 or not front_ok:
+            return None
+        rows, slots, binds = self._mon.drain(queued, self.W)
+        if len(slots) == 0:
+            return None
+        dead = self._ensure_carry().advance(slots, rows)
+        n = len(slots) if dead < 0 else dead + 1
+        self.settled_returns += n
+        self.walked_events += n
+        if dead >= 0:
+            self.violation = self._violation_at(int(binds[dead]))
+        return self.violation
+
+    def tail_alarm(self) -> Optional[Dict[str, Any]]:
+        if self.violation is not None or self.memo is None:
+            return None
+        self._drain()
+        _s, queued, _l, _w, _f = self._mon.stats()
+        if queued == 0:
+            return None         # nothing unsettled: nothing to alarm on
+        rows, slots, binds = self._mon.tail(self._TAIL_CAP, self.W)
+        if len(slots) == 0:
+            return None
+        dead = self._ensure_carry().probe(slots, rows)
+        if dead >= 0:
+            self.violation = self._violation_at(int(binds[dead]))
+            self.violation["tail-alarm"] = True
+        return self.violation
+
+
+# -- txn incremental engine -----------------------------------------------
+
+class TxnSessionEngine:
+    """Incremental Elle-style anomaly detection for one session:
+    host-side stateful inference + the device-resident dirty-block
+    closure. Direct anomalies (non-prefix reads, duplicate appends,
+    G1a) fail the session the moment they are proven, exactly like a
+    frontier death fails a register session."""
+
+    def __init__(self, *, max_dense_txns: Optional[int] = None) -> None:
+        from jepsen_tpu.txn import cycles
+        from jepsen_tpu.txn.infer import IncrementalInfer
+        self.infer = IncrementalInfer()
+        self.closure = cycles.IncrementalClosure(
+            max_dense_txns=max_dense_txns)
+        # the self-nemesis hook, fired right before the device
+        # closure — AFTER inference consumed the block, so the
+        # session's fallback can resume with an empty re-feed (the
+        # host classify reads the full accumulated graph)
+        self.fire_hook = lambda: None
+        self.host_mode = False              # permanent after decline
+        self.violation: Optional[Dict[str, Any]] = None
+        self.booleans: Dict[str, bool] = {
+            "cyc_ww": False, "cyc_wwwr": False,
+            "cyc_full": False, "gsingle": False}
+
+    def _classify(self) -> Optional[Dict[str, Any]]:
+        from jepsen_tpu.txn import host_ref
+        anomalies = host_ref.derive_anomalies(self.booleans)
+        if anomalies:
+            return {"valid": False, "engine": "session-txn",
+                    "anomalies": anomalies, "anomaly": anomalies[0],
+                    "booleans": dict(self.booleans)}
+        return None
+
+    def advance_block(self, ops: Sequence[Op]) -> Optional[Dict]:
+        """Feed one append block; returns the violation (sticky) or
+        None. Raises on device failure — the session owns the
+        exactly-one-fallback contract."""
+        from jepsen_tpu.txn import cycles, host_ref
+        if self.violation is not None:
+            return self.violation
+        self.infer.feed_block(ops)
+        if self.infer.direct:
+            kinds = sorted({d["type"] for d in self.infer.direct})
+            self.violation = {
+                "valid": False, "engine": "session-txn-infer",
+                "anomalies": kinds, "anomaly": kinds[0],
+                "direct": [dict(d) for d in self.infer.direct[:32]]}
+            return self.violation
+        src, dst, et = self.infer.drain_new_edges()
+        if self.host_mode:
+            self.booleans = host_ref.classify_booleans(
+                self.infer.graph())
+        else:
+            try:
+                self.fire_hook()
+                self.booleans = self.closure.add_block(
+                    max(self.infer.n, 1), src, dst, et)
+            except cycles.ClosureOverflow as e:
+                # capacity decline, not a device death: recorded
+                # route, host booleans from here on
+                obs.decision("session-advance", "route",
+                             cause=f"txn-overflow:{e}")
+                self.host_mode = True
+                self.booleans = host_ref.classify_booleans(
+                    self.infer.graph())
+        self.violation = self._classify()
+        return self.violation
+
+    def to_host(self) -> None:
+        """Device closure died: continue host-side permanently (the
+        session already recorded the one fallback)."""
+        self.host_mode = True
+
+    def close_incremental(self) -> Dict[str, Any]:
+        """Resolve stragglers and return the final incremental
+        verdict (the authoritative exact check is the session's).
+        The post-resolution classification reuses the ordinary
+        :meth:`advance_block` ladder with an empty feed, so the
+        close path cannot drift from the append path."""
+        if self.violation is None:
+            self.infer.resolve_stragglers()
+            self.advance_block([])
+        if self.violation is not None:
+            return dict(self.violation)
+        return {"valid": True, "engine": "session-txn",
+                "txns": self.infer.n,
+                "booleans": dict(self.booleans)}
+
+    def in_flight(self) -> int:
+        return len(self.infer._live) + self.infer.pending_reads()
+
+
+# -- the session ----------------------------------------------------------
+
+class Session:
+    """One long-lived check: carried engine state, the accumulated
+    op stream (close + fallback replay), and the sticky first
+    violation. Appends are serialized under the session lock (the
+    dispatcher already serializes same-session dispatch groups; the
+    lock additionally covers journal replay and HTTP status reads)."""
+
+    def __init__(self, sid: str, tenant: str, model_name: str,
+                 model: Model, opts: Optional[Dict[str, Any]] = None
+                 ) -> None:
+        from jepsen_tpu.txn.ops import ListAppend
+        self.id = sid
+        self.tenant = tenant
+        self.model_name = model_name
+        self.model = model
+        self.opts = dict(opts or {})
+        self.created_wall = time.time()
+        self.created_mono = time.monotonic()
+        self.lock = threading.RLock()
+        self.seq = 0                        # admitted append blocks
+        self.ops: List[Op] = []
+        self.ops_total = 0                  # survives the close drop
+        self.closed = False
+        self.closing = False
+        self.result: Optional[Dict[str, Any]] = None
+        self.violation: Optional[Dict[str, Any]] = None
+        self.fallbacks = 0
+        self.appends = 0
+        self.replayed = 0
+        self.is_txn = isinstance(model, ListAppend)
+        self._host: Optional[online.OnlineLinearizable] = None
+        self._eng: Any = None
+        self.engine_name = "session-host"
+        self._route()
+
+    # -- route selection -------------------------------------------------
+    def _eng_kw(self) -> Dict[str, Any]:
+        return {k: v for k, v in self.opts.items() if k in _ENG_KW}
+
+    def _route(self) -> None:
+        import os
+        if self.is_txn:
+            self._eng = TxnSessionEngine(
+                max_dense_txns=self.opts.get("max_dense_txns"))
+            self._eng.fire_hook = (
+                lambda: faults.fire("session-advance",
+                                    tenants=[self.tenant]))
+            self.engine_name = "session-txn-mxu"
+            return
+        from jepsen_tpu.checkers import preproc_native
+        if os.environ.get("JEPSEN_TPU_NO_SESSION_DEVICE"):
+            obs.decision("session-advance", "route", cause="opt-out",
+                         session=self.id)
+            self._to_host_monitor(record_fallback=False)
+            return
+        if not preproc_native.available():
+            # the device engine's settle bookkeeping is the C++
+            # monitor core; without it the host monitor (which has
+            # its own pure-Python tier) is the route, not a crash
+            obs.decision("session-advance", "route",
+                         cause="no-native-monitor", session=self.id)
+            self._to_host_monitor(record_fallback=False)
+            return
+        self._eng = DeviceFrontierEngine(self.model, **self._eng_kw())
+        self.engine_name = "session-frontier-device"
+
+    def _to_host_monitor(self, record_fallback: bool,
+                         exc: Optional[BaseException] = None) -> None:
+        """Switch PERMANENTLY to the host online monitor, replaying
+        the accumulated stream (its own incremental engine re-derives
+        the state; overflow degrades to prefix re-checking inside the
+        monitor — the same ladder live runs always had)."""
+        if record_fallback:
+            self.fallbacks += 1
+            obs.engine_fallback("session-advance",
+                                type(exc).__name__ if exc else "error",
+                                session=self.id, ops=len(self.ops))
+            obs.count("serve.session.fallback")
+            log.warning("session %s device path died (%r); host "
+                        "monitor fallback", self.id, exc)
+        if self.is_txn:
+            self._eng.to_host()
+            self.engine_name = "session-txn-host"
+            return
+        kw = self._eng_kw()
+        mon = online.OnlineLinearizable(self.model, **kw)
+        for op in self.ops:
+            mon.observe(op)
+        mon.flush()
+        self._host = mon
+        self._eng = None
+        self.engine_name = "session-host-monitor"
+        if mon.violation is not None and self.violation is None:
+            self.violation = dict(mon.violation)
+
+    # -- appends ---------------------------------------------------------
+    def advance_block(self, ops: Sequence[Op],
+                      seq: Optional[int] = None) -> Dict[str, Any]:
+        """Feed one event block and return the incremental verdict +
+        tail-alarm status. Fail-fast is permanent: once a violation
+        is proven, every later append returns it unchanged (the
+        sticky verdict — linearizability/serializability are
+        prefix-closed, nothing can repair them)."""
+        with self.lock:
+            if self.closed:
+                raise SessionClosed(f"session {self.id} is closed")
+            self.appends += 1
+            self.ops.extend(ops)
+            self.ops_total = len(self.ops)
+            obs.count("serve.session.appends")
+            obs.count("serve.session.append_ops", len(ops))
+            tail_hit = False
+            if self.violation is None:
+                try:
+                    # the self-nemesis hook (register path): chaos/
+                    # tests force the device path to die here — the
+                    # host monitor replays the FULL accumulated
+                    # stream, so firing before the feed loses
+                    # nothing. The txn hook fires inside the engine,
+                    # after inference consumed the block.
+                    if not self.is_txn:
+                        faults.fire("session-advance",
+                                    tenants=[self.tenant])
+                    v = self._advance_engine(ops)
+                except online._Overflow as e:
+                    # capacity, not death: recorded route decision
+                    obs.decision("session-advance", "route",
+                                 cause=f"overflow:{type(e).__name__}",
+                                 session=self.id)
+                    self._to_host_monitor(record_fallback=False)
+                    v = self.violation
+                except Exception as e:                  # noqa: BLE001
+                    # the device path died: exactly ONE obs fallback,
+                    # then the host monitor re-derives the state from
+                    # the journal-backed accumulated stream
+                    if self.is_txn:
+                        obs.engine_fallback(
+                            "session-advance", type(e).__name__,
+                            session=self.id, ops=len(self.ops))
+                        obs.count("serve.session.fallback")
+                        self.fallbacks += 1
+                        self._eng.to_host()
+                        self.engine_name = "session-txn-host"
+                        v = self._eng.advance_block([])
+                    else:
+                        self._to_host_monitor(record_fallback=True,
+                                              exc=e)
+                        v = self.violation
+                if v is not None and self.violation is None:
+                    self.violation = dict(v)
+                tail_hit = bool((v or {}).get("tail-alarm"))
+            return self._append_verdict(len(ops), tail_hit, seq)
+
+    def _advance_engine(self, ops: Sequence[Op]
+                        ) -> Optional[Dict[str, Any]]:
+        if self._host is not None:
+            for op in ops:
+                self._host.observe(op)
+            self._host.flush()
+            return self._host.violation
+        if self.is_txn:
+            return self._eng.advance_block(ops)
+        self._eng.feed_many(list(ops))
+        v = self._eng.advance()
+        if v is None:
+            v = self._eng.tail_alarm()
+        return v
+
+    def _append_verdict(self, block_ops: int, tail_hit: bool,
+                        seq: Optional[int] = None) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "session": self.id,
+            "seq": self.seq if seq is None else seq,
+            "block-ops": block_ops, "ops": len(self.ops),
+            "valid-so-far": self.violation is None,
+            "tail-alarm": tail_hit,
+            "engine": self.engine_name,
+        }
+        if self._host is not None:
+            out["in-flight"] = (self._host._engine.in_flight()
+                                if self._host._engine is not None
+                                else None)
+        elif self.is_txn:
+            out["txns"] = self._eng.infer.n
+            out["in-flight"] = self._eng.in_flight()
+        else:
+            out["settled-returns"] = self._eng.settled_returns
+            out["in-flight"] = self._eng.in_flight()
+        if self.violation is not None:
+            out["violation"] = dict(self.violation)
+        return out
+
+    # -- close -----------------------------------------------------------
+    def close(self) -> Dict[str, Any]:
+        """Resolve the unsettled tail (the incremental verdict becomes
+        the exact full-history one) and return the authoritative final
+        verdict + witness: ``facade.auto_check_packed`` /
+        ``auto_check_txn`` on the concatenated history — the
+        differential identity the protocol promises — cross-asserted
+        against the incremental verdict (a divergence is a recorded
+        bug, never silent)."""
+        with self.lock:
+            if self.closed:
+                return dict(self.result or {})
+            inc = self._close_incremental()
+            final = self._exact_final()
+            inc_valid = inc.get("valid")
+            if inc_valid in (True, False) \
+                    and final.get("valid") in (True, False) \
+                    and inc_valid is not final["valid"]:
+                obs.count("serve.session.divergence")
+                log.error("session %s incremental/exact divergence: "
+                          "%r vs %r", self.id, inc_valid,
+                          final.get("valid"))
+                final["incremental-divergence"] = True
+            final["session"] = self.id
+            final["appends"] = self.appends
+            final["session-ops"] = len(self.ops)
+            final["session-engine"] = self.engine_name
+            final["incremental"] = {
+                k: inc.get(k) for k in
+                ("valid", "engine", "settled-returns", "ops-checked",
+                 "txns", "anomalies")
+                if inc.get(k) is not None}
+            self.closed = True
+            self.result = final
+            # the retention contract is the verdict, not the stream:
+            # drop the accumulated ops and the carried engine state
+            # (device frontier / closure masks / host monitor) so the
+            # keep_closed retained sessions cost bytes, not histories
+            # and dead device buffers
+            self.ops_total = len(self.ops)
+            self.ops = []
+            self._eng = None
+            self._host = None
+            obs.count("serve.session.closed")
+            return dict(final)
+
+    def _close_incremental(self) -> Dict[str, Any]:
+        try:
+            if self._host is not None:
+                return self._host.stop()
+            if self.is_txn:
+                return self._eng.close_incremental()
+            v = self._eng.advance(run_over=True)
+            if v is not None:
+                return dict(v)
+            return {"valid": True, "engine": self.engine_name,
+                    "settled-returns": self._eng.settled_returns}
+        except online._Overflow as e:
+            # capacity at close is the same ROUTE decision it is at
+            # append time — never a recorded device death (the
+            # exactly-one-fallback accounting chaos asserts on)
+            obs.decision("session-advance", "route",
+                         cause=f"overflow:{type(e).__name__}",
+                         session=self.id, close=True)
+            self._to_host_monitor(record_fallback=False)
+            return self._host.stop()
+        except Exception as e:                          # noqa: BLE001
+            # a death during tail resolution follows the same
+            # one-fallback ladder; the host monitor's stop() is exact
+            if self.violation is not None:
+                return dict(self.violation)
+            if self.is_txn:
+                obs.engine_fallback("session-advance",
+                                    type(e).__name__, session=self.id,
+                                    close=True)
+                obs.count("serve.session.fallback")
+                self.fallbacks += 1
+                self._eng.to_host()
+                return self._eng.close_incremental()
+            self._to_host_monitor(record_fallback=True, exc=e)
+            return self._host.stop()
+
+    def _exact_final(self) -> Dict[str, Any]:
+        from jepsen_tpu.checkers import facade
+        if not self.ops:
+            return {"valid": True, "engine": "session-empty", "ops": 0}
+        # ALWAYS reindex in arrival order: blocks may carry
+        # client-supplied per-block indices (each starting at 0), and
+        # packing would re-sort duplicates across block boundaries —
+        # scrambling the stream the incremental engines walked in
+        # arrival order. Reindexing makes arrival order authoritative
+        # for the exact check too.
+        ops = h.index(list(self.ops))
+        try:
+            if self.is_txn:
+                return facade.auto_check_txn(ops, dict(self.opts))
+            return facade.auto_check_packed(self.model, h.pack(ops),
+                                            dict(self.opts))
+        except Exception as e:                          # noqa: BLE001
+            obs.checker_swallowed("session-close", type(e).__name__,
+                                  ops=len(ops))
+            return {"valid": "unknown",
+                    "error": f"{type(e).__name__}: {e}"}
+
+    # -- views -----------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        with self.lock:
+            out = {
+                "session": self.id, "tenant": self.tenant,
+                "model": self.model_name,
+                "status": "closed" if self.closed else "open",
+                "seq": self.seq, "appends": self.appends,
+                "ops": self.ops_total,
+                "age-s": round(time.monotonic() - self.created_mono,
+                               3),
+                "engine": self.engine_name,
+                "valid-so-far": self.violation is None,
+                "replayed-appends": self.replayed,
+            }
+            if self.violation is not None:
+                out["violation"] = dict(self.violation)
+            if self.result is not None:
+                out["result"] = dict(self.result)
+            return out
+
+
+# -- the registry ---------------------------------------------------------
+
+class SessionRegistry:
+    """id -> session lookup + the open-session census ``/stats`` and
+    the ``/engine`` dashboard render. Closed sessions are retained
+    FIFO-bounded (their close result stays queryable without letting
+    a long-lived daemon leak one session at a time); the open-session
+    count is bounded by refusing opens past ``max_open``."""
+
+    def __init__(self, max_open: int = 1024,
+                 keep_closed: int = 256) -> None:
+        self._lock = threading.Lock()
+        self._by_id: "OrderedDict[str, Session]" = OrderedDict()
+        self._closed_order: "deque[str]" = deque()
+        self._max_open = max_open
+        self._keep_closed = keep_closed
+
+    def add(self, sess: Session) -> None:
+        with self._lock:
+            n_open = sum(1 for s in self._by_id.values()
+                         if not s.closed)
+            if n_open >= self._max_open:
+                raise RuntimeError(
+                    f"open-session bound reached ({self._max_open})")
+            self._by_id[sess.id] = sess
+        obs.count("serve.session.opened")
+        self._gauge()
+
+    def get(self, sid: str) -> Optional[Session]:
+        with self._lock:
+            return self._by_id.get(sid)
+
+    def mark_closed(self, sess: Session) -> None:
+        with self._lock:
+            self._closed_order.append(sess.id)
+            while len(self._closed_order) > self._keep_closed:
+                old = self._closed_order.popleft()
+                s = self._by_id.get(old)
+                if s is not None and s.closed:
+                    self._by_id.pop(old, None)
+        self._gauge()
+
+    def _gauge(self) -> None:
+        obs.gauge("serve.session.open", self.open_count())
+
+    def open_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._by_id.values()
+                       if not s.closed)
+
+    def census(self) -> Dict[str, Any]:
+        """The /stats + /engine view: open count, oldest open age,
+        per-tenant open counts, total appends/ops across live
+        sessions."""
+        now = time.monotonic()
+        with self._lock:
+            open_s = [s for s in self._by_id.values() if not s.closed]
+            per_tenant: Dict[str, int] = {}
+            for s in open_s:
+                per_tenant[s.tenant] = per_tenant.get(s.tenant, 0) + 1
+            return {
+                "open": len(open_s),
+                "closed": len(self._closed_order),
+                "oldest-age-s": (round(max(
+                    now - s.created_mono for s in open_s), 3)
+                    if open_s else None),
+                "per-tenant": per_tenant,
+                "appends": sum(s.appends for s in open_s),
+                "ops": sum(s.ops_total for s in open_s),
+            }

@@ -160,6 +160,182 @@ def closure_booleans(graph: DepGraph,
             "cyc_full": bool(out[2]), "gsingle": bool(out[3])}
 
 
+# -- incremental closure (streaming check sessions) ----------------------
+#
+# A txn session maintains the closed reachability masks C [3, Np, Np]
+# DEVICE-RESIDENT across appends and re-closes only the dirty
+# row/column blocks per append batch: every path a new edge enables
+# decomposes as  old-reach → (junction path within the dirty node set
+# D) → old-reach,  because each new edge's endpoints are in D and C
+# was already transitively closed. So one append costs
+#
+#   1. scatter the new edges into C / A_rw (in place, donated);
+#   2. close H = C1[D, D] — a [|D|, |D|] squaring ladder, log2(|D|)
+#      iterations over the DIRTY block only;
+#   3. C' = C1 ∨ (C1∨I)[:, D] · H* · (C1∨I)[D, :] — two skinny
+#      [Np, d] matmuls instead of the full [Np, Np] ladder;
+#   4. the same 4-boolean verdict fetch as the one-shot closure.
+#
+# Geometry: Np pads to powers of two and regrows by re-embedding the
+# fetched masks (log2-many regrowths per session); |D| and the edge
+# count pad to powers of two so a session compiles a bounded family
+# of update programs.
+
+
+class ClosureOverflow(RuntimeError):
+    """The session's graph outgrew the dense closure envelope; the
+    caller routes per-append verdicts to the host SCC reference."""
+
+
+@lru_cache(maxsize=32)
+def _inc_call(Np: int, d_pad: int, e_pad: int):
+    """One compiled dirty-block update per (geometry, dirty width,
+    edge width): scatter → dirty-block ladder → skinny closure join →
+    verdict. The carried masks are donated (in-place advance)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_iter = max(1, math.ceil(math.log2(max(d_pad, 2))))
+
+    def fn(C, Arw, esrc, edst, elane, erw, dsel):
+        s = jnp.where(esrc < 0, 0, esrc)
+        d = jnp.where(edst < 0, 0, edst)
+        # scatter the batch's edges into the three lane masks + rw
+        # (pad entries carry zero weight: .max(0) is the identity)
+        for lane in range(3):
+            C = C.at[lane, s, d].max(elane[lane])
+        Arw = Arw.at[s, d].max(erw)
+        dd = jnp.where(dsel < 0, 0, dsel)
+        valid = (dsel >= 0).astype(jnp.float32)
+        # dirty-block closure: junction paths between new-edge
+        # endpoints, with old C entries as the long-range hops
+        H = C[:, dd][:, :, dd] * valid[None, :, None] \
+            * valid[None, None, :]
+        for _ in range(n_iter):
+            prod = jnp.einsum("bij,bjk->bik", H, H,
+                              preferred_element_type=jnp.float32)
+            H = jnp.where(prod > 0, 1.0, H)
+        eyeD = (jnp.arange(Np)[:, None] == dd[None, :]) \
+            .astype(jnp.float32) * valid[None, :]
+        left = jnp.maximum(C[:, :, dd] * valid[None, None, :],
+                           eyeD[None])
+        right = jnp.maximum(C[:, dd, :] * valid[None, :, None],
+                            eyeD.T[None])
+        thru = jnp.einsum("bik,bkl->bil", left, H,
+                          preferred_element_type=jnp.float32)
+        add = jnp.einsum("bil,blj->bij", thru, right,
+                         preferred_element_type=jnp.float32)
+        C = jnp.where(add > 0, 1.0, C)
+        cyc = jnp.einsum("bii->b", C) > 0
+        refl = jnp.maximum(C[1], jnp.eye(Np, dtype=jnp.float32))
+        gs = jnp.einsum("ij,ji->", Arw, refl) > 0
+        return C, Arw, jnp.concatenate([cyc, gs[None]])
+
+    return jax.jit(fn, donate_argnums=(0, 1))
+
+
+def _pow2_at_least(n: int, floor: int = 8) -> int:
+    return max(floor, 1 << max(0, (n - 1)).bit_length())
+
+
+class IncrementalClosure:
+    """Device-resident incremental transitive closure for one txn
+    session. ``add_block(n_txns, src, dst, et)`` folds an append
+    batch's new edges in and returns the four cycle booleans (the
+    same :func:`closure_booleans` keys). Raises
+    :class:`ClosureOverflow` when the graph outgrows the dense
+    envelope and any device failure to the caller, which owns the
+    exactly-one-obs-fallback contract."""
+
+    def __init__(self, *, max_dense_txns: Optional[int] = None) -> None:
+        self._cap = (max_dense_txns if max_dense_txns is not None
+                     else max_dense())
+        self.Np = 0
+        self._C = None                      # f32 [3, Np, Np] on device
+        self._Arw = None                    # f32 [Np, Np] on device
+        self.updates = 0
+
+    def _seed(self, Np: int) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.Np = Np
+        self._C = jax.device_put(jnp.zeros((3, Np, Np), jnp.float32))
+        self._Arw = jax.device_put(jnp.zeros((Np, Np), jnp.float32))
+
+    def _regrow(self, n: int) -> None:
+        """Re-embed the carried masks into the next power-of-two
+        geometry (closure is preserved: new nodes have no edges)."""
+        Np2 = _pad_n(n)
+        if n > self._cap:
+            raise ClosureOverflow(
+                f"session graph {n} txns > dense cap {self._cap}")
+        if self.P_empty:
+            self._seed(Np2)
+            return
+        import jax
+        C = np.asarray(self._C)
+        Arw = np.asarray(self._Arw)
+        C2 = np.zeros((3, Np2, Np2), np.float32)
+        Arw2 = np.zeros((Np2, Np2), np.float32)
+        C2[:, :self.Np, :self.Np] = C
+        Arw2[:self.Np, :self.Np] = Arw
+        from jepsen_tpu.checkers import transfer
+        transfer.count_put(int(C2.nbytes + Arw2.nbytes),
+                           int(C2.nbytes + Arw2.nbytes))
+        self.Np = Np2
+        self._C = jax.device_put(C2)
+        self._Arw = jax.device_put(Arw2)
+        obs.count("txn.closure.regrow")
+
+    @property
+    def P_empty(self) -> bool:
+        return self._C is None
+
+    def add_block(self, n_txns: int, src: np.ndarray, dst: np.ndarray,
+                  et: np.ndarray) -> Dict[str, bool]:
+        """Fold one append batch's new edges into the carried closure
+        and return the cycle booleans."""
+        import jax.numpy as jnp
+
+        if n_txns > self._cap:
+            raise ClosureOverflow(
+                f"session graph {n_txns} txns > dense cap {self._cap}")
+        if self.P_empty or n_txns > self.Np:
+            self._regrow(max(n_txns, 1))
+        e = len(src)
+        e_pad = _pow2_at_least(max(e, 1))
+        d_ids = np.unique(np.concatenate([src, dst])) if e else \
+            np.zeros(0, np.int64)
+        d_pad = min(self.Np, _pow2_at_least(max(len(d_ids), 1)))
+        if len(d_ids) > d_pad:              # cannot happen: |D| <= Np
+            d_pad = _pow2_at_least(len(d_ids))
+        esrc = np.full(e_pad, -1, np.int32)
+        edst = np.full(e_pad, -1, np.int32)
+        esrc[:e] = src
+        edst[:e] = dst
+        elane = np.zeros((3, e_pad), np.float32)
+        erw = np.zeros(e_pad, np.float32)
+        from jepsen_tpu.txn.infer import RW, WR, WW
+        elane[0, :e] = (et == WW)
+        elane[1, :e] = (et == WW) | (et == WR)
+        elane[2, :e] = 1.0
+        erw[:e] = (et == RW)
+        dsel = np.full(d_pad, -1, np.int32)
+        dsel[:len(d_ids)] = d_ids
+        from jepsen_tpu.checkers import transfer
+        wire = int(esrc.nbytes + edst.nbytes + elane.nbytes
+                   + erw.nbytes + dsel.nbytes)
+        transfer.count_put(wire, wire)
+        self._C, self._Arw, out = _inc_call(self.Np, d_pad, e_pad)(
+            self._C, self._Arw, jnp.asarray(esrc), jnp.asarray(edst),
+            jnp.asarray(elane), jnp.asarray(erw), jnp.asarray(dsel))
+        self.updates += 1
+        obs.count("txn.closure.incremental")
+        o = np.asarray(out)
+        return {"cyc_ww": bool(o[0]), "cyc_wwwr": bool(o[1]),
+                "cyc_full": bool(o[2]), "gsingle": bool(o[3])}
+
+
 # -- mesh tiling ---------------------------------------------------------
 
 @lru_cache(maxsize=16)

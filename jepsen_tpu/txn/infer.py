@@ -214,3 +214,319 @@ def infer(txns: Sequence[txn_ops.Txn],
         obs.count(f"txn.edges.{EDGE_NAMES[t]}", int((et == t).sum()))
     return DepGraph(n=n, src=src, dst=dst, et=et, txns=tuple(txns),
                     direct=tuple(direct), counters=counters)
+
+
+# -- incremental inference (streaming check sessions) ---------------------
+#
+# The streaming-session analogue of :func:`infer`: ops arrive in append
+# blocks, invocations may complete blocks later, and the dependency
+# adjacency must GROW monotonically so the device closure
+# (:class:`jepsen_tpu.txn.cycles.IncrementalClosure`) can re-close only
+# the dirty row/column blocks per append. The settled-prefix discipline
+# of checkers/online.py carries over: a read is *settled* — and only
+# then allowed to extend the recovered order or emit edges — once every
+# value it observed has a KNOWN appender (or is proven aborted, a G1a).
+# Until then it waits: trusting it earlier could brand an in-flight
+# append's value a phantom (a false alarm the post-hoc path can never
+# produce, because post-hoc everything has completed). Under this rule
+# the emitted edge set only ever grows in well-formed histories —
+# recovered orders are append-only and prefix-validated, so a ww/wr/rw
+# edge once emitted is never retracted — which is exactly what makes a
+# sound early cycle alarm possible. At close,
+# :meth:`IncrementalInfer.resolve_stragglers` resolves still-pending
+# invocations as crashed and finalizes pending reads (a value still
+# unattributed then IS a phantom), after which the edge set equals the
+# post-hoc :func:`infer` edge set (differentially tested).
+
+
+class _KeyState:
+    """Per-key incremental traceability state."""
+
+    __slots__ = ("order", "writers", "appenders", "crashed_vals",
+                 "failed_vals", "readers_by_len", "pending", "poisoned")
+
+    def __init__(self) -> None:
+        self.order: List[Any] = []          # recovered append order
+        self.writers: List[int] = []        # appender tid per position
+        self.appenders: Dict[Any, int] = {}
+        self.crashed_vals: Set[Any] = set()
+        self.failed_vals: Dict[Any, int] = {}
+        self.readers_by_len: Dict[int, List[int]] = {}
+        self.pending: List[Tuple[int, Tuple[Any, ...]]] = []
+        self.poisoned = False               # direct anomaly on this key
+
+
+class IncrementalInfer:
+    """Stateful list-append dependency inference for one session.
+
+    Feed append blocks with :meth:`feed_block`; new COO edges since
+    the last drain come from :meth:`drain_new_edges` (the device
+    closure's per-append delta); :meth:`graph` materializes the full
+    accumulated :class:`DepGraph` (host fallback + witness walk).
+    Direct anomalies land in :attr:`direct` as they are proven."""
+
+    def __init__(self) -> None:
+        from jepsen_tpu.txn import ops as txn_ops
+        self._ops_mod = txn_ops
+        self.txns: List[Any] = []
+        self.fails: List[Any] = []
+        self._live: Dict[Any, Any] = {}     # proc -> invoke op
+        self._keys: Dict[Any, _KeyState] = {}
+        self.direct: List[Dict[str, Any]] = []
+        self.counters: Dict[str, int] = {}
+        self._edges: Set[Tuple[int, int, int]] = set()
+        self._fresh: List[Tuple[int, int, int]] = []
+
+    # -- ingestion -------------------------------------------------------
+    def feed_block(self, ops: Sequence[Any]) -> None:
+        """Pair txn invocations/completions across block boundaries
+        and run settled inference over the completions."""
+        txn_ops = self._ops_mod
+        for op in ops:
+            if op.process == "nemesis" or op.f != "txn":
+                continue
+            if op.type == "invoke":
+                self._live[op.process] = op
+                continue
+            inv = self._live.pop(op.process, None)
+            if inv is None:
+                continue                    # completion without invoke
+            if op.type == "fail":
+                self.fails.append(txn_ops.FailedTxn(
+                    op=inv, micros=tuple(txn_ops.micro_ops(inv.value))))
+                self._register_fail(self.fails[-1])
+            elif op.type == "ok":
+                value = op.value if op.value is not None else inv.value
+                self._add_txn(inv.with_(value=value),
+                              tuple(txn_ops.micro_ops(value)),
+                              crashed=False)
+            elif op.type == "info":
+                micros = tuple(
+                    (k, key, None) if k == txn_ops.READ else (k, key, v)
+                    for k, key, v in txn_ops.micro_ops(inv.value))
+                self._add_txn(inv, micros, crashed=True)
+
+    def resolve_stragglers(self) -> None:
+        """The stream is over: still-pending invocations resolve as
+        crashed (reads blanked, exactly like post-hoc ``collect``),
+        then still-pending reads finalize — a value with no appender
+        now is a genuine phantom / aborted read."""
+        txn_ops = self._ops_mod
+        for _p, inv in sorted(self._live.items(),
+                              key=lambda kv: kv[1].index):
+            micros = tuple(
+                (k, key, None) if k == txn_ops.READ else (k, key, v)
+                for k, key, v in txn_ops.micro_ops(inv.value))
+            self._add_txn(inv, micros, crashed=True)
+        self._live.clear()
+        for hk, ks in self._keys.items():
+            still = ks.pending
+            ks.pending = []
+            for tid_r, vs in still:
+                self._finalize_read(hk, ks, tid_r, vs, final=True)
+
+    # -- internals -------------------------------------------------------
+    def _bump(self, name: str, n: int = 1) -> None:
+        _bump(self.counters, name, n)
+
+    def _key(self, k: Any) -> _KeyState:
+        hk = hashable(k)
+        ks = self._keys.get(hk)
+        if ks is None:
+            ks = self._keys[hk] = _KeyState()
+        return ks
+
+    def _register_fail(self, f: Any) -> None:
+        from jepsen_tpu.txn.ops import APPEND
+        for kind, k, v in f.micros:
+            if kind == APPEND:
+                ks = self._key(k)
+                ks.failed_vals.setdefault(hashable(v), f.op.index)
+
+    def _add_txn(self, op: Any, micros: Tuple, crashed: bool) -> None:
+        from jepsen_tpu.txn.ops import APPEND, READ, Txn
+        tid = len(self.txns)
+        self.txns.append(Txn(tid=tid, op=op, micros=micros,
+                             crashed=crashed))
+        touched: List[Any] = []
+        for kind, k, v in micros:
+            hk = hashable(k)
+            ks = self._key(k)
+            if kind == APPEND:
+                hv = hashable(v)
+                if hv in ks.appenders:
+                    self.direct.append(
+                        {"type": "duplicate-append", "key": k,
+                         "value": v, "txns": [ks.appenders[hv], tid]})
+                    self._bump("duplicate_append")
+                    ks.poisoned = True
+                    continue
+                ks.appenders[hv] = tid
+                if crashed:
+                    ks.crashed_vals.add(hv)
+                touched.append(hk)
+            elif kind == READ and v is not None:
+                ks.pending.append(
+                    (tid, tuple(hashable(x) for x in v)))
+                touched.append(hk)
+        # settlement: new appends may unblock reads queued on this key
+        for hk in dict.fromkeys(touched):
+            self._settle_key(hk, self._keys[hk])
+
+    def _settle_key(self, hk: Any, ks: _KeyState) -> None:
+        progressed = True
+        while progressed:
+            progressed = False
+            still: List[Tuple[int, Tuple[Any, ...]]] = []
+            for tid_r, vs in ks.pending:
+                if all(v in ks.appenders for v in vs):
+                    self._process_read(hk, ks, tid_r, vs)
+                    progressed = True
+                elif any(v in ks.failed_vals
+                         and v not in ks.appenders for v in vs):
+                    # a value only a FAILED txn ever appended: G1a
+                    self._finalize_read(hk, ks, tid_r, vs)
+                    progressed = True
+                else:
+                    still.append((tid_r, vs))
+            ks.pending = still
+
+    def _finalize_read(self, hk: Any, ks: _KeyState, tid_r: int,
+                       vs: Tuple[Any, ...],
+                       final: bool = False) -> None:
+        """A read that can never settle cleanly: attribute each
+        unknown value — G1a when a failed txn appended it, phantom
+        when the stream is OVER and nobody did. Mid-stream
+        (``final=False``, the G1a fast path) only the proven-aborted
+        values are attributed: an unknown value may simply be an
+        in-flight append, and branding it a phantom would diverge
+        from the post-hoc reference. The key poisons either way
+        (a proven G1a already fails the history)."""
+        if all(v in ks.appenders for v in vs):
+            self._process_read(hk, ks, tid_r, vs)
+            return
+        for v in vs:
+            if v in ks.appenders:
+                continue
+            if v in ks.failed_vals:
+                self.direct.append({"type": "G1a", "key": hk,
+                                    "value": v,
+                                    "failed-op-index":
+                                        ks.failed_vals[v]})
+                self._bump("aborted_read")
+            elif final:
+                self.direct.append(
+                    {"type": "incompatible-order", "key": hk,
+                     "value": v,
+                     "cause": "read observed a value never appended"})
+                self._bump("phantom_value")
+        ks.poisoned = True
+
+    def _edge(self, u: int, v: int, et: int) -> None:
+        if u == v:
+            return
+        e = (u, v, et)
+        if e not in self._edges:
+            self._edges.add(e)
+            self._fresh.append(e)
+            obs.count(f"txn.edges.{EDGE_NAMES[et]}")
+
+    def _process_read(self, hk: Any, ks: _KeyState, tid_r: int,
+                      vs: Tuple[Any, ...]) -> None:
+        """A settled read: validate prefix-compatibility, extend the
+        recovered order, and emit the wr/ww/rw edges it proves."""
+        if ks.poisoned:
+            return
+        L = len(vs)
+        cur = ks.order
+        if len(set(vs)) != L:
+            self.direct.append(
+                {"type": "incompatible-order", "key": hk,
+                 "cause": "duplicate value in one read",
+                 "version": list(vs)})
+            self._bump("incompatible_order")
+            ks.poisoned = True
+            return
+        if L > len(cur):
+            if tuple(cur) != vs[:len(cur)]:
+                self.direct.append(
+                    {"type": "incompatible-order", "key": hk,
+                     "txn": tid_r,
+                     "cause": "read is not a prefix of the recovered "
+                              "order",
+                     "version": list(vs), "order": list(cur)})
+                self._bump("incompatible_order")
+                ks.poisoned = True
+                return
+            # extend: every value has a known appender (settled), so
+            # the new positions' ww edges and the rw edges of readers
+            # parked at the old frontier emit now
+            for i in range(len(cur), L):
+                hv = vs[i]
+                w = ks.appenders[hv]
+                if hv in ks.crashed_vals:
+                    self._bump("crashed_recovered")
+                ks.order.append(hv)
+                ks.writers.append(w)
+                if i > 0:
+                    self._edge(ks.writers[i - 1], w, WW)
+                for parked in ks.readers_by_len.pop(i, ()):
+                    self._edge(parked, w, RW)
+        elif tuple(vs) != tuple(cur[:L]):
+            self.direct.append(
+                {"type": "incompatible-order", "key": hk,
+                 "txn": tid_r,
+                 "cause": "read is not a prefix of the recovered "
+                          "order",
+                 "version": list(vs), "order": list(cur)})
+            self._bump("incompatible_order")
+            ks.poisoned = True
+            return
+        if L:
+            self._edge(ks.writers[L - 1], tid_r, WR)
+        if L < len(ks.order):
+            self._edge(tid_r, ks.writers[L], RW)
+        else:
+            ks.readers_by_len.setdefault(L, []).append(tid_r)
+
+    # -- views -----------------------------------------------------------
+    @property
+    def n(self) -> int:
+        return len(self.txns)
+
+    def pending_reads(self) -> int:
+        return sum(len(ks.pending) for ks in self._keys.values())
+
+    def drain_new_edges(self) -> Tuple[np.ndarray, np.ndarray,
+                                       np.ndarray]:
+        """Edges emitted since the last drain, as (src, dst, et)
+        int32 arrays — the device closure's dirty-block delta."""
+        fresh, self._fresh = self._fresh, []
+        if not fresh:
+            z = np.zeros(0, np.int32)
+            return z, z.copy(), z.copy()
+        arr = np.asarray(fresh, np.int64)
+        return (arr[:, 0].astype(np.int32),
+                arr[:, 1].astype(np.int32),
+                arr[:, 2].astype(np.int32))
+
+    def graph(self) -> DepGraph:
+        """The accumulated dependency graph (host fallback rungs and
+        the witness walk read this)."""
+        from jepsen_tpu.checkers import transfer
+
+        n = len(self.txns)
+        dt = transfer.idx_dtype(max(n, 1), count=False)
+        if self._edges:
+            es = sorted(self._edges)
+            src = np.asarray([e[0] for e in es], dt)
+            dst = np.asarray([e[1] for e in es], dt)
+            et = np.asarray([e[2] for e in es], np.int8)
+        else:
+            src = np.zeros(0, dt)
+            dst = np.zeros(0, dt)
+            et = np.zeros(0, np.int8)
+        return DepGraph(n=n, src=src, dst=dst, et=et,
+                        txns=tuple(self.txns),
+                        direct=tuple(self.direct),
+                        counters=dict(self.counters))

@@ -258,6 +258,28 @@ def _engine_html(root: str) -> str:
         banner += (f"<p>journal: {jstats.get('pending', 0)} pending, "
                    f"{jstats.get('terminal', 0)} terminal entries"
                    f"</p>")
+    # open streaming sessions: count + oldest age + per-tenant spread
+    # (green when live sessions are being served, the grey path when
+    # none — same badge element/colors as the verdicts)
+    sess = st.get("sessions") or {}
+    if sess:
+        n_open = int(sess.get("open", 0))
+        if n_open:
+            tenants_s = ", ".join(
+                f"{html.escape(str(t))}: {c}" for t, c in
+                sorted((sess.get("per-tenant") or {}).items()))
+            banner += (
+                "<p>" + _state_span(f"{n_open} open session"
+                                    f"{'s' if n_open != 1 else ''}",
+                                    "#2e7d32")
+                + f" oldest {sess.get('oldest-age-s', '?')} s, "
+                  f"{sess.get('appends', 0)} appends / "
+                  f"{sess.get('ops', 0)} ops carried"
+                + (f" &middot; {tenants_s}" if tenants_s else "")
+                + "</p>")
+        else:
+            banner += (f"<p>{_state_span('no open sessions', '#616161')} "
+                       f"{sess.get('closed', 0)} closed retained</p>")
     serve_rows = "".join(
         f"<tr><td>{html.escape(k)}</td><td>{v}</td></tr>"
         for k, v in sorted(counters.items())

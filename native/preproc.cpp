@@ -573,6 +573,31 @@ int64_t jt_mon_advance(void* h, const int32_t* T, int32_t S,
     return L;
 }
 
+// Pop every currently-settleable queued return WITHOUT walking it:
+// fills rows [cap, W], slots [cap], binds [cap]; returns the count.
+// The device-resident session engine drains here and walks the block
+// on the accelerator (jepsen_tpu/serve/session.py) — the settle
+// discipline stays this monitor's, only the walk moves off-host.
+// The native settled counter advances for every POPPED item (on a
+// mid-block death the engine's own Python counter — which stops at
+// the death index — is the authoritative one); DEATH handling is
+// entirely the caller's.
+int64_t jt_mon_drain(void* h, int64_t cap, int32_t* rows,
+                     int32_t* slots, int32_t* binds_out) {
+    auto* m = static_cast<JtMonitor*>(h);
+    int64_t n = 0;
+    while (!m->queue.empty() && n < cap) {
+        const JtItem& it = m->queue.front();
+        if (!m->rows_for(it, rows + n * m->W, false)) break;
+        slots[n] = m->binds[static_cast<size_t>(it.b)].slot;
+        binds_out[n] = it.b;
+        m->queue.pop_front();
+        ++n;
+    }
+    m->settled += n;
+    return n;
+}
+
 // Export the first K unsettled queue items for the tail alarm
 // (unresolved members as their crashed-at-invoke wildcards). Fills
 // rows [K, W], slots [K], binds [K]; returns the count.

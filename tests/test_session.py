@@ -1,0 +1,573 @@
+"""Streaming check sessions (ISSUE 11): the device-resident
+carried-frontier engine differentially held to the host online
+engines and the one-shot facade chain, the session HTTP protocol,
+journal replay across a (simulated) crash, the exactly-one-fallback
+device-death ladder, and the incremental transactional path.
+
+Host-only: everything runs under JAX_PLATFORMS=cpu (the word-packed
+walk and the dense einsum walk are the same XLA programs the device
+runs; the differential pins them bit-identical to the host C++
+engine either way)."""
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures, models
+from jepsen_tpu import history as h
+from jepsen_tpu import obs
+from jepsen_tpu.checkers import facade, preproc_native
+from jepsen_tpu.checkers.online import NativeStreamEngine
+from jepsen_tpu.serve import faults
+from jepsen_tpu.serve.session import (DeviceFrontierEngine, Session,
+                                      SessionRegistry,
+                                      TxnSessionEngine)
+
+needs_native = pytest.mark.skipif(
+    not preproc_native.available(),
+    reason="native monitor core unavailable")
+
+
+def _ragged_blocks(hist, seed: int, n_cuts: int = 4):
+    rng = np.random.RandomState(seed)
+    cuts = sorted(rng.choice(len(hist), size=n_cuts, replace=False))
+    blocks, prev = [], 0
+    for c in list(cuts) + [len(hist)]:
+        if c > prev:
+            blocks.append(hist[prev:c])
+            prev = c
+    return blocks
+
+
+def _http(url, method, path, payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url + path, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- scheduling units ------------------------------------------------------
+
+def test_plan_admission_session_blocks_one_ordered_group():
+    """Same-session blocks must form ONE dispatch group in strict seq
+    order — length bucketing would reorder a carried frontier's
+    stream."""
+    from jepsen_tpu.serve import plan_admission
+    from jepsen_tpu.serve.request import CheckRequest
+
+    class _S:
+        id = "s1"
+
+    sess = _S()
+    reqs = []
+    for seq, n in ((3, 5), (1, 400), (2, 7)):
+        ops = fixtures.gen_history("cas", n_ops=n, processes=2,
+                                   seed=seq)
+        reqs.append(CheckRequest(
+            id=f"r{seq}", tenant="t", model_name="cas-register",
+            model=models.cas_register(), packed=None, history=ops,
+            n_ops=len(ops), kind="session-append", session=sess,
+            seq=seq))
+    groups = plan_admission(reqs, group=2)
+    assert len(groups) == 1
+    assert [reqs[i].seq for i in groups[0]] == [1, 2, 3]
+
+
+def test_session_registry_census_and_bound():
+    reg = SessionRegistry(max_open=2, keep_closed=1)
+    s1 = Session("sa", "t1", "cas-register", models.cas_register())
+    s2 = Session("sb", "t2", "cas-register", models.cas_register())
+    reg.add(s1)
+    reg.add(s2)
+    with pytest.raises(RuntimeError):
+        reg.add(Session("sc", "t1", "cas-register",
+                        models.cas_register()))
+    c = reg.census()
+    assert c["open"] == 2 and c["per-tenant"] == {"t1": 1, "t2": 1}
+    assert c["oldest-age-s"] is not None
+    s1.closed = True
+    reg.mark_closed(s1)
+    s2.closed = True
+    reg.mark_closed(s2)          # keep_closed=1 evicts sa
+    assert reg.get("sa") is None and reg.get("sb") is not None
+    assert reg.census()["open"] == 0
+
+
+# -- the carried-frontier differential ------------------------------------
+
+@needs_native
+@pytest.mark.parametrize("seed,crash_p,corrupt",
+                         [(0, 0.0, False), (1, 0.0, True),
+                          (2, 0.02, False)])
+def test_device_vs_host_frontier_ragged_differential(seed, crash_p,
+                                                     corrupt):
+    """The satellite bar: device-vs-host frontier-carry differential
+    on ragged append block sizes, crashes included — violation
+    presence, witness op, AND settled-return count identical, plus
+    agreement with the one-shot facade on the concatenated
+    history."""
+    model = models.cas_register()
+    hist = fixtures.gen_history("cas", n_ops=150, processes=4,
+                                seed=seed, crash_p=crash_p)
+    if corrupt:
+        hist = fixtures.corrupt(hist, seed=seed)
+    host = NativeStreamEngine(model)
+    dev = DeviceFrontierEngine(model)
+    vh = vd = None
+    for b in _ragged_blocks(hist, seed):
+        host.feed_many(list(b))
+        dev.feed_many(list(b))
+        vh = vh or host.advance()
+        vd = vd or dev.advance()
+        if vh is None:
+            vh = host.tail_alarm()
+        if vd is None:
+            vd = dev.tail_alarm()
+    vh = vh or host.advance(run_over=True)
+    vd = vd or dev.advance(run_over=True)
+    assert (vh is None) == (vd is None)
+    if vh is not None:
+        assert vh["op"] == vd["op"]
+        assert vh["settled-returns"] == vd["settled-returns"]
+    ref = facade.auto_check_packed(model, h.pack(hist), {})
+    assert (vd is None) == (ref["valid"] is True)
+
+
+@needs_native
+def test_word_walk_vs_dense_walk_bit_identical(monkeypatch):
+    """The word-packed kernel body and the dense einsum body are the
+    same walk: identical violation ops and settled counts on a
+    corrupted stream."""
+    model = models.cas_register()
+    hist = fixtures.corrupt(
+        fixtures.gen_history("cas", n_ops=150, processes=4, seed=3),
+        seed=7)
+    results = []
+    for no_word in ("", "1"):
+        monkeypatch.setenv("JEPSEN_TPU_NO_WORD_WALK", no_word)
+        eng = DeviceFrontierEngine(model)
+        for b in _ragged_blocks(hist, 5):
+            eng.feed_many(list(b))
+            eng.advance()
+        v = eng.advance(run_over=True)
+        if no_word == "":
+            assert eng._carry is not None and eng._carry.words
+        results.append((v and v["op"], v and v["settled-returns"]))
+    assert results[0] == results[1]
+    assert results[0][0] is not None
+
+
+@needs_native
+def test_word_walk_carry_sane_under_concurrent_jax():
+    """Regression: donating the (tiny) word-packed carry corrupted it
+    under concurrent jax dispatch on the CPU client — garbage bits in
+    the aliased output produced false tail/advance alarms on valid
+    streams (caught by the chaos harness's session-across-SIGKILL
+    workload: daemon replay runs while the dispatcher walks replayed
+    one-shots). The word walk is now non-donating; this hammers the
+    engine with a concurrent facade thread and asserts no false
+    alarm ever fires."""
+    import threading
+    model = models.cas_register()
+    hist = fixtures.gen_history("cas", n_ops=72, processes=3,
+                                seed=2007)
+    blocks = [hist[i:i + 12] for i in range(0, len(hist), 12)]
+    onehots = [h.pack(fixtures.gen_history("cas", n_ops=n,
+                                           processes=3,
+                                           seed=1007 + i))
+               for i, n in enumerate([10, 14])]
+    facade.auto_check_packed(model, onehots[0], {})   # settle imports
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            facade.auto_check_packed(model, onehots[i % 2], {})
+            i += 1
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        for trial in range(8):
+            eng = DeviceFrontierEngine(model)
+            v = None
+            for b in blocks:
+                eng.feed_many(list(b))
+                v = v or eng.advance()
+                if v is None:
+                    v = eng.tail_alarm()
+                assert v is None, (trial, v)
+            assert eng.advance(run_over=True) is None
+    finally:
+        stop.set()
+        t.join(10)
+
+
+@needs_native
+def test_geometry_growth_reencodes_carry():
+    """Fresh alphabet values and new slots mid-stream force memo
+    rebuilds / W growth: the carry must re-seed from the re-encoded
+    host mirror, verdicts unchanged."""
+    from jepsen_tpu.op import invoke, ok
+    model = models.register()
+    # phase 1: two values, two processes
+    ops = [invoke(0, "write", 1), ok(0, "write", 1),
+           invoke(1, "read"), ok(1, "read", 1)]
+    eng = DeviceFrontierEngine(model)
+    eng.feed_many(ops)
+    assert eng.advance() is None
+    carry1 = eng._carry
+    # phase 2: a new value (alphabet growth -> memo rebuild) and a
+    # third process (slot growth)
+    ops2 = [invoke(0, "write", 9), ok(0, "write", 9),
+            invoke(2, "write", 3), invoke(1, "read"),
+            ok(1, "read", 9), ok(2, "write", 3),
+            invoke(0, "read"), ok(0, "read", 3)]
+    eng.feed_many(ops2)
+    assert eng.advance() is None
+    assert eng._carry is not carry1          # re-seeded
+    # phase 3: a genuine violation after the growth
+    ops3 = [invoke(1, "read"), ok(1, "read", 777)]
+    eng.feed_many(ops3)
+    v = eng.advance(run_over=True)
+    assert v is not None and v["valid"] is False
+
+
+# -- session semantics ------------------------------------------------------
+
+@needs_native
+def test_session_tail_alarm_and_permanent_failfast():
+    """A violation stuck behind a never-resolving op is caught by the
+    session's tail alarm (sound early warning), and fail-fast is
+    permanent: later appends return the sticky violation
+    unchanged."""
+    from jepsen_tpu.op import invoke, ok
+    sess = Session("st", "t", "register", models.register())
+    blk = [invoke(9, "write", 7),            # forever pending
+           invoke(0, "write", 1), ok(0, "write", 1),
+           invoke(1, "read"), ok(1, "read", 2)]   # reads a ghost
+    r = sess.advance_block(blk, seq=1)
+    assert r["valid-so-far"] is False
+    assert r["tail-alarm"] is True
+    first = r["violation"]
+    # permanent: a perfectly fine block cannot repair it
+    blk2 = [invoke(2, "write", 5), ok(2, "write", 5)]
+    r2 = sess.advance_block(blk2, seq=2)
+    assert r2["valid-so-far"] is False
+    assert r2["violation"]["op"] == first["op"]
+
+
+@needs_native
+def test_session_device_death_exactly_one_fallback():
+    """An injected device-path death mid-session: exactly ONE
+    session-advance obs fallback, the session continues host-side
+    with identical verdicts, and close still equals the facade."""
+    faults.reset()
+    faults.arm("session-advance", at=2)
+    try:
+        hist = fixtures.gen_history("cas", n_ops=120, processes=3,
+                                    seed=11)
+        blocks = [hist[i:i + 60] for i in range(0, len(hist), 60)]
+        with obs.capture() as cap:
+            sess = Session("sf", "t", "cas-register",
+                           models.cas_register())
+            for i, b in enumerate(blocks):
+                r = sess.advance_block(b, seq=i + 1)
+                assert r["valid-so-far"] is True
+            res = sess.close()
+        falls = [f for f in cap.fallbacks()
+                 if f["stage"] == "session-advance"]
+        assert len(falls) == 1
+        assert sess.fallbacks == 1
+        assert sess.engine_name == "session-host-monitor"
+        assert res["valid"] is True
+        ref = facade.auto_check_packed(models.cas_register(),
+                                       h.pack(hist), {})
+        assert res["valid"] is ref["valid"]
+        assert res.get("incremental", {}).get("valid") is True
+    finally:
+        faults.reset()
+
+
+@needs_native
+def test_session_overflow_routes_to_host_monitor():
+    """Capacity overflow (slot bound) is a recorded ROUTE, not a
+    fallback: the session continues on the host monitor and the
+    close verdict stands."""
+    hist = fixtures.gen_history("cas", n_ops=150, processes=4,
+                                seed=13, crash_p=0.10)
+    sess = Session("so", "t", "cas-register", models.cas_register(),
+                   opts={"max_slots": 6})
+    with obs.capture() as cap:
+        for i, b in enumerate(
+                [hist[j:j + 60] for j in range(0, len(hist), 60)]):
+            sess.advance_block(b, seq=i + 1)
+        res = sess.close()
+    assert not [f for f in cap.fallbacks()
+                if f["stage"] == "session-advance"]
+    assert sess.engine_name == "session-host-monitor"
+    assert res["valid"] in (True, False)
+    ref = facade.auto_check_packed(models.cas_register(),
+                                   h.pack(hist), {})
+    assert res["valid"] == ref["valid"]
+
+
+def test_session_close_empty_and_idempotent():
+    sess = Session("se", "t", "cas-register", models.cas_register())
+    res = sess.close()
+    assert res["valid"] is True and res["engine"] == "session-empty"
+    assert sess.close()["engine"] == "session-empty"
+    from jepsen_tpu.serve.session import SessionClosed
+    with pytest.raises(SessionClosed):
+        sess.advance_block([], seq=1)
+
+
+# -- transactional sessions -------------------------------------------------
+
+def test_incremental_infer_matches_posthoc_graph():
+    """At close (stragglers resolved) the incremental edge set equals
+    the post-hoc :func:`txn.infer.infer` edge set, modulo the tid
+    relabeling between completion order and invocation order."""
+    from jepsen_tpu.txn import infer as ti
+    from jepsen_tpu.txn import ops as to
+    hist = fixtures.gen_txn_history(50, keys=4, processes=6, seed=11)
+    hist = h.index(hist + [op.with_(index=-1) for op in
+                           fixtures.txn_anomaly_block("G-single")])
+    inc = ti.IncrementalInfer()
+    for b in [hist[i:i + 37] for i in range(0, len(hist), 37)]:
+        inc.feed_block(b)
+    inc.resolve_stragglers()
+    g = inc.graph()
+    txns, fails = to.collect(hist)
+    post = ti.infer(txns, fails)
+    pidx = {t.index: t.tid for t in post.txns}
+    mapped = {(pidx[g.txns[u].index], pidx[g.txns[v].index], t)
+              for u, v, t in zip(g.src.tolist(), g.dst.tolist(),
+                                 g.et.tolist())}
+    assert mapped == set(zip(post.src.tolist(), post.dst.tolist(),
+                             post.et.tolist()))
+    assert not g.direct and not post.direct
+
+
+def test_incremental_closure_dirty_blocks_and_regrow():
+    """Per-block incremental closure booleans equal the host SCC
+    reference at every step, across a geometry regrowth (Np 8 ->
+    32)."""
+    from jepsen_tpu.txn import cycles, host_ref
+    from jepsen_tpu.txn.infer import DepGraph
+    rng = np.random.RandomState(3)
+    clo = cycles.IncrementalClosure()
+    edges = []
+    n = 5
+    for step in range(6):
+        n = 5 + step * 5                     # grows past Np=8, 16
+        k = rng.randint(3, 9)
+        new = [(int(rng.randint(0, n)), int(rng.randint(0, n)),
+                int(rng.randint(0, 3))) for _ in range(k)]
+        new = [(u, v, t) for u, v, t in new if u != v]
+        fresh = [e for e in new if e not in set(edges)]
+        edges.extend(fresh)
+        src = np.asarray([e[0] for e in fresh], np.int32)
+        dst = np.asarray([e[1] for e in fresh], np.int32)
+        et = np.asarray([e[2] for e in fresh], np.int32)
+        booleans = clo.add_block(n, src, dst, et)
+        g = DepGraph(
+            n=n, src=np.asarray([e[0] for e in edges], np.int32),
+            dst=np.asarray([e[1] for e in edges], np.int32),
+            et=np.asarray([e[2] for e in edges], np.int8),
+            txns=())
+        assert booleans == host_ref.classify_booleans(g), step
+    assert clo.Np >= 32
+
+
+def test_txn_session_flags_anomaly_mid_stream():
+    """A txn session flags an injected G-single on the append that
+    completes the cycle — an ONLINE anomaly detector — and close is
+    the authoritative auto_check_txn result."""
+    from jepsen_tpu.txn.ops import list_append_model
+    hist = fixtures.gen_txn_history(30, keys=3, processes=4, seed=5)
+    anomaly = [op.with_(index=-1)
+               for op in fixtures.txn_anomaly_block("G-single")]
+    hist = h.index(hist + anomaly)
+    sess = Session("tx", "t", "txn-list-append", list_append_model())
+    blocks = [hist[i:i + 40] for i in range(0, len(hist), 40)]
+    flagged = None
+    for i, b in enumerate(blocks):
+        r = sess.advance_block(b, seq=i + 1)
+        if flagged is None and r["valid-so-far"] is False:
+            flagged = i + 1
+    assert flagged is not None
+    res = sess.close()
+    assert res["valid"] is False
+    assert "G-single" in (res.get("anomalies") or [])
+    ref = facade.auto_check_txn(list(hist), {})
+    assert ref["valid"] is False
+    assert res.get("anomalies") == ref.get("anomalies")
+    assert res.get("witness") == ref.get("witness")
+
+
+def test_txn_session_closure_death_falls_to_host():
+    """A txn closure device death: one session-advance fallback, host
+    booleans from then on, verdicts unchanged."""
+    hist = fixtures.gen_txn_history(24, keys=3, processes=4, seed=9)
+    hist = h.index(hist)
+    from jepsen_tpu.txn.ops import list_append_model
+    sess = Session("txf", "t", "txn-list-append", list_append_model())
+
+    def boom(*a, **k):
+        raise RuntimeError("injected closure death")
+    sess._eng.closure.add_block = boom
+    with obs.capture() as cap:
+        blocks = [hist[i:i + 30] for i in range(0, len(hist), 30)]
+        for i, b in enumerate(blocks):
+            r = sess.advance_block(b, seq=i + 1)
+            assert r["valid-so-far"] is True
+        res = sess.close()
+    falls = [f for f in cap.fallbacks()
+             if f["stage"] == "session-advance"]
+    assert len(falls) == 1
+    assert sess.engine_name == "session-txn-host"
+    assert res["valid"] is True
+
+
+# -- HTTP protocol + journal replay ----------------------------------------
+
+@needs_native
+def test_session_http_end_to_end_with_replay(tmp_path):
+    """The whole protocol over real HTTP with a simulated crash: open
+    + appends journaled, a second daemon on the same root re-derives
+    the session (same id, same seq), a retried append dedups, close
+    equals the facade (witness included for the violating stream)."""
+    from jepsen_tpu import serve
+    root = str(tmp_path / "store")
+    d1 = serve.Daemon(port=0, store_root=root).start()
+    url = f"http://127.0.0.1:{d1.port}"
+    hist = fixtures.gen_history("cas", n_ops=150, processes=3,
+                                seed=21)
+    bad = fixtures.corrupt(hist, seed=2)
+    blocks = [bad[i:i + 60] for i in range(0, len(bad), 60)]
+    code, r = _http(url, "POST", "/session",
+                    {"model": "cas-register", "tenant": "tt"})
+    assert code == 201
+    sid = r["session"]
+    code, r = _http(url, "POST", f"/session/{sid}/append",
+                    {"history": [op.to_dict() for op in blocks[0]],
+                     "seq": 1})
+    assert code == 200 and "valid-so-far" in r
+    # out-of-band "crash": abandon d1 without drain/shutdown
+    d1.httpd.server_close()
+    d1.dispatcher.stop()
+
+    d2 = serve.Daemon(port=0, store_root=root).start()
+    url2 = f"http://127.0.0.1:{d2.port}"
+    try:
+        code, st = _http(url2, "GET", f"/session/{sid}")
+        assert code == 200 and st["status"] == "open"
+        assert st["seq"] == 1 and st["replayed-appends"] == 1
+        # retried block (its response "was lost"): dedup, not reapply
+        code, r = _http(url2, "POST", f"/session/{sid}/append",
+                        {"history": [op.to_dict()
+                                     for op in blocks[0]], "seq": 1})
+        assert code == 200 and r.get("deduped") is True
+        # a seq GAP is a protocol error, never silently renumbered
+        code, r = _http(url2, "POST", f"/session/{sid}/append",
+                        {"history": [op.to_dict()
+                                     for op in blocks[1]], "seq": 5})
+        assert code == 409 and "seq gap" in r["error"]
+        for seq, b in enumerate(blocks[1:], start=2):
+            code, r = _http(url2, "POST", f"/session/{sid}/append",
+                            {"history": [op.to_dict() for op in b],
+                             "seq": seq})
+            assert code == 200
+        code, r = _http(url2, "POST", f"/session/{sid}/close", {})
+        assert code == 200
+        res = r["result"]
+        ref = facade.auto_check_packed(models.cas_register(),
+                                       h.pack(bad), {})
+        assert res["valid"] is False and ref["valid"] is False
+        assert res.get("op") == ref.get("op")
+        # closed marker survives: a third daemon answers from it
+        code, st = _http(url2, "GET", f"/session/{sid}")
+        assert code == 200 and st["status"] == "closed"
+        # appends after close are a 409
+        code, _ = _http(url2, "POST", f"/session/{sid}/append",
+                        {"history": [op.to_dict()
+                                     for op in blocks[0]], "seq": 99})
+        assert code == 409
+        # stats carry the census + counters
+        with urllib.request.urlopen(url2 + "/stats",
+                                    timeout=30) as resp:
+            stats = json.loads(resp.read())
+        assert "sessions" in stats
+        assert stats["counters"].get("serve.session.replayed", 0) >= 1
+    finally:
+        d2.shutdown()
+
+
+def test_session_unknown_and_closed_lookup(tmp_path):
+    from jepsen_tpu import serve
+    d = serve.Daemon(port=0, store_root=str(tmp_path)).start(
+        dispatch=False)
+    url = f"http://127.0.0.1:{d.port}"
+    try:
+        code, _ = _http(url, "GET", "/session/nope")
+        assert code == 404
+        code, _ = _http(url, "POST", "/session/nope/append",
+                        {"history": [{"process": 0,
+                                      "type": "invoke",
+                                      "f": "read"}], "seq": 1})
+        assert code == 404
+        code, _ = _http(url, "POST", "/session",
+                        {"model": "not-a-model"})
+        assert code == 400
+    finally:
+        d.shutdown()
+
+
+def test_journal_session_gc(tmp_path):
+    from jepsen_tpu.serve.journal import Journal
+    j = Journal(str(tmp_path), keep_terminal=2)
+    for i in range(4):
+        sid = f"s{i}"
+        j.session_open(sid, tenant="t", model_name="cas-register",
+                       options={})
+        j.session_append_entry(sid, 1, fixtures.gen_history(
+            "cas", n_ops=4, processes=2, seed=i))
+        j.session_close_marker(sid, {"valid": True})
+    assert j.gc() >= 2
+    names = os.listdir(str(tmp_path))
+    remaining = {n.split(".")[0] for n in names if "sess" in n}
+    assert len(remaining) == 2
+    # open sessions are never collected
+    j.session_open("sopen", tenant="t", model_name="cas-register",
+                   options={})
+    j.gc()
+    assert "sopen" in j.open_session_ids()
+
+
+def test_web_engine_renders_open_sessions_row(tmp_path):
+    from jepsen_tpu import web
+    d = tmp_path / "serve"
+    d.mkdir()
+    (d / "stats.json").write_text(json.dumps({
+        "counters": {}, "queue": {}, "breaker": {"state": "closed"},
+        "sessions": {"open": 2, "closed": 1, "oldest-age-s": 12.5,
+                     "per-tenant": {"team-a": 2}, "appends": 7,
+                     "ops": 420}}))
+    html_out = web._engine_html(str(tmp_path))
+    assert "2 open sessions" in html_out
+    assert "team-a" in html_out and "12.5" in html_out
+    (d / "stats.json").write_text(json.dumps({
+        "counters": {}, "queue": {},
+        "sessions": {"open": 0, "closed": 3}}))
+    html_out = web._engine_html(str(tmp_path))
+    assert "no open sessions" in html_out

@@ -300,6 +300,36 @@ def run_chaos(opts: Dict[str, Any]) -> Dict[str, Any]:
             if c1.get("serve.quarantined", 0) < 1:
                 violate("no serve.quarantined counter")
 
+        # ---- long-lived streaming session: opened + partially
+        # appended BEFORE the SIGKILL; it must ride the crash — same
+        # id, journaled appends replayed, frontier re-derived — and
+        # reach the same final verdict as the standalone facade ----
+        from jepsen_tpu import fixtures as _fx
+        sess_hist = _fx.gen_history("cas", n_ops=72, processes=3,
+                                    seed=seed + 2000)
+        sess_blocks = [sess_hist[i:i + 12]
+                       for i in range(0, len(sess_hist), 12)]
+        n_pre = len(sess_blocks) // 2
+        sess_id = None
+        code, resp = _lg._post_json(d1.url, "/session",
+                                    {"model": "cas-register",
+                                     "tenant": "chaos-sess"})
+        if code != 201:
+            violate(f"session open failed: {code} {resp}")
+        else:
+            sess_id = resp["session"]
+            for seq in range(1, n_pre + 1):
+                code, r = _lg._post_json(
+                    d1.url, f"/session/{sess_id}/append",
+                    {"history": [op.to_dict()
+                                 for op in sess_blocks[seq - 1]],
+                     "seq": seq, "wait-s": 120})
+                if code != 200 or r.get("valid-so-far") is not True:
+                    violate(f"pre-kill session append {seq} bad: "
+                            f"{code} {r}")
+        report["session_id"] = sess_id
+        report["session_pre_kill_appends"] = n_pre
+
         # ---- phase 2: wave 2 posts, then SIGKILL mid-load ----
         wave2 = build_cases(seed=seed + 1000, n=4 if quick else 8,
                             sizes=[10, 14], violation_frac=0.3,
@@ -337,6 +367,63 @@ def run_chaos(opts: Dict[str, Any]) -> Dict[str, Any]:
         if first_done is not None:
             report["recovery_to_first_verdict_s"] = round(
                 first_done - t_kill, 3)
+
+        # ---- the session rode the SIGKILL: same id, journaled
+        # appends replayed (no lost acks), frontier re-derived;
+        # post-kill appends continue the stream and close must equal
+        # the standalone facade on the full concatenated history ----
+        if sess_id is not None:
+            code, st = _get(d2.url, f"/session/{sess_id}")
+            if code != 200 or st.get("status") != "open":
+                violate(f"session {sess_id} lost across restart: "
+                        f"{code} {st}")
+            elif int(st.get("seq", -1)) != n_pre:
+                violate(f"session replay lost appends: seq "
+                        f"{st.get('seq')} != {n_pre}")
+            else:
+                report["session_replayed_appends"] = \
+                    st.get("replayed-appends")
+                # a RETRIED pre-kill append (its response was lost to
+                # the crash, says the client) must dedup, not
+                # double-advance the frontier
+                code, r = _lg._post_json(
+                    d2.url, f"/session/{sess_id}/append",
+                    {"history": [op.to_dict()
+                                 for op in sess_blocks[n_pre - 1]],
+                     "seq": n_pre})
+                if code != 200 or not r.get("deduped"):
+                    violate(f"retried session append did not dedup: "
+                            f"{code} {r}")
+                for seq in range(n_pre + 1, len(sess_blocks) + 1):
+                    code, r = _lg._post_json(
+                        d2.url, f"/session/{sess_id}/append",
+                        {"history": [op.to_dict() for op in
+                                     sess_blocks[seq - 1]],
+                         "seq": seq, "wait-s": 120})
+                    if code != 200 \
+                            or r.get("valid-so-far") is not True:
+                        violate(f"post-kill session append {seq} "
+                                f"bad: {code} {r}")
+                code, r = _lg._post_json(
+                    d2.url, f"/session/{sess_id}/close", {})
+                sres = (r.get("result") or {}) if code == 200 else {}
+                report["session_close"] = {
+                    "valid": sres.get("valid"),
+                    "engine": sres.get("engine"),
+                    "incremental": sres.get("incremental")}
+                if code != 200 or sres.get("valid") is not True:
+                    violate(f"session close verdict wrong: "
+                            f"{code} {r}")
+                else:
+                    from jepsen_tpu import history as _h
+                    from jepsen_tpu import models as _models
+                    from jepsen_tpu.checkers import facade as _facade
+                    stand = _facade.auto_check_packed(
+                        _models.cas_register(), _h.pack(sess_hist),
+                        {})
+                    if stand["valid"] is not sres.get("valid"):
+                        violate("session close diverges from the "
+                                "standalone facade")
 
         # invariant 1: every 202 reached a terminal state
         for c in wave1 + wave2 + ([poison_case] if poison_case

@@ -78,12 +78,13 @@ def build_pool(*, sizes, tenants: int, violation_frac: float,
     return pool
 
 
-def _post(url: str, body: bytes) -> Tuple[int, Dict]:
+def _post(url: str, body: bytes, path: str = "/check",
+          timeout: float = 30.0) -> Tuple[int, Dict]:
     req = urllib.request.Request(
-        url + "/check", data=body,
+        url + path, data=body,
         headers={"Content-Type": "application/json"})
     try:
-        with urllib.request.urlopen(req, timeout=30) as r:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read())
     except urllib.error.HTTPError as e:
         try:
@@ -469,6 +470,145 @@ def run_load(url: str, *, rate: float, duration: float,
     return report
 
 
+def build_session_plans(*, n_sessions: int, ops_per_session: int,
+                        appends: int, violation_frac: float,
+                        seed: int = 7) -> List[Dict]:
+    """Session traffic plans: each a known-ground-truth history split
+    into append blocks (violating sessions get a corrupted stream, so
+    the incremental verdict has something to catch)."""
+    from jepsen_tpu import fixtures
+
+    plans = []
+    for i in range(n_sessions):
+        hist = fixtures.gen_history("cas", n_ops=ops_per_session,
+                                    processes=3, seed=seed + 100 + i)
+        expect = True
+        if (i * 997 % 101) / 101.0 < violation_frac:
+            hist = fixtures.corrupt(hist, seed=seed + i)
+            expect = False
+        step = max(1, len(hist) // appends)
+        blocks = [hist[j:j + step]
+                  for j in range(0, len(hist), step)]
+        plans.append({"tenant": f"sess-tenant-{i % 2}",
+                      "expect": expect,
+                      "blocks": [[op.to_dict() for op in b]
+                                 for b in blocks]})
+    return plans
+
+
+def run_session_traffic(url: str, plans: List[Dict], *,
+                        cadence_s: float = 0.15,
+                        wait_s: float = 60.0) -> Dict[str, Any]:
+    """Drive long-lived sessions (one thread each, appends at the
+    configured cadence) and gate their verdicts against ground truth:
+    a valid stream must never be flagged, a violating stream must be
+    flagged by close at the latest (earlier = streaming win, counted).
+    Reports the per-append-latency distribution — the
+    append-to-verdict number the session protocol exists for."""
+    results: List[Dict] = []
+    lock = threading.Lock()
+
+    def one(plan: Dict) -> None:
+        rec: Dict[str, Any] = {"expect": plan["expect"],
+                               "appends": 0, "latencies": [],
+                               "flagged_at": None, "final": None,
+                               "errors": 0}
+        code, resp = _post_json(url, "/session",
+                                {"model": "cas-register",
+                                 "tenant": plan["tenant"]})
+        if code != 201:
+            rec["errors"] += 1
+            rec["final"] = f"open-error-{code}"
+            with lock:
+                results.append(rec)
+            return
+        sid = resp["session"]
+        rec["session"] = sid
+        for seq, block in enumerate(plan["blocks"], start=1):
+            t0 = time.monotonic()
+            code, r = _post_json(
+                url, f"/session/{sid}/append",
+                {"history": block, "seq": seq, "wait-s": wait_s})
+            if code == 429:
+                # backpressure: retry once after the advised delay
+                time.sleep(float(r.get("retry-after-s", 1.0)))
+                code, r = _post_json(
+                    url, f"/session/{sid}/append",
+                    {"history": block, "seq": seq, "wait-s": wait_s})
+            if code == 202 and r.get("id"):
+                # slow dispatch: protocol-legal — the verdict arrives
+                # via GET /check/<id>; poll it out rather than
+                # miscounting a healthy daemon as an error
+                end = time.monotonic() + wait_s
+                while time.monotonic() < end:
+                    code2, st = _get(url, f"/check/{r['id']}")
+                    if code2 == 200 and st.get("status") == "done" \
+                            and st.get("result"):
+                        code, r = 200, st["result"]
+                        break
+                    time.sleep(0.1)
+            if code != 200:
+                rec["errors"] += 1
+                continue
+            rec["appends"] += 1
+            rec["latencies"].append(time.monotonic() - t0)
+            if rec["flagged_at"] is None \
+                    and r.get("valid-so-far") is False:
+                rec["flagged_at"] = seq
+            time.sleep(cadence_s)
+        code, r = _post_json(url, f"/session/{sid}/close", {})
+        if code == 200:
+            rec["final"] = (r.get("result") or {}).get("valid")
+        else:
+            rec["errors"] += 1
+            rec["final"] = f"close-error-{code}"
+        with lock:
+            results.append(rec)
+
+    threads = [threading.Thread(target=one, args=(p,), daemon=True)
+               for p in plans]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    wall = max(1e-9, time.monotonic() - t0)
+    lats = sorted(x for r in results for x in r["latencies"])
+    mismatches = [r for r in results
+                  if r["final"] is not r["expect"]]
+    # a VALID stream flagged mid-run is a false alarm — as much a
+    # verdict bug as a wrong close
+    false_alarms = [r for r in results
+                    if r["expect"] and r["flagged_at"] is not None]
+    total_ops = sum(len(b) for p in plans for b in p["blocks"])
+    return {
+        "sessions": len(plans),
+        "appends": sum(r["appends"] for r in results),
+        "append_ops": total_ops,
+        "errors": sum(r["errors"] for r in results),
+        "wall_s": round(wall, 3),
+        "sustained_append_ops_s": round(total_ops / wall, 1),
+        "append_p50_s": (round(_percentile(lats, 0.50), 4)
+                         if lats else None),
+        "append_p99_s": (round(_percentile(lats, 0.99), 4)
+                         if lats else None),
+        "verdict_mismatches": len(mismatches),
+        "false_alarms": len(false_alarms),
+        "violating_sessions": sum(1 for r in results
+                                  if not r["expect"]),
+        "flagged_before_close": sum(
+            1 for r in results
+            if not r["expect"] and r["flagged_at"] is not None),
+    }
+
+
+def _post_json(url: str, path: str, payload: Dict) -> Tuple[int, Dict]:
+    # one transport ladder for the toolbox: delegate to _post (the
+    # longer timeout covers synchronous session appends/closes)
+    return _post(url, json.dumps(payload).encode(), path=path,
+                 timeout=120.0)
+
+
 def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
     """Programmatic entry (bench.py's ``serve`` sub-object): ``opts``
     mirrors the CLI flags. Self-hosts a daemon when no url given."""
@@ -508,9 +648,38 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
         # scrape the e2e histogram around the measured run: the delta
         # is the measured window's distribution, warmup excluded
         hist_before = fetch_hist_buckets(url)
+        sess_result: Dict[str, Any] = {}
+        sess_thread = None
+        if opts.get("sessions"):
+            # mixed traffic: long-lived sessions append at their
+            # cadence WHILE the one-shot open-loop load runs — the
+            # coalescer interleaves append groups with check groups,
+            # which is the serving regime sessions actually face
+            plans = build_session_plans(
+                n_sessions=int(opts.get("n_sessions")
+                               or (2 if quick else 4)),
+                ops_per_session=int(opts.get("session_ops")
+                                    or (240 if quick else 2000)),
+                appends=int(opts.get("session_appends")
+                            or (6 if quick else 12)),
+                violation_frac=float(
+                    opts.get("violation_frac", 0.25)),
+                seed=int(opts.get("seed", 7)))
+
+            def _run_sessions() -> None:
+                sess_result.update(run_session_traffic(
+                    url, plans,
+                    cadence_s=float(opts.get("session_cadence")
+                                    or 0.1)))
+            sess_thread = threading.Thread(target=_run_sessions,
+                                           daemon=True)
+            sess_thread.start()
         report.update(run_load(
             url, rate=rate, duration=duration, pool=pool,
             chaos_tolerant=bool(opts.get("chaos_tolerant"))))
+        if sess_thread is not None:
+            sess_thread.join(600)
+            report["sessions"] = sess_result
         hist_after = fetch_hist_buckets(url)
         xc = crosscheck_quantiles(
             {"p50": report.get("p50_s"), "p99": report.get("p99_s")},
@@ -556,6 +725,14 @@ def main(argv=None) -> int:
                          "error-restart (not error-net), keep "
                          "polling across the gap, and report "
                          "recovery-time-to-first-verdict")
+    ap.add_argument("--sessions", action="store_true",
+                    help="mix long-lived streaming sessions into the "
+                         "load (appends at --session-cadence) and "
+                         "gate their incremental + close verdicts "
+                         "against ground truth, reporting the "
+                         "per-append latency distribution")
+    ap.add_argument("--session-cadence", type=float, default=0.1,
+                    help="seconds between one session's appends")
     args = ap.parse_args(argv)
     if args.self_host and args.url:
         ap.error("--self-host and --url are mutually exclusive")
@@ -566,12 +743,24 @@ def main(argv=None) -> int:
         "seed": args.seed, "store_root": args.store_root,
         "quick": args.quick, "warmup": not args.no_warmup,
         "chaos_tolerant": args.chaos_tolerant,
+        "sessions": args.sessions,
+        "session_cadence": args.session_cadence,
     })
     print(json.dumps(report, default=str))
     if report.get("error"):
         return 2
     ok = (report.get("completed", 0) > 0
           and report.get("verdict_mismatches", 0) == 0)
+    # session gate: every close verdict equals its stream's ground
+    # truth, no valid stream was ever flagged mid-run, no transport
+    # errors — the streaming protocol's correctness bar
+    sess = report.get("sessions")
+    if sess is not None:
+        if (sess.get("verdict_mismatches", 0)
+                or sess.get("false_alarms", 0)
+                or sess.get("errors", 0)
+                or sess.get("appends", 0) == 0):
+            ok = False
     # the histogram cross-check catches clock/stamping bugs: loadgen's
     # client-measured quantiles and the daemon's histogram-derived
     # ones must agree (>15% past the poll-resolution slack is a bug)
