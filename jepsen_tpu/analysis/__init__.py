@@ -1,0 +1,25 @@
+"""jtlint — the AST-driven invariant analyzer (docs/ANALYSIS.md).
+
+Turns the repo's hand-enforced disciplines into static CI gates:
+donation aliasing (the PR-10 reuse-after-donation bug class), silent
+``except`` fallbacks, the ``JEPSEN_TPU_*`` gate registry + doc
+cross-check, obs counter/doc drift, and declared lock discipline.
+
+Pure stdlib ``ast`` — importing this package never imports jax, so
+``python -m jepsen_tpu.analysis --strict`` runs anywhere in seconds.
+
+Entry points::
+
+    python -m jepsen_tpu.analysis [--strict] [...]
+    python tools/lint.py [--strict] [...]
+
+Programmatic::
+
+    from jepsen_tpu.analysis import run_lint
+    report = run_lint("/path/to/repo")
+    assert not report["live"]
+"""
+from jepsen_tpu.analysis.core import (Finding, Module, PASS_IDS,  # noqa: F401
+                                      Tree, load_baseline, main,
+                                      run_lint, run_passes,
+                                      save_baseline, triage)

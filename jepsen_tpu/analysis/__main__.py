@@ -1,0 +1,7 @@
+"""``python -m jepsen_tpu.analysis`` — the jtlint CLI."""
+import sys
+
+from jepsen_tpu.analysis.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

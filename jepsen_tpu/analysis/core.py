@@ -1,0 +1,413 @@
+"""jtlint core: the shared machinery of the AST-driven invariant
+analyzer — source-tree loading, findings, inline suppression, the
+checked-in baseline, and the CLI.
+
+The analyzer turns the repo's hand-enforced disciplines (ENGINE.md /
+OBSERVABILITY.md / SERVING.md folklore plus per-site tests) into CI
+gates, the same budget-file-plus-guard shape as
+``tools/transfer_guard.py``:
+
+- **Pure stdlib ``ast``** — no jax import anywhere on the lint path,
+  so the CI job needs no accelerator stack and finishes in seconds.
+- **Findings carry ``file:line`` + a pass id** and are suppressible
+  inline (``# jtlint: ok <pass>`` on the finding line) or via the
+  checked-in ``data/lint_baseline.json`` for accepted pre-existing
+  cases — baseline adds require touching the checked-in file so they
+  show up in review.
+- **``--strict`` exits nonzero** on anything unsuppressed.
+
+The five passes (each its own module, registered in :data:`PASSES`):
+
+==================  =====================================================
+``donation``        host-side reads of a ``jax.jit(...,
+                    donate_argnums=...)`` operand after the dispatch —
+                    the PR-10 reuse-after-donation bug class
+                    (:mod:`jepsen_tpu.analysis.donation`)
+``fallback``        ``except`` handlers in ``checkers/``/``serve/``/
+                    ``txn/`` that suppress without an obs/ledger record
+                    on every path (:mod:`jepsen_tpu.analysis.fallback`)
+``env-gate``        every ``JEPSEN_TPU_*`` read collected into
+                    ``data/env_gates.json`` and cross-checked against
+                    the docs, both directions
+                    (:mod:`jepsen_tpu.analysis.envgates`)
+``counter-drift``   ``obs.count/gauge/histogram`` name literals vs the
+                    OBSERVABILITY.md counter tables, both directions,
+                    with prefix-pattern support for dynamic names
+                    (:mod:`jepsen_tpu.analysis.counters`)
+``lock-discipline`` attributes a class declares guarded
+                    (``_GUARDED_BY``) touched outside ``with
+                    self.<lock>`` (:mod:`jepsen_tpu.analysis.locks`)
+==================  =====================================================
+
+Extending: write a module with ``run(tree) -> List[Finding]``, add it
+to :data:`PASSES`, document it in docs/ANALYSIS.md, and give
+``tests/test_analysis.py`` a violating fixture + a clean twin.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+PASS_IDS = ("donation", "fallback", "env-gate", "counter-drift",
+            "lock-discipline")
+
+# # jtlint: ok            -- suppress every pass on this line
+# # jtlint: ok donation   -- suppress one pass (comma-separate for more)
+_SUPPRESS_RE = re.compile(r"#\s*jtlint:\s*ok\b([\w ,\-]*)")
+
+_DEFAULT_BASELINE = os.path.join("data", "lint_baseline.json")
+_DEFAULT_REGISTRY = os.path.join("data", "env_gates.json")
+
+# directories whose .py files the analyzer loads (tests are NOT
+# scanned: fixtures there deliberately violate the disciplines)
+_CODE_DIRS = ("jepsen_tpu", "tools")
+_CODE_FILES = ("bench.py",)
+_DOC_FILES = ("README.md", "ROADMAP.md")
+_DOC_DIRS = ("docs",)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: pass id + repo-relative file + line + message.
+    The baseline keys on ``(pass, file, msg)`` — deliberately NOT the
+    line, so unrelated edits shifting lines cannot churn it."""
+    pass_id: str
+    file: str
+    line: int
+    msg: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.pass_id, self.file, self.msg)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.pass_id}] {self.msg}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"pass": self.pass_id, "file": self.file,
+                "line": self.line, "msg": self.msg}
+
+
+class Module:
+    """One parsed source file: AST + raw lines + the per-line inline
+    suppression table."""
+
+    def __init__(self, rel: str, source: str) -> None:
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(source)
+        except SyntaxError as e:        # surfaced as its own finding
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        # line -> set of suppressed pass ids ('*' = all)
+        self.suppress: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            ids = {p.strip() for p in m.group(1).split(",") if p.strip()}
+            self.suppress[i] = ids or {"*"}
+
+    def suppressed(self, finding: Finding) -> bool:
+        """Inline suppression: ``# jtlint: ok <pass>`` on the finding
+        line, or on a standalone comment line directly above it."""
+        for line in (finding.line, finding.line - 1):
+            ids = self.suppress.get(line)
+            if not ids:
+                continue
+            if line != finding.line:
+                text = self.lines[line - 1].strip() \
+                    if 0 < line <= len(self.lines) else ""
+                if not text.startswith("#"):
+                    continue
+            if "*" in ids or finding.pass_id in ids:
+                return True
+        return False
+
+
+class Tree:
+    """The lint unit: every code module plus the doc texts. Built from
+    a repo root, or assembled by tests from in-memory fixtures."""
+
+    def __init__(self, root: str, modules: Sequence[Module],
+                 docs: Dict[str, str]) -> None:
+        self.root = root
+        self.modules = list(modules)
+        self.docs = dict(docs)
+
+    @classmethod
+    def load(cls, root: str) -> "Tree":
+        modules: List[Module] = []
+        for d in _CODE_DIRS:
+            base = os.path.join(root, d)
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [x for x in dirnames
+                               if x != "__pycache__"
+                               and not x.startswith(".")]
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(path, root).replace(os.sep,
+                                                              "/")
+                    modules.append(cls._read_module(path, rel))
+        for fn in _CODE_FILES:
+            path = os.path.join(root, fn)
+            if os.path.exists(path):
+                modules.append(cls._read_module(path, fn))
+        docs: Dict[str, str] = {}
+        for fn in _DOC_FILES:
+            path = os.path.join(root, fn)
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as f:
+                    docs[fn] = f.read()
+        for d in _DOC_DIRS:
+            base = os.path.join(root, d)
+            if not os.path.isdir(base):
+                continue
+            for fn in sorted(os.listdir(base)):
+                if fn.endswith(".md"):
+                    with open(os.path.join(base, fn),
+                              encoding="utf-8") as f:
+                        docs[f"{d}/{fn}"] = f.read()
+        return cls(root, modules, docs)
+
+    @staticmethod
+    def _read_module(path: str, rel: str) -> Module:
+        with open(path, encoding="utf-8") as f:
+            return Module(rel, f.read())
+
+    def module(self, rel: str) -> Optional[Module]:
+        for m in self.modules:
+            if m.rel == rel:
+                return m
+        return None
+
+
+# -- pass registry (populated lazily to keep import order trivial) -------
+
+def _passes() -> Dict[str, Any]:
+    from jepsen_tpu.analysis import (counters, donation, envgates,
+                                     fallback, locks)
+    return {
+        "donation": donation.run,
+        "fallback": fallback.run,
+        "env-gate": envgates.run,
+        "counter-drift": counters.run,
+        "lock-discipline": locks.run,
+    }
+
+
+def run_passes(tree: Tree,
+               passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """All findings from the selected passes (default: every pass),
+    plus one ``parse`` finding per unparseable module — a file the
+    analyzer cannot read must not pass silently."""
+    registry = _passes()
+    selected = list(passes) if passes else list(PASS_IDS)
+    unknown = [p for p in selected if p not in registry]
+    if unknown:
+        raise ValueError(f"unknown pass(es): {unknown}")
+    findings: List[Finding] = []
+    for m in tree.modules:
+        if m.parse_error:
+            findings.append(Finding("parse", m.rel, 1,
+                                    f"unparseable: {m.parse_error}"))
+    for p in selected:
+        findings.extend(registry[p](tree))
+    findings.sort(key=lambda f: (f.file, f.line, f.pass_id, f.msg))
+    return findings
+
+
+# -- suppression + baseline ----------------------------------------------
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """key -> accepted occurrence count (entries without a ``count``
+    field accept exactly one occurrence)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("findings", []):
+        key = (e["pass"], e["file"], e["msg"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    # carry hand-written extra fields (the review `why` rationales)
+    # through a regeneration for keys that survive
+    extras: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+    if os.path.exists(path):
+        try:
+            with open(path, encoding="utf-8") as f:
+                for e in json.load(f).get("findings", []):
+                    key = (e["pass"], e["file"], e["msg"])
+                    extra = {k: v for k, v in e.items()
+                             if k not in ("pass", "file", "msg",
+                                          "count")}
+                    if extra:
+                        extras[key] = extra
+        except (OSError, ValueError, KeyError):
+            pass
+    data = {
+        "_comment": ("jtlint accepted pre-existing findings; adds "
+                     "require touching this checked-in file so they "
+                     "show up in review. Keyed (pass, file, msg) "
+                     "with an occurrence count — line-number churn "
+                     "cannot invalidate entries, but a NEW identical "
+                     "violation in the same file exceeds the count "
+                     "and goes live."),
+        "findings": [dict({"pass": p, "file": fl, "msg": m,
+                           "count": counts[(p, fl, m)]},
+                          **extras.get((p, fl, m), {}))
+                     for (p, fl, m) in sorted(counts)],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True,
+                  ensure_ascii=False)
+        f.write("\n")
+
+
+def triage(tree: Tree, findings: Sequence[Finding],
+           baseline: Dict[Tuple[str, str, str], int],
+           passes: Optional[Sequence[str]] = None
+           ) -> Dict[str, List[Finding]]:
+    """Split findings into inline-suppressed, baselined, and live
+    (unsuppressed). The baseline accepts up to ``count`` occurrences
+    per key — the count+1'th identical violation goes LIVE, so a new
+    instance of an accepted pattern still shows up in review. Entries
+    whose accepted count exceeds what fired are ``stale_baseline``
+    (accepted cases cannot quietly outlive their justification);
+    staleness only considers entries of the selected ``passes`` —
+    a subset run must not call untested entries stale."""
+    remaining = dict(baseline)
+    by_rel = {m.rel: m for m in tree.modules}
+    out: Dict[str, List[Finding]] = {
+        "live": [], "inline": [], "baselined": []}
+    for f in findings:
+        mod = by_rel.get(f.file)
+        if mod is not None and mod.suppressed(f):
+            out["inline"].append(f)
+        elif remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            out["baselined"].append(f)
+        else:
+            out["live"].append(f)
+    ran = set(passes) if passes else set(PASS_IDS)
+    out["stale_baseline"] = [Finding(p, fl, 0, m)
+                             for (p, fl, m), n in remaining.items()
+                             if n > 0 and p in ran]
+    return out
+
+
+def run_lint(root: str, passes: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None) -> Dict[str, Any]:
+    """Load the tree, run the passes, triage against the baseline.
+    The programmatic entry tests and tools share with the CLI."""
+    tree = Tree.load(root)
+    findings = run_passes(tree, passes)
+    bp = baseline_path if baseline_path is not None else \
+        os.path.join(root, _DEFAULT_BASELINE)
+    t = triage(tree, findings, load_baseline(bp), passes)
+    t["tree"] = tree
+    return t
+
+
+# -- CLI -----------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jtlint",
+        description="AST-driven invariant analyzer (docs/ANALYSIS.md)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default: {_DEFAULT_BASELINE})")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of: "
+                         + ",".join(PASS_IDS))
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any live finding or stale "
+                         "baseline entry")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current live "
+                         "findings")
+    ap.add_argument("--emit-env-registry", action="store_true",
+                    help=f"regenerate {_DEFAULT_REGISTRY} from the "
+                         "tree and exit")
+    args = ap.parse_args(argv)
+
+    root = args.root or _find_root()
+    passes = ([p.strip() for p in args.passes.split(",") if p.strip()]
+              if args.passes else None)
+
+    if args.emit_env_registry:
+        from jepsen_tpu.analysis import envgates
+        tree = Tree.load(root)
+        path = os.path.join(root, _DEFAULT_REGISTRY)
+        envgates.write_registry(tree, path)
+        print(f"wrote {os.path.relpath(path, root)} "
+              f"({len(envgates.collect_gates(tree))} gates)")
+        return 0
+
+    bp = args.baseline or os.path.join(root, _DEFAULT_BASELINE)
+
+    if args.write_baseline:
+        # regenerate from scratch: triage against an EMPTY baseline so
+        # currently-baselined findings are re-accepted, not dropped
+        tree = Tree.load(root)
+        t0 = triage(tree, run_passes(tree, passes), {}, passes)
+        save_baseline(bp, t0["live"])
+        print(f"wrote {os.path.relpath(bp, root)} "
+              f"({len(t0['live'])} findings)")
+        return 0
+
+    t = run_lint(root, passes, bp)
+
+    if args.json:
+        print(json.dumps({
+            "live": [f.to_json() for f in t["live"]],
+            "inline_suppressed": [f.to_json() for f in t["inline"]],
+            "baselined": [f.to_json() for f in t["baselined"]],
+            "stale_baseline": [f.to_json()
+                               for f in t["stale_baseline"]],
+        }, indent=2))
+    else:
+        for f in t["live"]:
+            print(f.render())
+        for f in t["stale_baseline"]:
+            print(f"{f.file}: [{f.pass_id}] STALE baseline entry "
+                  f"(no longer fires): {f.msg}")
+        print(f"jtlint: {len(t['live'])} live, "
+              f"{len(t['inline'])} inline-suppressed, "
+              f"{len(t['baselined'])} baselined, "
+              f"{len(t['stale_baseline'])} stale baseline")
+    if args.strict and (t["live"] or t["stale_baseline"]):
+        return 1
+    return 0
+
+
+def _find_root() -> str:
+    """Repo root: the directory holding the ``jepsen_tpu`` package
+    this module was imported from."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+if __name__ == "__main__":          # pragma: no cover
+    sys.exit(main())

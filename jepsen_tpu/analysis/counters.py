@@ -1,0 +1,232 @@
+"""jtlint pass ``counter-drift``: the obs counter/gauge/histogram
+namespace versus the OBSERVABILITY.md taxonomy table, both
+directions.
+
+Code side — collected with pure ``ast`` from ``jepsen_tpu/``:
+
+- ``obs.count("…")`` / ``obs.gauge`` / ``obs.histogram`` call sites
+  with a literal first argument;
+- f-string names become *prefix patterns*: dynamic pieces turn into
+  ``*`` segments (``f"engine.fallback.{stage}.{cause}"`` ->
+  ``engine.fallback.*.*``), matching the doc rows' ``<stage>``
+  placeholders;
+- inside :mod:`jepsen_tpu.obs` itself, the bare ``count(…)`` helpers
+  and the registry-internal ``self.counters["…"]`` stores (the
+  ``obs.dropped.*`` bookkeeping) are collected too.
+
+Doc side — every backticked name in the first column of
+OBSERVABILITY.md table rows, with ``{a,b}`` alternation expanded and
+``<placeholder>`` mapped to ``*``.
+
+A code name with no matching row is an undocumented metric; a row no
+code emits is doc rot. Dynamic (non-literal, non-f-string) names are
+skipped — a documented limitation, not a silent pass: they are
+counted and reported by ``--json`` consumers via the pass module's
+:func:`collect_code_names`.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from jepsen_tpu.analysis.core import Finding, Tree
+
+PASS_ID = "counter-drift"
+
+_DOC_REL = "docs/OBSERVABILITY.md"
+_OBS_FNS = {"count", "gauge", "histogram", "observe"}
+_NAME_OK = re.compile(r"[A-Za-z0-9_.*:<>-]+\Z")
+
+
+def _pattern_of_arg(arg: ast.AST) -> Optional[str]:
+    """Literal -> exact name; JoinedStr -> pattern with '*' dynamic
+    segments; ``"prefix." + expr`` -> ``prefix.*``; anything else ->
+    None (dynamic, skipped)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts: List[str] = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+        left = _pattern_of_arg(arg.left)
+        if left is not None:
+            return left.rstrip("*") + "*"
+    return None
+
+
+def _helper_patterns(mod_tree: ast.Module) -> Dict[str, str]:
+    """Module functions whose every return is a resolvable name
+    expression — ``obs.count(_counter_name(x))`` then collects the
+    helper's pattern (one level; the ``serve.fault.<name>`` idiom)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(mod_tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        pats: List[str] = []
+        ok = True
+        for n in ast.walk(node):
+            if isinstance(n, ast.Return) and n.value is not None:
+                p = _pattern_of_arg(n.value)
+                if p is None:
+                    ok = False
+                    break
+                pats.append(p)
+        if ok and len(set(pats)) == 1:
+            out[node.name] = pats[0]
+    return out
+
+
+def collect_code_names(tree: Tree) -> Tuple[
+        Dict[str, List[Tuple[str, int]]], List[Tuple[str, int]]]:
+    """(pattern -> sites, dynamic-call sites). Scans jepsen_tpu/."""
+    names: Dict[str, List[Tuple[str, int]]] = {}
+    dynamic: List[Tuple[str, int]] = []
+    for mod in tree.modules:
+        if mod.tree is None \
+                or not mod.rel.startswith("jepsen_tpu/"):
+            continue
+        in_obs = mod.rel.startswith("jepsen_tpu/obs/")
+        helpers = _helper_patterns(mod.tree)
+        for node in ast.walk(mod.tree):
+            arg: Optional[ast.AST] = None
+            site = None
+            if isinstance(node, ast.Call):
+                f = node.func
+                is_obs_attr = (isinstance(f, ast.Attribute)
+                               and f.attr in _OBS_FNS
+                               and isinstance(f.value, ast.Name)
+                               and f.value.id == "obs")
+                is_bare = (in_obs and isinstance(f, ast.Name)
+                           and f.id in _OBS_FNS)
+                if (is_obs_attr or is_bare) and node.args:
+                    arg = node.args[0]
+                    site = (mod.rel, node.lineno)
+            elif in_obs and isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and node.value.attr in ("counters", "gauges"):
+                # registry-internal bookkeeping, e.g.
+                # self.counters["obs.dropped.spans"]
+                arg = node.slice
+                site = (mod.rel, node.lineno)
+            if arg is None or site is None:
+                continue
+            pat = _pattern_of_arg(arg)
+            if pat is None and isinstance(arg, ast.Call):
+                f = arg.func
+                hn = f.id if isinstance(f, ast.Name) else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                pat = helpers.get(hn) if hn else None
+            if pat is None:
+                dynamic.append(site)
+            elif "." in pat:        # namespaced metrics only
+                names.setdefault(pat, []).append(site)
+    for sites in names.values():
+        sites.sort()
+    return names, dynamic
+
+
+# -- doc table parsing ---------------------------------------------------
+
+_BACKTICK = re.compile(r"`([^`]+)`")
+_BRACE = re.compile(r"\{([^{}]*)\}")
+
+
+def _expand_braces(name: str) -> List[str]:
+    m = _BRACE.search(name)
+    if not m:
+        return [name]
+    out: List[str] = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand_braces(
+            name[:m.start()] + alt.strip() + name[m.end():]))
+    return out
+
+
+def _normalize(name: str) -> Optional[str]:
+    """Doc token -> match pattern: ``<placeholder>`` becomes ``*``.
+    None for tokens that are not metric names (prose code spans)."""
+    n = re.sub(r"<[^<>]*>", "*", name.strip())
+    if "." not in n or "=" in n or "(" in n or " " in n:
+        return None
+    if not _NAME_OK.match(n):
+        return None
+    return n
+
+
+def collect_doc_rows(tree: Tree) -> Dict[str, List[Tuple[str, int]]]:
+    """pattern -> [(doc file, line)] from the OBSERVABILITY.md
+    taxonomy table rows (first column, backticked names)."""
+    text = tree.docs.get(_DOC_REL, "")
+    rows: Dict[str, List[Tuple[str, int]]] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        s = line.strip()
+        if not s.startswith("|"):
+            continue
+        cells = s.split("|")
+        if len(cells) < 3:
+            continue
+        first = cells[1]
+        if set(first.strip()) <= {"-", " ", ":"}:
+            continue                        # divider row
+        if first.strip().lower() in ("name",):
+            continue                        # header row
+        for m in _BACKTICK.finditer(first):
+            for ex in _expand_braces(m.group(1)):
+                n = _normalize(ex)
+                if n is not None:
+                    rows.setdefault(n, []).append((_DOC_REL, i))
+    return rows
+
+
+# -- matching ------------------------------------------------------------
+
+def _seg_match(a: str, b: str) -> bool:
+    if a == "*" or b == "*":
+        return True
+    if "*" in a or "*" in b:
+        return fnmatch.fnmatchcase(b, a) or fnmatch.fnmatchcase(a, b)
+    return a == b
+
+
+def patterns_match(code: str, doc: str) -> bool:
+    ca, da = code.split("."), doc.split(".")
+    if len(ca) != len(da):
+        # a trailing wildcard absorbs extra segments (dynamic pieces
+        # may themselves contain dots, e.g. tenant names)
+        if da and da[-1] == "*" and len(ca) > len(da):
+            ca = ca[:len(da) - 1] + ["*"]
+        elif ca and ca[-1] == "*" and len(da) > len(ca):
+            da = da[:len(ca) - 1] + ["*"]
+        else:
+            return False
+    return all(_seg_match(x, y) for x, y in zip(ca, da))
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    if _DOC_REL not in tree.docs:
+        return findings
+    code, _dynamic = collect_code_names(tree)
+    rows = collect_doc_rows(tree)
+
+    for pat, sites in sorted(code.items()):
+        if not any(patterns_match(pat, d) for d in rows):
+            f, line = sites[0]
+            findings.append(Finding(
+                PASS_ID, f, line,
+                f"obs name '{pat}' has no {_DOC_REL} table row"))
+
+    for doc, where in sorted(rows.items()):
+        if not any(patterns_match(c, doc) for c in code):
+            f, line = where[0]
+            findings.append(Finding(
+                PASS_ID, f, line,
+                f"{_DOC_REL} row '{doc}' matches no obs call site"))
+    return findings

@@ -1,0 +1,523 @@
+"""jtlint pass ``donation``: host-side reads of a donated operand
+after its dispatch — the exact PR-10 bug class (a donated word-walk
+carry read by the host while XLA recycled its buffer corrupted the
+session frontier in ~30% of concurrent runs, never single-threaded).
+
+What it knows how to see, all with pure ``ast``:
+
+1. **Donating callables.** A ``jax.jit(..., donate_argnums=<literal>)``
+   call anywhere makes its enclosing function a *donating factory*
+   (the repo idiom: ``_jitted_advance_frontier`` /
+   ``_lane_call(..., donate=True)`` / ``_inc_call(...)`` return the
+   jitted callable). When the jit sits under ``X if <param> else Y``
+   or ``if <param>:`` and ``<param>`` is a factory parameter, donation
+   is *gated*: a call site donates only when it passes that parameter
+   a value other than its (False) default — resolved positionally or
+   by keyword against the factory's signature.
+2. **Donating call sites.** ``factory(...)(args)``, a local binding
+   ``f = factory(...); f(args)``, or an immediate
+   ``jax.jit(g, donate_argnums=...)(args)``.
+3. **The dataflow.** For a donated operand that is a plain name or a
+   ``self.<attr>``, statement-ordered scan of the enclosing function
+   AFTER the dispatch: a load before any rebind is a finding. If the
+   dispatch statement itself rebinds the operand
+   (``R = step(R, ...)`` — the carried-advance idiom) the name refers
+   to the fresh buffer and the site is clean. If the dispatch sits in
+   a loop and the operand is never rebound inside it, reads earlier
+   in the loop body execute after the dispatch on iteration 2+ and
+   are flagged too (the PR-10 shape).
+
+Over-approximations, by design: statements in exclusive ``else``
+branches after the dispatch are scanned (suppress with
+``# jtlint: ok donation`` when provably unreachable), and donated
+operands that are expressions (``jnp.asarray(x)``) are skipped — a
+fresh temporary has no host alias to protect.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from jepsen_tpu.analysis.core import Finding, Module, Tree
+
+PASS_ID = "donation"
+
+# a symbol is a plain local name ('name', x) or an instance attribute
+# ('self', attr) — the two alias shapes worth tracking
+Sym = Tuple[str, str]
+
+
+@dataclass
+class Factory:
+    """One donating callable maker."""
+    name: str
+    positions: Tuple[int, ...]
+    params: Tuple[str, ...] = ()
+    gate_param: Optional[str] = None       # donation-enabling param
+    gate_default: bool = False             # its default truthiness
+    direct: bool = False                   # name IS the jitted callable
+
+
+def _is_jit(func: ast.AST) -> bool:
+    if isinstance(func, ast.Attribute) and func.attr == "jit":
+        return True
+    return isinstance(func, ast.Name) and func.id == "jit"
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums of a jax.jit call, else None."""
+    if not _is_jit(call.func):
+        return None
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for e in v.elts:
+                if not (isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)):
+                    return None
+                out.append(e.value)
+            return tuple(out)
+        return None
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> Tuple[str, ...]:
+    a = fn.args
+    return tuple(p.arg for p in (a.posonlyargs + a.args))
+
+
+def _param_default(fn: ast.FunctionDef, name: str) -> bool:
+    """Truthiness of the (constant) default of ``name``; False when
+    required or non-constant."""
+    a = fn.args
+    pos = list(a.posonlyargs + a.args)
+    defaults = list(a.defaults)
+    for p, d in zip(reversed(pos), reversed(defaults)):
+        if p.arg == name and isinstance(d, ast.Constant):
+            return bool(d.value)
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if p.arg == name and isinstance(d, ast.Constant):
+            return bool(d.value)
+    return False
+
+
+def _decorator_donation(fn: ast.FunctionDef) -> Optional[Factory]:
+    """``@functools.partial(jax.jit, donate_argnums=…)`` (the common
+    decorator idiom): the decorated function IS a donating callable."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        f = dec.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name == "partial" and dec.args and _is_jit(dec.args[0]):
+            jit_proxy = ast.Call(func=dec.args[0], args=[],
+                                 keywords=dec.keywords)
+            pos = _donate_positions(jit_proxy)
+            if pos:
+                return Factory(fn.name, pos, direct=True)
+        pos = _donate_positions(dec)        # @jax.jit(donate_argnums=…)
+        if pos:
+            return Factory(fn.name, pos, direct=True)
+    return None
+
+
+def collect_factories(tree: Tree) -> Dict[str, Factory]:
+    """Bare-name index of donating callables across the whole tree
+    (call sites routinely import them, so matching is by name — a
+    collision keeps the first record, conservatively)."""
+    out: Dict[str, Factory] = {}
+    for mod in tree.modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                fac = _decorator_donation(node) \
+                    or _factory_from_def(node)
+                if fac is not None:
+                    out.setdefault(fac.name, fac)
+            elif isinstance(node, ast.Assign):
+                # module/class-level `g = jax.jit(f, donate_argnums=…)`
+                if (isinstance(node.value, ast.Call)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    pos = _donate_positions(node.value)
+                    if pos:
+                        n = node.targets[0].id
+                        out.setdefault(n, Factory(n, pos, direct=True))
+    return out
+
+
+def _own_statements(fn: ast.FunctionDef) -> List[ast.stmt]:
+    """The function's statements in source order, recursing into
+    compound statements but NOT into nested function/class defs."""
+    out: List[ast.stmt] = []
+
+    def rec(stmts: Sequence[ast.stmt]) -> None:
+        for st in stmts:
+            out.append(st)
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            for fname in ("body", "orelse", "finalbody"):
+                sub = getattr(st, fname, None)
+                if sub:
+                    rec(sub)
+            for h in getattr(st, "handlers", ()) or ():
+                rec(h.body)
+    rec(fn.body)
+    return out
+
+
+def _factory_from_def(fn: ast.FunctionDef) -> Optional[Factory]:
+    """Does ``fn`` contain a donate-jit call in its OWN statements
+    (nested defs excluded — those are the kernel bodies being
+    jitted)? Resolve the optional gating parameter."""
+    params = _param_names(fn)
+    for st in _own_statements(fn):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        for node in ast.walk(st):
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            pos = _donate_positions(node)
+            if pos is None:
+                continue
+            gate = _gate_param(st, node, params)
+            return Factory(fn.name, pos, params, gate,
+                           _param_default(fn, gate) if gate else False)
+    return None
+
+
+def _gate_param(stmt: ast.stmt, jit_call: ast.Call,
+                params: Tuple[str, ...]) -> Optional[str]:
+    """Gating parameter when the jit call sits under
+    ``A if <param> else B`` or ``if <param>:``."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.IfExp) \
+                and isinstance(node.test, ast.Name) \
+                and node.test.id in params:
+            if any(n is jit_call for n in ast.walk(node.body)):
+                return node.test.id
+    if isinstance(stmt, ast.If) and isinstance(stmt.test, ast.Name) \
+            and stmt.test.id in params:
+        return stmt.test.id
+    return None
+
+
+def _call_donates(fac: Factory, call: ast.Call) -> bool:
+    """Does THIS call to a gated factory enable donation? Ungated
+    factories always donate; gated ones donate when the gate argument
+    resolves to anything but a constant falsy (absent -> default)."""
+    if fac.direct:
+        return True
+    if fac.gate_param is None:
+        return True
+    for kw in call.keywords:
+        if kw.arg == fac.gate_param:
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True                     # dynamic gate: assume on
+        if kw.arg is None:
+            return True                     # **kwargs: unresolvable
+    try:
+        idx = fac.params.index(fac.gate_param)
+    except ValueError:
+        return fac.gate_default
+    if idx < len(call.args):
+        a = call.args[idx]
+        if any(isinstance(x, ast.Starred) for x in call.args[:idx + 1]):
+            return True                     # *args: unresolvable
+        if isinstance(a, ast.Constant):
+            return bool(a.value)
+        return True
+    return fac.gate_default
+
+
+def _sym_of(expr: ast.AST) -> Optional[Sym]:
+    if isinstance(expr, ast.Name):
+        return ("name", expr.id)
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return ("self", expr.attr)
+    return None
+
+
+def _direct_nodes(stmt: ast.stmt) -> List[ast.AST]:
+    """Expression nodes DIRECTLY in this statement — compound
+    statements contribute only their headers (their bodies are
+    separate entries in the flattened statement order), and nested
+    function/lambda bodies are excluded."""
+    roots: List[ast.AST] = []
+    for fname, val in ast.iter_fields(stmt):
+        if fname in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(val, ast.AST):
+            roots.append(val)
+        elif isinstance(val, list):
+            roots.extend(x for x in val if isinstance(x, ast.AST))
+    out: List[ast.AST] = []
+    for root in roots:
+        for node in ast.walk(root):
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                continue
+            out.append(node)
+    return out
+
+
+def _sym_events(stmt: ast.stmt) -> Tuple[Set[Sym], Set[Sym]]:
+    """(loads, stores) of trackable symbols DIRECTLY in this
+    statement (compound statements contribute only their headers —
+    their bodies are separate entries in the flattened order)."""
+    loads: Set[Sym] = set()
+    stores: Set[Sym] = set()
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return loads, stores
+    for node in _direct_nodes(stmt):
+        sym = _sym_of(node)
+        if sym is None:
+            continue
+        ctx = getattr(node, "ctx", None)
+        if isinstance(ctx, ast.Store):
+            stores.add(sym)
+        elif isinstance(ctx, (ast.Load, ast.Del)):
+            loads.add(sym)
+    if isinstance(stmt, ast.AugAssign):
+        # `R |= mask` LOADS the old buffer before rebinding — on a
+        # donated operand that read is itself the hazard
+        sym = _sym_of(stmt.target)
+        if sym is not None:
+            loads.add(sym)
+    return loads, stores
+
+
+def _conditional_ancestors(fn: ast.FunctionDef
+                           ) -> Dict[ast.stmt, Tuple[ast.stmt, ...]]:
+    """Per statement, the enclosing branching/looping statements
+    (if/for/while/try) within ``fn`` — a statement under one of these
+    may not execute on every path through code that reaches it."""
+    out: Dict[ast.stmt, Tuple[ast.stmt, ...]] = {}
+
+    def rec(node: ast.AST, chain: Tuple[ast.stmt, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)) \
+                and node is not fn:
+            return
+        if isinstance(node, ast.stmt):
+            out[node] = chain
+        if isinstance(node, (ast.If, ast.For, ast.While, ast.Try)):
+            chain = chain + (node,)
+        for child in ast.iter_child_nodes(node):
+            rec(child, chain)
+
+    rec(fn, ())
+    return out
+
+
+def _enclosing_loop(fn: ast.FunctionDef,
+                    stmt: ast.stmt) -> Optional[ast.stmt]:
+    """Innermost for/while of ``fn`` containing ``stmt`` (nested defs
+    excluded)."""
+    best: Optional[ast.stmt] = None
+
+    def rec(node: ast.AST, loops: List[ast.stmt]) -> bool:
+        if node is stmt:
+            nonlocal best
+            best = loops[-1] if loops else None
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)) \
+                and node is not fn:
+            return False
+        push = isinstance(node, (ast.For, ast.While))
+        if push:
+            loops.append(node)
+        hit = any(rec(c, loops) for c in ast.iter_child_nodes(node))
+        if push:
+            loops.pop()
+        return hit
+
+    rec(fn, [])
+    return best
+
+
+@dataclass
+class _Site:
+    call: ast.Call
+    stmt: ast.stmt
+    sym: Sym
+    factory: str
+
+
+def _donating_sites(fn: ast.FunctionDef,
+                    factories: Dict[str, Factory]) -> List[_Site]:
+    """Donated (trackable) operands of every donating dispatch in
+    ``fn``, with the statement each dispatch lives in."""
+    stmts = _own_statements(fn)
+    # local bindings: f = factory(...)  (donation resolved per call)
+    bound: Dict[str, Factory] = {}
+    for st in stmts:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and isinstance(st.value, ast.Call):
+            fac = _factory_of_call(st.value, factories)
+            if fac is not None and not fac.direct \
+                    and _call_donates(fac, st.value):
+                bound[st.targets[0].id] = fac
+
+    sites: List[_Site] = []
+    for st in stmts:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        for node in _direct_nodes(st):
+            if not isinstance(node, ast.Call):
+                continue
+            fac, positions = _dispatch_positions(node, factories, bound)
+            if fac is None:
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue                    # arg mapping unresolvable
+            for p in positions:
+                if p < len(node.args):
+                    sym = _sym_of(node.args[p])
+                    if sym is not None:
+                        sites.append(_Site(node, st, sym, fac))
+    return sites
+
+
+def _factory_of_call(call: ast.Call,
+                     factories: Dict[str, Factory]
+                     ) -> Optional[Factory]:
+    f = call.func
+    name = None
+    if isinstance(f, ast.Name):
+        name = f.id
+    elif isinstance(f, ast.Attribute):
+        name = f.attr
+    fac = factories.get(name) if name else None
+    return fac if fac is not None and not fac.direct else None
+
+
+def _dispatch_positions(call: ast.Call, factories: Dict[str, Factory],
+                        bound: Dict[str, Factory]
+                        ) -> Tuple[Optional[str], Tuple[int, ...]]:
+    """Is ``call`` a donating dispatch? Returns (factory name,
+    donated positions) or (None, ())."""
+    f = call.func
+    # factory(...)(args) — including jax.jit(g, donate_argnums=…)(args)
+    if isinstance(f, ast.Call):
+        pos = _donate_positions(f)
+        if pos is not None:
+            return ("jax.jit", pos)
+        fac = _factory_of_call(f, factories)
+        if fac is not None and _call_donates(fac, f):
+            return (fac.name, fac.positions)
+        return (None, ())
+    # bound(args) / direct(args)
+    name = None
+    if isinstance(f, ast.Name):
+        name = f.id
+    elif isinstance(f, ast.Attribute):
+        name = f.attr
+    if name in bound:
+        return (bound[name].name, bound[name].positions)
+    fac = factories.get(name) if name else None
+    if fac is not None and fac.direct:
+        return (fac.name, fac.positions)
+    return (None, ())
+
+
+def _sym_str(sym: Sym) -> str:
+    return f"self.{sym[1]}" if sym[0] == "self" else sym[1]
+
+
+def _check_site(fn: ast.FunctionDef, site: _Site,
+                mod: Module) -> List[Finding]:
+    stmts = _own_statements(fn)
+    try:
+        i = stmts.index(site.stmt)
+    except ValueError:                      # pragma: no cover
+        return []
+    loads_i, stores_i = _sym_events(site.stmt)
+    if site.sym in stores_i:
+        # `R = step(R, …)`: the name now holds the fresh buffer
+        return []
+    findings: List[Finding] = []
+    cond = _conditional_ancestors(fn)
+    call_chain = set(cond.get(site.stmt, ()))
+
+    def scan(seq: Sequence[ast.stmt]) -> Optional[str]:
+        for st in seq:
+            lo, sto = _sym_events(st)
+            if site.sym in lo:
+                findings.append(Finding(
+                    PASS_ID, mod.rel, st.lineno,
+                    f"host read of donated operand "
+                    f"'{_sym_str(site.sym)}' after donating dispatch "
+                    f"of {site.factory} (donated at line "
+                    f"{site.call.lineno})"))
+                return "read"
+            if site.sym in sto:
+                # a store ends the hazard only when it executes
+                # UNCONDITIONALLY relative to the dispatch: a rebind
+                # inside an if/loop/try the dispatch is not in may be
+                # skipped, leaving later reads on the stale buffer
+                if set(cond.get(st, ())) <= call_chain:
+                    return "rebound"
+        return None
+
+    outcome = scan(stmts[i + 1:])
+    if outcome == "read":
+        return findings
+    # loop wrap: never rebound inside the enclosing loop -> loads
+    # textually before the dispatch run on the stale buffer next
+    # iteration (the PR-10 shape)
+    loop = _enclosing_loop(fn, site.stmt)
+    if loop is not None:
+        loop_stmts = [st for st in stmts
+                      if st is not loop
+                      and st.lineno >= loop.lineno
+                      and (st.end_lineno or st.lineno)
+                      <= (loop.end_lineno or loop.lineno)]
+        rebound_in_loop = any(
+            site.sym in _sym_events(st)[1] for st in loop_stmts)
+        if not rebound_in_loop:
+            j = loop_stmts.index(site.stmt)
+            scan(loop_stmts[:j])
+    return findings
+
+
+def run(tree: Tree) -> List[Finding]:
+    factories = collect_factories(tree)
+    findings: List[Finding] = []
+    if not factories:
+        return findings
+    for mod in tree.modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for site in _donating_sites(node, factories):
+                findings.extend(_check_site(node, site, mod))
+    # one finding per (file, line, msg)
+    seen: Set[Tuple[str, int, str]] = set()
+    out = []
+    for f in findings:
+        k = (f.file, f.line, f.msg)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

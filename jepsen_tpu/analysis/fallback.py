@@ -1,0 +1,251 @@
+"""jtlint pass ``fallback``: ``except`` handlers in ``checkers/``,
+``serve/``, and ``txn/`` that *suppress* (return / continue / break /
+fall through rather than re-raise) without an obs/ledger record on
+every suppressing path.
+
+This is the "no silent fallback" discipline OBSERVABILITY.md
+documents and `obs.capture()` asserts dynamically — made static, so
+a new ``except Exception: return None`` cannot land unrecorded even
+on paths no test exercises.
+
+What counts as a record: a call to ``obs.count`` / ``gauge`` /
+``histogram`` / ``observe`` / ``decision`` / ``engine_fallback`` /
+``engine_selected`` / ``engine_skipped`` / ``checker_swallowed``, a
+``ledger_record`` (the serve tenant ledger), a call to any tree
+function/method that itself records (computed as a name-keyed
+fixpoint, so helpers like ``facade._fellback``,
+``session._to_host_monitor``, or ``reach._warn_pallas_failed``
+satisfy the discipline at their call sites), or — in the serve HTTP
+layer — a structured ``return 4xx/5xx, {...}`` error response (the
+client receives the error; the response is the record).
+
+Path analysis is a conservative structural walk: ``if``/``else``
+branches are both followed, loop bodies may run zero times (a record
+inside a loop does NOT satisfy the discipline), and a handler whose
+every path raises needs nothing. Best-effort cleanup handlers
+(``except OSError: pass`` around ``os.unlink``) that are genuinely
+fine carry an inline ``# jtlint: ok fallback`` with the
+justification next to the code it excuses.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from jepsen_tpu.analysis.core import Finding, Tree
+
+PASS_ID = "fallback"
+
+_SCOPES = ("jepsen_tpu/checkers/", "jepsen_tpu/serve/",
+           "jepsen_tpu/txn/")
+
+_OBS_ATTRS = {
+    "count", "gauge", "histogram", "observe", "decision",
+    "engine_fallback", "engine_selected", "engine_skipped",
+    "checker_swallowed", "ledger_record",
+}
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _tree_recorders(tree: Tree) -> Set[str]:
+    """Names of functions/methods anywhere in the tree whose body
+    contains an obs-ish call, closed under calls-a-recorder
+    (fixpoint) — so a handler delegating to a helper that records
+    (``facade._fellback``, ``session._to_host_monitor``,
+    ``reach._warn_pallas_failed`` from another module) is compliant.
+    Name-keyed across modules: deliberately permissive — a shared
+    name with one recording definition credits them all, which can
+    only under-report, never false-positive."""
+    fns: Dict[str, ast.AST] = {}
+    for mod in tree.modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, node)
+    recorders: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in fns.items():
+            if name in recorders:
+                continue
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Call):
+                    cn = _call_name(n)
+                    if cn in _OBS_ATTRS or cn in recorders:
+                        recorders.add(name)
+                        changed = True
+                        break
+    return recorders
+
+
+def _http_error_return(st: ast.Return) -> bool:
+    """``return 4xx/5xx, {...}`` — the serve HTTP layer's structured
+    error responses. The client receives the error, so the path is
+    not silent: the response IS the record."""
+    v = st.value
+    return (isinstance(v, ast.Tuple) and len(v.elts) >= 2
+            and isinstance(v.elts[0], ast.Constant)
+            and isinstance(v.elts[0].value, int)
+            and v.elts[0].value >= 400)
+
+
+def _records(node: ast.AST, recorders: Set[str]) -> bool:
+    """Does this (sub)tree contain an obs/ledger/recorder call?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            cn = _call_name(n)
+            if cn in _OBS_ATTRS or cn in recorders:
+                return True
+    return False
+
+
+# terminals: (kind, recorded) with kind in raise/return/continue/break
+_Terminal = Tuple[str, bool]
+
+
+def _block(stmts: Sequence[ast.stmt], rec: bool,
+           recorders: Set[str]) -> Tuple[List[_Terminal],
+                                         Optional[bool]]:
+    """Walk a statement list. Returns (terminals, fallthrough):
+    ``terminals`` are the exits taken inside, each with
+    recorded-by-then; ``fallthrough`` is recorded-at-end, or None
+    when the block cannot fall through."""
+    terms: List[_Terminal] = []
+    for st in stmts:
+        if isinstance(st, ast.Raise):
+            terms.append(("raise", rec))
+            return terms, None
+        if isinstance(st, ast.Return):
+            terms.append(("return",
+                          rec or _records(st, recorders)
+                          or _http_error_return(st)))
+            return terms, None
+        if isinstance(st, ast.Continue):
+            terms.append(("continue", rec))
+            return terms, None
+        if isinstance(st, ast.Break):
+            terms.append(("break", rec))
+            return terms, None
+        if isinstance(st, ast.If):
+            if _records(st.test, recorders):
+                rec = True
+            t1, f1 = _block(st.body, rec, recorders)
+            t2, f2 = _block(st.orelse, rec, recorders)
+            terms += t1 + t2
+            if f1 is None and f2 is None:
+                return terms, None
+            rec = all(f for f in (f1, f2) if f is not None)
+            continue
+        if isinstance(st, (ast.For, ast.While)):
+            it = getattr(st, "iter", None) or getattr(st, "test", None)
+            if it is not None and _records(it, recorders):
+                rec = True
+            t, _f = _block(st.body, rec, recorders)
+            te, fe = _block(st.orelse, rec, recorders)
+            # break/continue are loop-local; the loop may run zero
+            # times, so body records do not carry past it
+            terms += [x for x in t if x[0] in ("raise", "return")]
+            terms += te
+            if fe is None:
+                return terms, None
+            rec = rec and fe
+            continue
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                if _records(item.context_expr, recorders):
+                    rec = True
+            t, f = _block(st.body, rec, recorders)
+            terms += t
+            if f is None:
+                return terms, None
+            rec = f
+            continue
+        if isinstance(st, ast.Try):
+            tb, fb = _block(st.body, rec, recorders)
+            # raises in the try body may be caught by its own
+            # handlers — drop them (never hides a bad exit: the
+            # handlers' own exits are walked below)
+            terms += [x for x in tb if x[0] != "raise"]
+            falls: List[Optional[bool]] = [fb]
+            for h in st.handlers:
+                th, fh = _block(h.body, rec, recorders)
+                terms += th
+                falls.append(fh)
+            if st.orelse:
+                to, fo = _block(st.orelse, fb if fb is not None
+                                else rec, recorders)
+                terms += to
+                falls[0] = fo if fb is not None else None
+            if st.finalbody:
+                tf, ff = _block(st.finalbody, rec, recorders)
+                terms += [x for x in tf if x[0] == "raise"]
+                if ff is None:
+                    return terms, None
+                if _records(ast.Module(body=list(st.finalbody),
+                                       type_ignores=[]), recorders):
+                    falls = [True if f is not None else None
+                             for f in falls]
+            live = [f for f in falls if f is not None]
+            if not live:
+                return terms, None
+            rec = all(live)
+            continue
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue                    # a nested def runs later
+        if _records(st, recorders):
+            rec = True
+    return terms, rec
+
+
+def _handler_findings(handler: ast.ExceptHandler, mod: Module,
+                      recorders: Set[str],
+                      finally_records: bool = False) -> List[Finding]:
+    # a recording `finally` on the handler's own Try runs on every
+    # exit path through the handler — credit it up front
+    terms, fall = _block(handler.body, finally_records, recorders)
+    silent = [t for t in terms
+              if t[0] in ("return", "continue", "break") and not t[1]]
+    if fall is not None and not fall:
+        silent.append(("fall", False))
+    if not silent:
+        return []
+    caught = ast.unparse(handler.type) if handler.type is not None \
+        else "BaseException"
+    how = sorted({k for k, _ in silent})
+    return [Finding(
+        PASS_ID, mod.rel, handler.lineno,
+        f"except {caught}: handler suppresses "
+        f"({'/'.join(how)}) without an obs/ledger record on every "
+        f"path")]
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    recorders = _tree_recorders(tree)
+    for mod in tree.modules:
+        if mod.tree is None:
+            continue
+        if not any(mod.rel.startswith(s) for s in _SCOPES):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            fin = bool(node.finalbody) and any(
+                _records(st, recorders) for st in node.finalbody)
+            for handler in node.handlers:
+                findings.extend(
+                    _handler_findings(handler, mod, recorders,
+                                      finally_records=fin))
+    return findings

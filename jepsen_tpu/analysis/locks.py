@@ -1,0 +1,163 @@
+"""jtlint pass ``lock-discipline``: attributes a class declares
+guarded may only be touched under its lock.
+
+The convention (seeded in ``serve/request.py``, ``serve/journal.py``,
+``serve/session.py`` — any class may adopt it):
+
+```python
+class Registry:
+    _GUARDED_BY = {"_lock": ("_by_id", "_done_order")}
+    # or, with the default lock attribute name "_lock":
+    _GUARDED_BY = ("_by_id", "_done_order")
+    # helper methods CALLED with the lock already held:
+    _LOCK_ASSUMED = ("_rebuild",)
+```
+
+Every ``self.<attr>`` load/store of a guarded attribute inside the
+class's methods must sit lexically within ``with self.<lock>:``.
+Exempt: ``__init__`` (construction precedes sharing), methods whose
+name ends in ``_locked`` (the repo's existing called-under-lock
+suffix), and methods listed in ``_LOCK_ASSUMED``.
+
+This is lexical, not interprocedural — a helper that genuinely runs
+under the caller's lock is *declared* so (suffix or ``_LOCK_ASSUMED``)
+rather than inferred, which keeps the contract readable at the class
+head and reviewable when it changes. Accesses through other
+receivers (``req.session.ops``) are out of scope: the discipline is
+self-access; cross-object protocols stay on the owning class.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from jepsen_tpu.analysis.core import Finding, Tree
+
+PASS_ID = "lock-discipline"
+
+_DEFAULT_LOCK = "_lock"
+
+
+def _const_str_seq(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return None
+
+
+def _class_decl(cls: ast.ClassDef) -> Tuple[
+        Dict[str, Tuple[str, ...]], Set[str]]:
+    """(lock attr -> guarded attrs, lock-assumed method names)."""
+    guards: Dict[str, Tuple[str, ...]] = {}
+    assumed: Set[str] = set()
+    for st in cls.body:
+        if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = st.targets if isinstance(st, ast.Assign) \
+            else [st.target]
+        value = st.value
+        if value is None:
+            continue
+        for t in targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id == "_GUARDED_BY":
+                if isinstance(value, ast.Dict):
+                    for k, v in zip(value.keys, value.values):
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(k.value, str):
+                            attrs = _const_str_seq(v)
+                            if attrs:
+                                guards[k.value] = attrs
+                else:
+                    attrs = _const_str_seq(value)
+                    if attrs:
+                        guards[_DEFAULT_LOCK] = attrs
+            elif t.id == "_LOCK_ASSUMED":
+                names = _const_str_seq(value)
+                if names:
+                    assumed.update(names)
+    return guards, assumed
+
+
+def _lock_names_held(with_stmt: ast.With) -> Set[str]:
+    """Lock attribute names this ``with`` acquires via
+    ``with self.<name>:`` (any item)."""
+    out: Set[str] = set()
+    for item in with_stmt.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) \
+                and isinstance(e.value, ast.Name) \
+                and e.value.id == "self":
+            out.add(e.attr)
+    return out
+
+
+def _check_method(cls_name: str, method: ast.FunctionDef,
+                  guards: Dict[str, Tuple[str, ...]],
+                  rel: str) -> List[Finding]:
+    attr_to_lock: Dict[str, str] = {}
+    for lock, attrs in guards.items():
+        for a in attrs:
+            attr_to_lock[a] = lock
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, ast.With):
+            held = held | _lock_names_held(node)
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in attr_to_lock:
+            lock = attr_to_lock[node.attr]
+            if lock not in held:
+                findings.append(Finding(
+                    PASS_ID, rel, node.lineno,
+                    f"{cls_name}.{method.name} touches guarded "
+                    f"attribute 'self.{node.attr}' outside "
+                    f"`with self.{lock}`"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for st in method.body:
+        visit(st, set())
+    # one finding per line/attr
+    seen: Set[Tuple[int, str]] = set()
+    out = []
+    for f in findings:
+        k = (f.line, f.msg)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out
+
+
+def run(tree: Tree) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in tree.modules:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            guards, assumed = _class_decl(node)
+            if not guards:
+                continue
+            for st in node.body:
+                if not isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if st.name == "__init__" \
+                        or st.name.endswith("_locked") \
+                        or st.name in assumed:
+                    continue
+                findings.extend(
+                    _check_method(node.name, st, guards, mod.rel))
+    return findings

@@ -70,6 +70,7 @@ def split(history: Sequence[Op] = (), *,
         (k, val), = items
         try:
             hash(k)
+        # jtlint: ok fallback — not-decomposable probe: None routes the caller, nothing degraded
         except TypeError:
             return None
         groups.setdefault(k, []).append(replace(e, op=e.op.with_(value=val)))
@@ -101,6 +102,7 @@ def split_projections(history: Sequence[Op] = (), *,
         for k, val in items:
             try:
                 hash(k)
+            # jtlint: ok fallback — not-decomposable probe: None routes the caller, nothing degraded
             except TypeError:
                 return None
             groups.setdefault(k, []).append(
@@ -323,6 +325,7 @@ def check_restricted_product(model: models.Model,
     try:
         for k in keys:
             hash(k)
+    # jtlint: ok fallback — not-decomposable probe: None routes the caller, nothing degraded
     except TypeError:
         return None
     walks = {k: _KeyWalk(init.get(k), max_key_configs) for k in keys}
@@ -442,10 +445,14 @@ def _check_groups(model: models.MultiRegister,
                                   max_slots=max_slots, max_dense=max_dense,
                                   devices=devices)
             results.update(zip(ks, rs))
-        except Exception:                               # noqa: BLE001
+        except Exception as batch_exc:                  # noqa: BLE001
             # batch does not fit (common shapes too big) or device failure:
             # per-key auto chain (shared with the facade), each key
             # picking the engine that fits it, honoring the time budget
+            from jepsen_tpu import obs
+            obs.engine_fallback("reach-many",
+                                type(batch_exc).__name__,
+                                keys=len(ks))
             from jepsen_tpu.checkers import facade
             for k, p in zip(ks, packed_list):
                 rem = remaining()

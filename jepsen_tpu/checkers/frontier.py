@@ -63,6 +63,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from jepsen_tpu import history as h
+from jepsen_tpu import obs
 from jepsen_tpu.checkers import events as ev
 from jepsen_tpu.checkers import reach
 from jepsen_tpu.models import Model
@@ -622,6 +623,9 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         try:
             from jepsen_tpu.checkers import reach_q
         except ImportError:                             # degraded install
+            obs.count("engine.skipped.frontier-quotient.unavailable")
+            obs.decision("frontier-quotient", "skipped",
+                         cause="unavailable")
             reach_q = None
         if reach_q is not None:
             try:
@@ -642,7 +646,11 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                 out["product-space"] = q["product-space"]
                 return out
             except reach_q.QuotientOverflow:
-                pass
+                # capacity decline (budgeted product space), not a
+                # death: recorded route, sparse walk below decides
+                obs.decision("frontier-quotient", "route",
+                             cause="quotient-overflow")
+            # jtlint: ok fallback — abort cause carried in the returned verdict
             except reach_q.Aborted:
                 cause = ("timeout" if deadline is not None
                          and _time.monotonic() > deadline else "aborted")
@@ -693,6 +701,7 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
         if dead_ret > 0:
             prev = packed.entries[int(rs.ret_entry[dead_ret - 1])]
             out["previous-ok"] = prev.op.to_dict()
+    # jtlint: ok fallback — witness evidence is best-effort garnish on a decided verdict
     except Exception:                                   # noqa: BLE001
         pass                            # evidence is best-effort garnish
     return out

@@ -779,9 +779,17 @@ class OnlineLinearizable:
             try:
                 return self._flush_incremental()
             except _Overflow as e:
+                # capacity decline, not a death: recorded as a route
+                # decision (the engine-ladder discipline)
+                from jepsen_tpu import obs
+                obs.decision("online-incremental", "route",
+                             cause=f"overflow:{type(e).__name__}")
                 log.info("online check: dense state overflowed (%s); "
                          "falling back to prefix re-checking", e)
             except Exception as e:                      # noqa: BLE001
+                from jepsen_tpu import obs
+                obs.engine_fallback("online-incremental",
+                                    type(e).__name__)
                 log.warning("online incremental engine failed (%s); "
                             "falling back to prefix re-checking", e)
             # permanent fallback: the recheck path below re-verifies
@@ -826,6 +834,7 @@ class OnlineLinearizable:
             if self.on_violation is not None:
                 try:
                     self.on_violation(res)
+                # jtlint: ok fallback — on_violation notify garnish; the violation itself is recorded
                 except Exception:                       # noqa: BLE001
                     pass
         else:
@@ -861,6 +870,7 @@ class OnlineLinearizable:
             if self.on_violation is not None:
                 try:
                     self.on_violation(v)
+                # jtlint: ok fallback — on_violation notify garnish; the violation itself is recorded
                 except Exception:                       # noqa: BLE001
                     pass
         return self.violation
@@ -888,6 +898,11 @@ class OnlineLinearizable:
             try:
                 self.flush()
             except Exception as e:                      # noqa: BLE001
+                # the monitor thread keeps running and retries next
+                # interval, but an unchecked window existed: recorded
+                from jepsen_tpu import obs
+                obs.checker_swallowed("online-flush",
+                                      type(e).__name__)
                 log.warning("online check flush failed: %s", e)
 
     def stop(self) -> Dict[str, Any]:
@@ -903,6 +918,10 @@ class OnlineLinearizable:
         try:
             self.flush()
         except Exception as e:                          # noqa: BLE001
+            # result() below reports only what WAS verified; the
+            # failed final flush is recorded, never silent
+            from jepsen_tpu import obs
+            obs.checker_swallowed("online-flush", type(e).__name__)
             log.warning("online check final flush failed: %s", e)
         return self.result()
 

@@ -674,6 +674,7 @@ def _use_pallas() -> bool:
     try:
         import jax
         return jax.devices()[0].platform in ("tpu", "axon")
+    # jtlint: ok fallback — capability probe: False just routes away from the fast path
     except Exception:                                   # noqa: BLE001
         return False
 
@@ -742,6 +743,7 @@ def _ensure_persistent_caches() -> None:
     try:
         from jepsen_tpu import store
         store.enable_compilation_cache()
+    # jtlint: ok fallback — persistence is best-effort; the check's verdict is unaffected
     except Exception:                                   # noqa: BLE001
         pass                            # persistence must never fail a check
 
@@ -849,6 +851,7 @@ def _cached_memo(model: Model, packed: h.PackedHistory,
         order = sorted(range(len(keys)), key=lambda i: _op_sort_key(keys[i]))
         sig = (model, max_states, tuple(keys[i] for i in order))
         hash(sig)
+    # jtlint: ok fallback — unhashable model: cache bypass, the memo is simply rebuilt
     except TypeError:                   # unhashable model/values: no cache
         return build_memo(model, packed, max_states=max_states)
     with _MEMO_CACHE_LOCK:
@@ -985,6 +988,7 @@ def _disk_memo_get(sig, canonical_ops: Tuple[Op, ...]) -> Optional[Memo]:
         obs.count("memo_cache.disk.invalid")
         try:
             os.unlink(path)             # corrupt/stale entry: drop it
+        # jtlint: ok fallback — absent/unreadable disk entry is a cache miss, counted by the caller
         except OSError:
             pass
         return None
@@ -1027,8 +1031,10 @@ def _disk_memo_put(sig, m: Memo) -> None:
                 try:
                     os.unlink(os.path.join(d, n))
                     obs.count("memo_cache.disk.evict")
+                # jtlint: ok fallback — best-effort cache store/evict; misses are counted on read
                 except OSError:
                     pass
+    # jtlint: ok fallback — best-effort cache store/evict; misses are counted on read
     except Exception:                                   # noqa: BLE001
         pass
 
@@ -1094,6 +1100,7 @@ def _memo_for_ops(model: Model, ops: Tuple[Op, ...],
         m = _project_from_seeds(model, keys, max_states, ops)
         if m is not None:
             return m
+    # jtlint: ok fallback — unhashable values: superset seeding skipped, exact path intact
     except TypeError:
         pass
     return memo_ops(model, ops, max_states=max_states)
@@ -1125,11 +1132,13 @@ def _seed_union_memo(model: Model,
         ops = tuple(union[keys[i]] for i in order)
         m = memo_ops(model, ops,
                      max_states=min(max_states, _SUPERSET_MAX_STATES))
+    # jtlint: ok fallback — decline tracked in _SUPERSET_SEEDS_FAILED; per-key path decides
     except StateExplosion:
         with _MEMO_CACHE_LOCK:
             if len(_SUPERSET_SEEDS_FAILED) < 64:
                 _SUPERSET_SEEDS_FAILED.add(sig)
         return                      # per-key path handles these fine
+    # jtlint: ok fallback — unhashable signature: no seed, per-key path decides
     except TypeError:
         return
     col_of = {k: i for i, k in enumerate(keys[i] for i in order)}
@@ -1238,6 +1247,7 @@ def _attach_witness(out: Dict[str, Any], memo: Memo, rs, P_np, S_pad, M,
         if dead_ret > 0:
             prev = packed.entries[int(rs.ret_entry[dead_ret - 1])]
             out["previous-ok"] = prev.op.to_dict()
+    # jtlint: ok fallback — witness evidence is best-effort garnish on a decided verdict
     except Exception:                                   # noqa: BLE001
         pass                            # evidence is best-effort garnish
 
@@ -1290,6 +1300,7 @@ def _attach_witness_slow(out: Dict[str, Any], memo: Memo,
         if len(rets):
             prev = packed.entries[int(stream.entry[int(rets[-1])])]
             out["previous-ok"] = prev.op.to_dict()
+    # jtlint: ok fallback — witness evidence is best-effort garnish on a decided verdict
     except Exception:                                   # noqa: BLE001
         pass                            # evidence is best-effort garnish
 
@@ -1382,6 +1393,7 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
                     dead, _ = reach_lane.walk_returns(
                         P_np, rs.ret_slot, rs.slot_ops, R0_np,
                         fetch_R=False, should_abort=should_abort)
+            # jtlint: ok fallback — abort verdict returned to the caller, cause inside
             except reach_lane.Aborted:
                 return dict(_ABORTED)
             except Exception as e:                      # noqa: BLE001
@@ -1533,6 +1545,7 @@ def _check_many_keyed(model, rss, preps, live, results, packed_list,
     try:
         P, ret_flat, ops_flat, key_flat, offsets, wide = _keyed_operands(
             model, packed_list, rss, live, W, max_states)
+    # jtlint: ok fallback — batch-capability probe: None routes to per-key, which records
     except (StateExplosion, DenseOverflow):
         return None
     try:
@@ -1642,6 +1655,7 @@ def _union_stage_a(model: Model,
                     union_ops.append(op)
         memo_u = _memo_for_ops(model, tuple(union_ops),
                                max_states=max_states)
+    # jtlint: ok fallback — batch-capability probe: None routes to per-key, which records
     except (StateExplosion, TypeError):
         return None
     S_pad = max(2, _next_pow2(memo_u.n_states))
@@ -2356,6 +2370,7 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
             try:
                 q.put(item, timeout=0.05)
                 return True
+            # jtlint: ok fallback — bounded producer backoff: retried until should_abort fires
             except _queue.Full:
                 continue
         return False
@@ -2396,6 +2411,7 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
                     return
                 queue_hwm[0] = max(queue_hwm[0], q.qsize())
             _put(("done", -1, None))
+        # jtlint: ok fallback — error tuple forwarded to the consumer, which re-raises
         except BaseException as e:                      # noqa: BLE001
             _put(("error", -1, e))
 
@@ -2440,6 +2456,7 @@ def _dispatch_lockstep_stream(sa: "_UnionPrepA", groups,
         try:
             while True:
                 q.get_nowait()
+        # jtlint: ok fallback — shutdown drain of the prep queue
         except _queue.Empty:
             pass
         th.join(timeout=30.0)

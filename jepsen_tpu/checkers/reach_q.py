@@ -680,6 +680,7 @@ def check_quotient(memo: Memo, stream: ev.EventStream,
                         P_np, digit, src, gids, rs, so, cp, ec, er,
                         S_pad, C, L, rn, Fcap, should_abort)
                     return ptr, (m, p), alive
+                # jtlint: ok fallback — re-raised as QuotientOverflow after the sizing ladder
                 except _SqOverflow as e:
                     last = e
             raise QuotientOverflow(str(last or "sparse-live overflow"))
@@ -713,6 +714,7 @@ def check_quotient(memo: Memo, stream: ev.EventStream,
             out["final-configs"] = _decode_sparse(
                 memo, np.asarray(m_prev), np.asarray(p_prev),
                 slot_ops[dead_ret], gids, sizes, digit)
+    # jtlint: ok fallback — witness evidence is best-effort garnish on a decided verdict
     except Exception:                                   # noqa: BLE001
         pass                            # evidence is best-effort garnish
     return out

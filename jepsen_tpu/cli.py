@@ -390,6 +390,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     rp.set_defaults(fn=_cmd_recheck)
 
     args = ap.parse_args(argv)
+    from jepsen_tpu import envcheck
+    envcheck.check_once()           # typo'd opt-outs warn, not no-op
     return args.fn(args)
 
 

@@ -1,0 +1,85 @@
+"""Runtime companion of jtlint's env-gate registry: warn once on set
+``JEPSEN_TPU_*`` environment variables the tree does not read.
+
+Today a typo'd opt-out (``JEPSEN_TPU_NO_WORDWALK=1`` for
+``JEPSEN_TPU_NO_WORD_WALK=1``) silently no-ops — the worst failure
+mode an escape hatch can have. The static analyzer generates the
+authoritative gate registry (``data/env_gates.json``, kept current by
+the CI ``lint`` job); this module compares it against the live
+environment at facade/daemon/CLI entry and, once per process:
+
+- logs one warning naming each unknown gate (with the closest known
+  name when one is near), and
+- bumps ``obs.count("env.unknown_gate")`` per unknown gate, so the
+  condition is visible on ``GET /metrics`` too.
+
+Checking never fails the caller: a missing/corrupt registry (e.g. an
+installed package without the repo ``data/`` tree) disables the check
+rather than breaking real work.
+"""
+from __future__ import annotations
+
+import difflib
+import json
+import logging
+import os
+import threading
+from typing import List, Optional, Set
+
+from jepsen_tpu import obs
+
+log = logging.getLogger("jepsen.envcheck")
+
+_PREFIX = "JEPSEN_TPU_"
+_REGISTRY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, "data", "env_gates.json")
+
+_lock = threading.Lock()
+_checked = False
+
+
+def known_gates(path: Optional[str] = None) -> Optional[Set[str]]:
+    """The registry's gate names, or None when it is unavailable
+    (check disabled, never an error)."""
+    try:
+        with open(path or _REGISTRY, encoding="utf-8") as f:
+            gates = json.load(f).get("gates")
+        if not isinstance(gates, dict) or not gates:
+            return None
+        return set(gates)
+    except (OSError, ValueError):
+        return None
+
+
+def unknown_gates(path: Optional[str] = None) -> List[str]:
+    """Set ``JEPSEN_TPU_*`` env vars absent from the registry (empty
+    when the registry is unavailable)."""
+    known = known_gates(path)
+    if known is None:
+        return []
+    return sorted(k for k in os.environ
+                  if k.startswith(_PREFIX) and k not in known)
+
+
+def check_once(path: Optional[str] = None,
+               force: bool = False) -> List[str]:
+    """Warn-once entry hook (facade / check-serve daemon / CLI): logs
+    and counts each set-but-unknown gate on the first call, a cheap
+    no-op afterwards. Returns the unknown names (tests use this)."""
+    global _checked
+    with _lock:
+        if _checked and not force:
+            return []
+        _checked = True
+    unknown = unknown_gates(path)
+    if not unknown:
+        return []
+    known = known_gates(path) or set()
+    for name in unknown:
+        obs.count("env.unknown_gate")
+        close = difflib.get_close_matches(name, known, n=1)
+        hint = f" (did you mean {close[0]}?)" if close else ""
+        log.warning("unknown JEPSEN_TPU_* gate %s is set and has no "
+                    "effect%s — known gates are registered in "
+                    "data/env_gates.json", name, hint)
+    return unknown

@@ -520,6 +520,7 @@ class Dispatcher:
                     else:
                         res = sess.advance_block(list(r.history),
                                                  seq=r.seq)
+                # jtlint: ok fallback — append/close client race: the member gets a 'closed' verdict
                 except SessionClosed as e:
                     res = {"valid": "unknown", "cause": "closed",
                            "error": str(e)}
@@ -880,5 +881,6 @@ class Dispatcher:
                 json.dump({"ts": time.time(), **self.stats(snap)}, f,
                           default=str)
             os.replace(tmp, os.path.join(d, "stats.json"))
+        # jtlint: ok fallback — per-dispatch stats are advisory, never fatal
         except Exception:                               # noqa: BLE001
             pass                # stats are advisory, never fatal

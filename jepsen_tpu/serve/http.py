@@ -240,6 +240,8 @@ class Daemon:
         """``dispatch=False`` starts only the HTTP side — protocol
         tests exercise admission/backpressure without a device
         engine behind the queue."""
+        from jepsen_tpu import envcheck
+        envcheck.check_once()       # typo'd opt-outs warn, not no-op
         if dispatch:
             self.dispatcher.start()
             self.replay_journal()
@@ -609,6 +611,7 @@ class Daemon:
             wait_s = float((json.loads(body.decode() or "{}")
                             or {}).get("wait-s", 120.0)) \
                 if body else 120.0
+        # jtlint: ok fallback — malformed wait-s defaults; the close itself proceeds
         except Exception:                               # noqa: BLE001
             wait_s = 120.0
         with sess.lock:

@@ -208,6 +208,12 @@ class Registry:
     cancelled / rejected) — the serve-layer analogue of the
     engine-decision ledger, isolated per tenant."""
 
+    # jtlint lock discipline: these attributes are only touched under
+    # self._lock (methods named *_locked are called with it held) —
+    # statically enforced by the `lock-discipline` pass
+    _GUARDED_BY = ("_by_id", "_done_order", "_tenant_ledgers",
+                   "_event_counts", "_device_s")
+
     def __init__(self, keep_done: int = 4096,
                  ledger_depth: int = 512,
                  max_tenants: int = 1024) -> None:
@@ -271,8 +277,12 @@ class Registry:
                 cb(req)
             except Exception as e:                      # noqa: BLE001
                 # the hook is durability bookkeeping; a failure there
-                # must never lose the in-memory terminal transition
+                # must never lose the in-memory terminal transition —
+                # but it IS degraded durability, so it is recorded
                 import logging
+                from jepsen_tpu import obs
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, id=req.id)
                 logging.getLogger("jepsen.serve").warning(
                     "on_terminal hook failed for %s: %s", req.id, e)
         req.done_event.set()

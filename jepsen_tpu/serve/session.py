@@ -275,6 +275,17 @@ class Session:
     dispatcher already serializes same-session dispatch groups; the
     lock additionally covers journal replay and HTTP status reads)."""
 
+    # jtlint lock discipline: session state is only touched under
+    # self.lock; the listed helpers are called with it held (or from
+    # __init__, before the session is shared) — statically enforced
+    # by the `lock-discipline` pass
+    _GUARDED_BY = {"lock": ("ops", "ops_total", "closed", "closing",
+                            "result", "violation", "seq", "appends",
+                            "replayed", "fallbacks")}
+    _LOCK_ASSUMED = ("_route", "_to_host_monitor", "_advance_engine",
+                     "_append_verdict", "_close_incremental",
+                     "_exact_final")
+
     def __init__(self, sid: str, tenant: str, model_name: str,
                  model: Model, opts: Optional[Dict[str, Any]] = None
                  ) -> None:
@@ -526,6 +537,7 @@ class Session:
                          session=self.id, close=True)
             self._to_host_monitor(record_fallback=False)
             return self._host.stop()
+        # jtlint: ok fallback — violation already proven+sticky; the close death is moot
         except Exception as e:                          # noqa: BLE001
             # a death during tail resolution follows the same
             # one-fallback ladder; the host monitor's stop() is exact
@@ -594,6 +606,9 @@ class SessionRegistry:
     FIFO-bounded (their close result stays queryable without letting
     a long-lived daemon leak one session at a time); the open-session
     count is bounded by refusing opens past ``max_open``."""
+
+    # jtlint lock discipline (see Session above)
+    _GUARDED_BY = ("_by_id", "_closed_order")
 
     def __init__(self, max_open: int = 1024,
                  keep_closed: int = 256) -> None:

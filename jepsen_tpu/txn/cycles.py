@@ -50,6 +50,7 @@ def max_dense() -> int:
     try:
         return int(os.environ.get("JEPSEN_TPU_TXN_MAX_DENSE", "") or
                    _MAX_DENSE_DEFAULT)
+    # jtlint: ok fallback — malformed gate value falls back to the default cap
     except ValueError:
         return _MAX_DENSE_DEFAULT
 

@@ -1,0 +1,746 @@
+"""jtlint (jepsen_tpu.analysis) — fixture snippets with one known
+violation per pass must each fire exactly that pass; clean twins must
+not; suppression and the baseline round-trip; and the real tree must
+lint clean against the checked-in baseline (the CI ``lint`` gate, as
+a test).
+
+The donation fixtures include a distilled replica of the PR-10
+word-walk donated-carry reuse (a donated session carry read by the
+host inside the append loop) — the analyzer must flag the bug that
+chaos only caught in ~30% of concurrent runs.
+
+Pure stdlib: no jax import anywhere on this path.
+"""
+import json
+import os
+
+import pytest
+
+from jepsen_tpu.analysis import (Finding, Module, Tree, load_baseline,
+                                 run_lint, run_passes, save_baseline,
+                                 triage)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_REAL_TREE = None
+
+
+def real_tree():
+    """One shared Tree.load of the repo (parsing ~100 files costs a
+    couple of seconds; the real-tree tests share it)."""
+    global _REAL_TREE
+    if _REAL_TREE is None:
+        _REAL_TREE = Tree.load(ROOT)
+    return _REAL_TREE
+
+
+def lint_source(src: str, rel: str = "jepsen_tpu/serve/fixture.py",
+                passes=None, docs=None):
+    """Analyze one in-memory module with every pass (or a subset).
+    The empty root marks the tree synthetic: the env-gate pass skips
+    its checked-in-registry comparison."""
+    tree = Tree("", [Module(rel, src)], docs or {})
+    return tree, run_passes(tree, passes)
+
+
+def pass_ids(findings):
+    return sorted({f.pass_id for f in findings})
+
+
+# -- pass 1: donation-aliasing ------------------------------------------------
+
+# the PR-10 bug class, distilled: the word-walk carry is donated and
+# the host reads the stale buffer inside the append loop before the
+# rebind — corrupting the frontier only under concurrent dispatch
+PR10_DONATION = '''
+import functools
+import jax
+import numpy as np
+
+
+@functools.cache
+def _jitted_word_walk_donated():
+    return jax.jit(_word_walk, donate_argnums=(1,))
+
+
+def session_appends(T, R, blocks, log):
+    step = _jitted_word_walk_donated()
+    for b in blocks:
+        R2, dead = step(T, R, b)
+        log.append(np.asarray(R))       # host read of the DONATED buffer
+        R = R2
+    return R
+'''
+
+# the clean twin: snapshot BEFORE the dispatch, rebind after
+PR10_CLEAN = '''
+import functools
+import jax
+import numpy as np
+
+
+@functools.cache
+def _jitted_word_walk_donated():
+    return jax.jit(_word_walk, donate_argnums=(1,))
+
+
+def session_appends(T, R, blocks, log):
+    step = _jitted_word_walk_donated()
+    for b in blocks:
+        log.append(np.asarray(R))       # snapshot precedes the dispatch
+        R = step(T, R, b)[0]
+    return R
+'''
+
+# gated factory (the reach_lane/_batch_call idiom): donation off by
+# default — an undonated call site may read its operand freely
+GATED_CLEAN = '''
+import jax
+
+
+def _lane_call(geom, donate=False):
+    def run(a, b, P, R0):
+        return R0
+    return jax.jit(run, donate_argnums=(3,)) if donate else jax.jit(run)
+
+
+def walk(a, b, P, R0):
+    run = _lane_call(None)
+    ck = run(a, b, P, R0)
+    return ck, R0.dtype                 # fine: nothing was donated
+'''
+
+GATED_VIOLATION = '''
+import jax
+
+
+def _lane_call(geom, donate=False):
+    def run(a, b, P, R0):
+        return R0
+    return jax.jit(run, donate_argnums=(3,)) if donate else jax.jit(run)
+
+
+def walk(a, b, P, R0):
+    run_d = _lane_call(None, True)
+    ck = run_d(a, b, P, R0)
+    return ck, R0.dtype                 # R0's buffer was donated
+'''
+
+# a rebind INSIDE a conditional branch does not end the hazard: on
+# the branch-not-taken path the later read still sees the donated
+# buffer
+CONDITIONAL_REBIND_VIOLATION = '''
+import jax
+
+
+def _step_factory():
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+def advance(R, ops, cond, log):
+    ck = _step_factory()(R, ops)
+    if cond:
+        R = fresh()
+    log.append(R)
+    return ck
+'''
+
+# an unconditional rebind after the dispatch IS clean
+UNCONDITIONAL_REBIND_CLEAN = '''
+import jax
+
+
+def _step_factory():
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+def advance(R, ops, log):
+    ck = _step_factory()(R, ops)
+    R = fresh()
+    log.append(R)
+    return ck
+'''
+
+# augmented assignment reads the old (donated) buffer before
+# rebinding — the load half of the read-modify-write is the hazard
+AUGASSIGN_VIOLATION = '''
+import jax
+
+
+def _step_factory():
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+def advance(R, ops):
+    ck = _step_factory()(R, ops)
+    R |= 1
+    return ck, R
+'''
+
+# the carried-advance idiom: rebinding at the call is clean even
+# without a loop
+REBIND_CLEAN = '''
+import functools
+import jax
+
+
+@functools.cache
+def _jitted_advance():
+    return jax.jit(_adv, donate_argnums=(0,))
+
+
+def advance(R, ops):
+    R = _jitted_advance()(R, ops)
+    return R.sum()
+'''
+
+
+class TestDonationPass:
+    def test_pr10_replica_fires_exactly_donation(self):
+        _t, fs = lint_source(PR10_DONATION,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert pass_ids(fs) == ["donation"], fs
+        (f,) = fs
+        assert "donated operand 'R'" in f.msg
+        assert f.line == PR10_DONATION.splitlines().index(
+            "        log.append(np.asarray(R))       "
+            "# host read of the DONATED buffer") + 1
+
+    def test_pr10_clean_twin_is_clean(self):
+        _t, fs = lint_source(PR10_CLEAN,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert fs == []
+
+    def test_gated_factory_default_off_is_clean(self):
+        _t, fs = lint_source(GATED_CLEAN,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert fs == []
+
+    def test_gated_factory_positional_true_fires(self):
+        _t, fs = lint_source(GATED_VIOLATION,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert pass_ids(fs) == ["donation"], fs
+
+    def test_rebind_at_call_is_clean(self):
+        _t, fs = lint_source(REBIND_CLEAN,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert fs == []
+
+    def test_conditional_rebind_does_not_end_hazard(self):
+        _t, fs = lint_source(CONDITIONAL_REBIND_VIOLATION,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert pass_ids(fs) == ["donation"], fs
+
+    def test_unconditional_rebind_ends_hazard(self):
+        _t, fs = lint_source(UNCONDITIONAL_REBIND_CLEAN,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert fs == []
+
+    def test_augassign_counts_as_read(self):
+        _t, fs = lint_source(AUGASSIGN_VIOLATION,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert pass_ids(fs) == ["donation"], fs
+
+    def test_decorator_partial_jit_donation_fires(self):
+        src = (
+            "import functools\n"
+            "import jax\n"
+            "import numpy as np\n\n\n"
+            "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+            "def step(R, blk):\n"
+            "    return R\n\n\n"
+            "def advance(R, blk, log):\n"
+            "    R2 = step(R, blk)\n"
+            "    log.append(np.asarray(R))\n"
+            "    return R2\n")
+        _t, fs = lint_source(src,
+                             rel="jepsen_tpu/checkers/fixture.py")
+        assert pass_ids(fs) == ["donation"], fs
+
+
+# -- pass 2: silent-fallback --------------------------------------------------
+
+FALLBACK_VIOLATION = '''
+def lookup(path):
+    try:
+        return open(path).read()
+    except Exception:
+        return None
+'''
+
+FALLBACK_CLEAN = '''
+from jepsen_tpu import obs
+
+
+def lookup(path):
+    try:
+        return open(path).read()
+    except Exception as e:
+        obs.count("engine.fallback.lookup." + type(e).__name__)
+        return None
+'''
+
+FALLBACK_HELPER_CLEAN = '''
+from jepsen_tpu import obs
+
+
+def _fellback(stage, cause):
+    obs.engine_fallback(stage, cause)
+
+
+def lookup(path):
+    try:
+        return open(path).read()
+    except Exception as e:
+        _fellback("lookup", type(e).__name__)
+        return None
+'''
+
+FALLBACK_RERAISE_CLEAN = '''
+def lookup(path):
+    try:
+        return open(path).read()
+    except OSError:
+        raise RuntimeError(path)
+'''
+
+FALLBACK_HTTP_CLEAN = '''
+def handle(body):
+    try:
+        return 200, parse(body)
+    except ValueError as e:
+        return 400, {"error": str(e)}
+'''
+
+FALLBACK_BRANCH_VIOLATION = '''
+from jepsen_tpu import obs
+
+
+def lookup(path, flag):
+    try:
+        return open(path).read()
+    except Exception as e:
+        if flag:
+            obs.count("engine.fallback.lookup.x")
+            return None
+        return None                     # the unrecorded branch
+'''
+
+
+class TestFallbackPass:
+    def test_silent_return_fires_exactly_fallback(self):
+        _t, fs = lint_source(FALLBACK_VIOLATION)
+        assert pass_ids(fs) == ["fallback"], fs
+
+    def test_recorded_handler_is_clean(self):
+        _t, fs = lint_source(FALLBACK_CLEAN)
+        assert fs == []
+
+    def test_recording_helper_is_credited(self):
+        _t, fs = lint_source(FALLBACK_HELPER_CLEAN)
+        assert fs == []
+
+    def test_reraise_is_clean(self):
+        _t, fs = lint_source(FALLBACK_RERAISE_CLEAN)
+        assert fs == []
+
+    def test_http_error_return_is_clean(self):
+        _t, fs = lint_source(FALLBACK_HTTP_CLEAN)
+        assert fs == []
+
+    def test_one_unrecorded_branch_fires(self):
+        _t, fs = lint_source(FALLBACK_BRANCH_VIOLATION)
+        assert pass_ids(fs) == ["fallback"], fs
+
+    def test_out_of_scope_dir_is_not_checked(self):
+        _t, fs = lint_source(FALLBACK_VIOLATION,
+                             rel="jepsen_tpu/suites/fixture.py")
+        assert fs == []
+
+    def test_recording_finally_credits_the_handler(self):
+        src = (
+            "from jepsen_tpu import obs\n\n\n"
+            "def lookup(path):\n"
+            "    try:\n"
+            "        return open(path).read()\n"
+            "    except Exception:\n"
+            "        return None\n"
+            "    finally:\n"
+            "        obs.count('engine.fallback.lookup.done')\n")
+        _t, fs = lint_source(src)
+        assert fs == []
+
+
+# -- pass 3: env-gate registry ------------------------------------------------
+
+GATE_SRC = '''
+import os
+
+FLAG = bool(os.environ.get("JEPSEN_TPU_FIXTURE_GATE"))
+'''
+
+
+class TestEnvGatePass:
+    def _tree(self, docs):
+        t = Tree("", [Module("jepsen_tpu/fixture.py", GATE_SRC)],
+                 docs)
+        return t, run_passes(t, ["env-gate"])
+
+    def test_undocumented_gate_fires(self):
+        _t, fs = self._tree({})
+        msgs = [f.msg for f in fs if f.pass_id == "env-gate"]
+        assert any("JEPSEN_TPU_FIXTURE_GATE has no doc row" in m
+                   for m in msgs), msgs
+
+    def test_documented_gate_needs_no_row(self):
+        docs = {"docs/ENGINE.md":
+                "set `JEPSEN_TPU_FIXTURE_GATE=1` to fixture"}
+        _t, fs = self._tree(docs)
+        assert not any("FIXTURE_GATE has no doc row" in f.msg
+                       for f in fs), fs
+
+    def test_doc_rot_fires(self):
+        docs = {"docs/ENGINE.md":
+                "`JEPSEN_TPU_FIXTURE_GATE` and `JEPSEN_TPU_GONE`"}
+        _t, fs = self._tree(docs)
+        assert any("JEPSEN_TPU_GONE which no code reads" in f.msg
+                   for f in fs), fs
+
+    def test_checked_in_registry_is_current(self):
+        # the acceptance-criteria check: the generated registry
+        # matches the tree (17+ gates) and both doc directions pass
+        from jepsen_tpu.analysis import envgates
+        fs = envgates.run(real_tree())
+        assert fs == [], [f.render() for f in fs]
+        with open(os.path.join(ROOT, "data/env_gates.json")) as f:
+            reg = json.load(f)["gates"]
+        assert len(reg) >= 17
+        for g in ("JEPSEN_TPU_NO_WORD_WALK", "JEPSEN_TPU_NO_QUOTIENT",
+                  "JEPSEN_TPU_CACHE_DIR", "JEPSEN_TPU_NO_OBS"):
+            assert g in reg, g
+            assert reg[g]["docs"], g
+
+
+# -- pass 4: counter/doc drift ------------------------------------------------
+
+_COUNTER_DOC = """
+| name | meaning |
+| --- | --- |
+| `fixture.documented` | a fixture row |
+| `fixture.fallback.<stage>.<cause>` | dynamic fixture row |
+| `fixture.pair.{a,b}` | brace fixture row |
+"""
+
+COUNTER_CLEAN = '''
+from jepsen_tpu import obs
+
+
+def f(stage, cause):
+    obs.count("fixture.documented")
+    obs.count(f"fixture.fallback.{stage}.{cause}")
+    obs.gauge("fixture.pair.a", 1)
+    obs.histogram("fixture.pair.b", 0.5)
+'''
+
+COUNTER_VIOLATION = '''
+from jepsen_tpu import obs
+
+
+def f():
+    obs.count("fixture.undocumented")
+'''
+
+
+class TestCounterDriftPass:
+    def _run(self, src):
+        docs = {"docs/OBSERVABILITY.md": _COUNTER_DOC}
+        t = Tree("", [Module("jepsen_tpu/fixture.py", src)], docs)
+        return run_passes(t, ["counter-drift"])
+
+    def test_documented_names_and_patterns_are_clean(self):
+        assert self._run(COUNTER_CLEAN) == []
+
+    def test_undocumented_counter_fires(self):
+        fs = self._run(COUNTER_VIOLATION)
+        assert any("'fixture.undocumented' has no" in f.msg
+                   for f in fs), fs
+
+    def test_doc_row_without_emitter_fires(self):
+        fs = self._run(COUNTER_VIOLATION)
+        assert any("row 'fixture.documented'" in f.msg
+                   for f in fs), fs
+
+    def test_real_tree_matches_observability_tables(self):
+        from jepsen_tpu.analysis import counters
+        fs = counters.run(real_tree())
+        assert fs == [], [f.render() for f in fs]
+
+
+# -- pass 5: lock discipline --------------------------------------------------
+
+LOCK_VIOLATION = '''
+import threading
+
+
+class Registry:
+    _GUARDED_BY = ("_items",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def size(self):
+        return len(self._items)         # guarded access, no lock
+'''
+
+LOCK_CLEAN = '''
+import threading
+
+
+class Registry:
+    _GUARDED_BY = ("_items",)
+    _LOCK_ASSUMED = ("_census",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def stats(self):
+        with self._lock:
+            return self._census()
+
+    def _census(self):
+        return {"n": len(self._items)}
+
+    def _drop_locked(self):
+        self._items.clear()
+'''
+
+LOCK_NAMED_CLEAN = '''
+import threading
+
+
+class Session:
+    _GUARDED_BY = {"lock": ("ops",)}
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.ops = []
+
+    def extend(self, ops):
+        with self.lock:
+            self.ops.extend(ops)
+'''
+
+
+class TestLockDisciplinePass:
+    def test_unlocked_access_fires_exactly_lock(self):
+        _t, fs = lint_source(LOCK_VIOLATION)
+        assert pass_ids(fs) == ["lock-discipline"], fs
+        assert "'self._items' outside `with self._lock`" in fs[0].msg
+
+    def test_locked_assumed_and_suffix_are_clean(self):
+        _t, fs = lint_source(LOCK_CLEAN)
+        assert fs == []
+
+    def test_named_lock_dict_form(self):
+        _t, fs = lint_source(LOCK_NAMED_CLEAN)
+        assert fs == []
+
+    def test_seeded_classes_declare_guards(self):
+        # the convention is live in the serve layer, not just fixtures
+        for rel, token in (
+                ("jepsen_tpu/serve/request.py", "_GUARDED_BY"),
+                ("jepsen_tpu/serve/journal.py", "_GUARDED_BY"),
+                ("jepsen_tpu/serve/session.py", "_LOCK_ASSUMED")):
+            with open(os.path.join(ROOT, rel)) as f:
+                assert token in f.read(), rel
+
+
+# -- suppression + baseline ---------------------------------------------------
+
+SUPPRESSED_SAME_LINE = FALLBACK_VIOLATION.replace(
+    "    except Exception:",
+    "    except Exception:  # jtlint: ok fallback")
+
+SUPPRESSED_LINE_ABOVE = FALLBACK_VIOLATION.replace(
+    "    except Exception:",
+    "    # jtlint: ok fallback — fixture justification\n"
+    "    except Exception:")
+
+SUPPRESSED_OTHER_PASS = FALLBACK_VIOLATION.replace(
+    "    except Exception:",
+    "    except Exception:  # jtlint: ok donation")
+
+
+class TestSuppressionAndBaseline:
+    def test_inline_suppression_same_line(self):
+        tree, fs = lint_source(SUPPRESSED_SAME_LINE)
+        t = triage(tree, fs, [])
+        assert t["live"] == [] and len(t["inline"]) == 1
+
+    def test_inline_suppression_line_above(self):
+        tree, fs = lint_source(SUPPRESSED_LINE_ABOVE)
+        t = triage(tree, fs, [])
+        assert t["live"] == [] and len(t["inline"]) == 1
+
+    def test_wrong_pass_id_does_not_suppress(self):
+        tree, fs = lint_source(SUPPRESSED_OTHER_PASS)
+        t = triage(tree, fs, [])
+        assert len(t["live"]) == 1
+
+    def test_baseline_round_trip(self, tmp_path):
+        tree, fs = lint_source(FALLBACK_VIOLATION)
+        assert len(fs) == 1
+        bp = str(tmp_path / "baseline.json")
+        save_baseline(bp, fs)
+        # accepted: the same finding triages as baselined, not live
+        t = triage(tree, fs, load_baseline(bp))
+        assert t["live"] == [] and len(t["baselined"]) == 1
+        assert t["stale_baseline"] == []
+        # fixed: the entry goes stale and is surfaced (strict fails)
+        t2 = triage(tree, [], load_baseline(bp))
+        assert len(t2["stale_baseline"]) == 1
+
+    def test_baseline_count_rejects_new_identical_violation(
+            self, tmp_path):
+        # one accepted occurrence must NOT absorb a second identical
+        # handler added later in the same file — the count is the gate
+        tree, fs = lint_source(FALLBACK_VIOLATION)
+        bp = str(tmp_path / "baseline.json")
+        save_baseline(bp, fs)
+        doubled = FALLBACK_VIOLATION + FALLBACK_VIOLATION.replace(
+            "def lookup", "def lookup2")
+        tree2, fs2 = lint_source(doubled)
+        assert len(fs2) == 2
+        t = triage(tree2, fs2, load_baseline(bp))
+        assert len(t["baselined"]) == 1 and len(t["live"]) == 1
+
+    def test_write_baseline_preserves_why_fields(self, tmp_path):
+        tree, fs = lint_source(FALLBACK_VIOLATION)
+        bp = str(tmp_path / "baseline.json")
+        save_baseline(bp, fs)
+        data = json.load(open(bp))
+        data["findings"][0]["why"] = "review justification"
+        with open(bp, "w") as f:
+            json.dump(data, f)
+        save_baseline(bp, fs)               # regenerate
+        data2 = json.load(open(bp))
+        assert data2["findings"][0]["why"] == "review justification"
+
+    def test_pass_subset_does_not_stale_other_entries(self, tmp_path):
+        # `--passes donation` must not call the fallback-pass baseline
+        # entries stale just because that pass never ran
+        tree, fs = lint_source(FALLBACK_VIOLATION)
+        bp = str(tmp_path / "baseline.json")
+        save_baseline(bp, fs)
+        fs_d = run_passes(tree, ["donation"])
+        t = triage(tree, fs_d, load_baseline(bp), ["donation"])
+        assert t["live"] == [] and t["stale_baseline"] == []
+
+    def test_baseline_ignores_line_numbers(self, tmp_path):
+        tree, fs = lint_source(FALLBACK_VIOLATION)
+        bp = str(tmp_path / "baseline.json")
+        save_baseline(bp, fs)
+        shifted = "# a new comment shifts every line\n" \
+            + FALLBACK_VIOLATION
+        tree2, fs2 = lint_source(shifted)
+        t = triage(tree2, fs2, load_baseline(bp))
+        assert t["live"] == []
+
+    def test_unparseable_module_is_a_finding(self):
+        tree = Tree("", [Module("jepsen_tpu/broken.py",
+                                "def f(:\n")], {})
+        fs = run_passes(tree, ["fallback"])
+        assert [f.pass_id for f in fs] == ["parse"]
+
+
+# -- the real tree ------------------------------------------------------------
+
+class TestRealTree:
+    def test_tree_lints_clean_with_checked_in_baseline(self):
+        # the CI `lint` job, as a test: zero live findings, zero
+        # stale baseline entries
+        from jepsen_tpu.analysis.core import (_DEFAULT_BASELINE,
+                                              run_passes)
+        tree = real_tree()
+        findings = run_passes(tree)
+        rep = triage(tree, findings, load_baseline(
+            os.path.join(ROOT, _DEFAULT_BASELINE)))
+        assert rep["live"] == [], [f.render() for f in rep["live"]]
+        assert rep["stale_baseline"] == [], \
+            [f.render() for f in rep["stale_baseline"]]
+
+    def test_donation_factories_are_discovered(self):
+        # the four known donation sites stay visible to the analyzer:
+        # if donate_argnums moves or a new idiom appears, this fails
+        # before the pass silently stops checking anything
+        from jepsen_tpu.analysis import donation
+        facs = donation.collect_factories(real_tree())
+        for name in ("_jitted_advance_frontier", "_lane_call",
+                     "_batch_call", "_inc_call"):
+            assert name in facs, sorted(facs)
+        assert facs["_lane_call"].gate_param == "donate"
+        assert facs["_jitted_advance_frontier"].positions == (5,)
+
+    def test_no_jax_import_on_lint_path(self):
+        # a single-module synthetic run suffices: the point is that
+        # importing and running the analyzer pulls no jax/numpy
+        import subprocess
+        import sys
+        code = (
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from jepsen_tpu.analysis import Module, Tree, run_passes\n"
+            "t = Tree('', [Module('jepsen_tpu/f.py', 'x = 1\\n')], {})\n"
+            "assert run_passes(t) == []\n"
+            "assert 'jax' not in sys.modules\n"
+            "assert 'numpy' not in sys.modules\n" % (ROOT,))
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stderr
+
+
+# -- runtime companion (satellite: unknown-gate warning) ----------------------
+
+class TestEnvcheckRuntime:
+    def test_unknown_gate_warns_and_counts(self, monkeypatch, caplog):
+        from jepsen_tpu import envcheck, obs
+        monkeypatch.setenv("JEPSEN_TPU_NO_WORDWALK", "1")    # typo'd
+        with obs.capture() as cap:
+            import logging
+            with caplog.at_level(logging.WARNING, "jepsen.envcheck"):
+                unknown = envcheck.check_once(force=True)
+        assert unknown == ["JEPSEN_TPU_NO_WORDWALK"]
+        assert cap.counters.get("env.unknown_gate") == 1
+        assert any("JEPSEN_TPU_NO_WORDWALK" in r.message
+                   for r in caplog.records)
+        # the near-miss hint names the real gate
+        assert any("JEPSEN_TPU_NO_WORD_WALK" in r.message
+                   for r in caplog.records)
+
+    def test_known_gates_are_quiet(self, monkeypatch):
+        from jepsen_tpu import envcheck, obs
+        monkeypatch.setenv("JEPSEN_TPU_NO_OBS", "")
+        with obs.capture() as cap:
+            assert envcheck.check_once(force=True) == []
+        assert "env.unknown_gate" not in cap.counters
+
+    def test_warns_once_per_process(self, monkeypatch):
+        from jepsen_tpu import envcheck
+        monkeypatch.setenv("JEPSEN_TPU_TYPO_GATE", "1")
+        assert envcheck.check_once(force=True) \
+            == ["JEPSEN_TPU_TYPO_GATE"]
+        assert envcheck.check_once() == []      # warned already
+
+    def test_missing_registry_disables_check(self, tmp_path,
+                                             monkeypatch):
+        from jepsen_tpu import envcheck
+        monkeypatch.setenv("JEPSEN_TPU_TYPO_GATE", "1")
+        missing = str(tmp_path / "nope.json")
+        assert envcheck.known_gates(missing) is None
+        assert envcheck.check_once(missing, force=True) == []

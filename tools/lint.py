@@ -1,0 +1,33 @@
+"""jtlint CLI — the AST-driven invariant analyzer as a CI gate.
+
+Five passes over the tree (docs/ANALYSIS.md): donation aliasing (the
+PR-10 reuse-after-donation bug class), silent ``except`` fallbacks in
+``checkers/``/``serve/``/``txn/``, the ``JEPSEN_TPU_*`` env-gate
+registry + doc cross-check, obs counter/doc drift, and declared lock
+discipline (``_GUARDED_BY``).
+
+Pure stdlib ``ast`` — no jax import, so the CI ``lint`` job needs no
+accelerator stack and finishes in seconds. Same budget-file-plus-guard
+shape as ``tools/transfer_guard.py``: accepted pre-existing findings
+live in the checked-in ``data/lint_baseline.json`` (adds show up in
+review), one-off sites carry inline ``# jtlint: ok <pass>``.
+
+Usage:
+    python tools/lint.py --strict                 # the CI gate
+    python tools/lint.py --passes donation        # one pass
+    python tools/lint.py --emit-env-registry      # refresh data/env_gates.json
+    python tools/lint.py --write-baseline         # accept current findings
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+
+from jepsen_tpu.analysis.core import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
