@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -391,7 +392,8 @@ def serve_probe(quick: bool = True) -> dict:
     keep = ("warmup", "target_rate", "duration_s", "submitted",
             "completed", "rejected_429", "timeouts",
             "verdict_mismatches", "sustained_req_s", "p50_s",
-            "p99_s", "windows", "stage_split", "latency_crosscheck",
+            "p99_s", "p50_admit_s", "p99_admit_s", "windows",
+            "stage_split", "latency_crosscheck",
             "fallbacks", "drained", "error")
     out = {k: report[k] for k in keep if k in report}
     stats = report.get("stats", {})
@@ -522,6 +524,12 @@ def txn_probe(n_txns: int, seed: int) -> dict:
                                  key_rotate=32, seed=seed)
     h = h + [op.with_(index=-1) for op in
              fixtures.txn_anomaly_block("G-single")]
+    # index ONCE at composition (the anomaly block rides in with
+    # index=-1): production histories arrive indexed — re-indexing
+    # 2*n ops inside every timed check_history call was measuring
+    # history construction, not checking
+    from jepsen_tpu import history as h_mod
+    h = h_mod.index(h)
     gen_s = time.monotonic() - t0
     t0 = time.monotonic()
     txns, fails = txn_ops.collect(h)
@@ -536,7 +544,21 @@ def txn_probe(n_txns: int, seed: int) -> dict:
             times.append(time.monotonic() - t1)
         return res, min(times)
 
-    dev, dev_s = best_of(lambda: txn.check_history(h))
+    from jepsen_tpu.txn import cycles as txn_cycles
+
+    # the dev arm measures the SHIPPING DEFAULT body (word unless the
+    # opt-out is set): bypass the autotune table so a recorded "f32"
+    # winner can't silently swap the body under the "word" label below
+    os.environ["JEPSEN_TPU_NO_AUTOTUNE"] = "1"
+    try:
+        dev, dev_s = best_of(lambda: txn.check_history(h))
+        os.environ["JEPSEN_TPU_NO_WORD_CLOSURE"] = "1"
+        try:
+            f32, f32_s = best_of(lambda: txn.check_history(h))
+        finally:
+            os.environ.pop("JEPSEN_TPU_NO_WORD_CLOSURE", None)
+    finally:
+        os.environ.pop("JEPSEN_TPU_NO_AUTOTUNE", None)
     host, host_s = best_of(
         lambda: txn.check_history(h, force_host=True))
     out = {
@@ -546,19 +568,144 @@ def txn_probe(n_txns: int, seed: int) -> dict:
         "device": {"check_s": round(dev_s, 3),
                    "txns_s": round(graph.n / max(dev_s, 1e-9)),
                    "engine": dev.get("engine"),
+                   "body": ("word" if txn_cycles.word_closure_enabled()
+                            else "f32"),
                    "core_txns": dev.get("core-txns"),
                    "anomalies": dev.get("anomalies")},
+        "device_f32": {"check_s": round(f32_s, 3),
+                       "txns_s": round(graph.n / max(f32_s, 1e-9)),
+                       "anomalies": f32.get("anomalies")},
         "host": {"check_s": round(host_s, 3),
                  "txns_s": round(graph.n / max(host_s, 1e-9)),
                  "engine": host.get("engine"),
                  "anomalies": host.get("anomalies")},
         "speedup_vs_host": round(host_s / max(dev_s, 1e-9), 2),
+        # the closure KERNEL in isolation: the e2e rung above trims
+        # to a tiny core (inference dominates), so the body win is
+        # measured on a closure-bound synthetic cyclic graph too,
+        # and the winner lands in the autotune table warm processes
+        # consult
+        "closure_kernel": _closure_kernel_probe(),
     }
     if dev.get("anomalies") != host.get("anomalies") \
+            or dev.get("anomalies") != f32.get("anomalies") \
             or "G-single" not in (dev.get("anomalies") or ()):
         out["error"] = (f"classification drift: device "
-                        f"{dev.get('anomalies')} vs host "
+                        f"{dev.get('anomalies')} vs f32 "
+                        f"{f32.get('anomalies')} vs host "
                         f"{host.get('anomalies')}")
+    return out
+
+
+def _closure_kernel_probe(n: int = 1024, repeat: int = 3) -> dict:
+    """Word-packed vs f32 closure bodies on a closure-BOUND graph
+    (random cyclic, no trimmable fringe at this density): the kernel
+    comparison the 100k rung's tiny trimmed core can't show. Records
+    the winner in the autotune table (tools/closure_sweep.py is the
+    full sweep; this keeps BENCH honest about the body in one run)."""
+    import numpy as np
+
+    from jepsen_tpu.checkers import autotune
+    from jepsen_tpu.txn import cycles
+    from jepsen_tpu.txn.infer import DepGraph
+
+    r = np.random.default_rng(42)
+    e = n * 2
+    src = r.integers(0, n, e).astype(np.int32)
+    dst = r.integers(0, n, e).astype(np.int32)
+    keep = src != dst
+    g = DepGraph(n=n, src=src[keep], dst=dst[keep],
+                 et=r.integers(0, 3, int(keep.sum())).astype(np.int8),
+                 txns=tuple(range(n)))
+
+    def _t(no_word: bool) -> float:
+        env = "JEPSEN_TPU_NO_WORD_CLOSURE"
+        at = "JEPSEN_TPU_NO_AUTOTUNE"
+        old = os.environ.pop(env, None)
+        old_at = os.environ.pop(at, None)
+        try:
+            # a recorded winner must not steer the arm being measured
+            os.environ[at] = "1"
+            if no_word:
+                os.environ[env] = "1"
+            cycles.closure_booleans(g)          # warm
+            best = float("inf")
+            for _ in range(repeat):
+                t0 = time.monotonic()
+                cycles.closure_booleans(g)
+                best = min(best, time.monotonic() - t0)
+            return best
+        finally:
+            os.environ.pop(env, None)
+            os.environ.pop(at, None)
+            if old is not None:
+                os.environ[env] = old
+            if old_at is not None:
+                os.environ[at] = old_at
+
+    w, f = _t(False), _t(True)
+    winner = "word" if w <= f else "f32"
+    autotune.record("closure", autotune.closure_key(n), winner,
+                    metric=1.0 / max(min(w, f), 1e-9))
+    return {"Np": n, "word_s": round(w, 4), "f32_s": round(f, 4),
+            "winner": winner,
+            "speedup": round(f / max(w, 1e-9), 2)}
+
+
+def walk_bodies_probe(model, packed, n_ops: int,
+                      repeat: int = 2) -> dict:
+    """The post-hoc kernel-body comparison on the headline history:
+    ``reach.check_packed`` with the word-packed body FORCED vs the
+    dense/pallas chain, verdicts asserted equal, winner recorded in
+    the autotune table (``walk`` kind) that route selection consults
+    on the next process. The 33x XLA:CPU step-cost folklore becomes a
+    measured, persisted number."""
+    from jepsen_tpu.checkers import autotune, events as ev, reach
+
+    memo, stream, _T, S_pad, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    W = max(stream.W, 1)
+    rs = ev.returns_view(stream)
+
+    def _t(body: str):
+        env = ("JEPSEN_TPU_WORD_POSTHOC" if body == "word"
+               else "JEPSEN_TPU_NO_WORD_WALK")
+        old = os.environ.pop(env, None)
+        os.environ[env] = "1"
+        try:
+            res = reach.check_packed(model, packed)     # warm
+            best = float("inf")
+            for _ in range(max(1, repeat)):
+                t0 = time.monotonic()
+                res = reach.check_packed(model, packed)
+                best = min(best, time.monotonic() - t0)
+            return res, best
+        finally:
+            os.environ.pop(env, None)
+            if old is not None:
+                os.environ[env] = old
+
+    res_w, t_w = _t("word")
+    res_d, t_d = _t("dense")
+    out = {"geometry": {"S": memo.n_states, "W": W, "M": M,
+                        "returns": int(rs.n_returns)},
+           "word": {"check_s": round(t_w, 3),
+                    "ops_s": round(n_ops / max(t_w, 1e-9)),
+                    "engine": res_w.get("engine")},
+           "dense": {"check_s": round(t_d, 3),
+                     "ops_s": round(n_ops / max(t_d, 1e-9)),
+                     "engine": res_d.get("engine")},
+           "speedup_word_vs_dense": round(t_d / max(t_w, 1e-9), 2)}
+    if res_w.get("valid") != res_d.get("valid"):
+        out["error"] = (f"verdict drift: word {res_w.get('valid')} "
+                        f"vs dense {res_d.get('valid')}")
+        return out
+    winner = "word" if t_w <= t_d else "dense"
+    out["winner"] = winner
+    out["recorded"] = autotune.record(
+        "walk", autotune.walk_key(memo.n_states, W, M, rs.n_returns),
+        winner, metric=n_ops / max(min(t_w, t_d), 1e-9))
     return out
 
 
@@ -827,6 +974,16 @@ def main() -> int:
             out["chunklock"] = chunklock_probe(model, packed)
         except Exception as e:                          # noqa: BLE001
             out["chunklock"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            # post-hoc kernel BODIES on this rung's history: the
+            # word-packed walk vs the dense/pallas chain, winner
+            # persisted in the autotune table (warm processes then
+            # route check_packed through the recorded winner)
+            out["walk_bodies"] = walk_bodies_probe(model, packed,
+                                                   args.ops)
+        except Exception as e:                          # noqa: BLE001
+            out["walk_bodies"] = {"error":
+                                  f"{type(e).__name__}: {e}"}
         if not args.no_batch and args.ops <= 200_000:
             try:
                 out["batch"] = batch_probe(model, args.ops, args.seed,

@@ -1,0 +1,194 @@
+"""Persisted autotune table: measured kernel-body winners, on disk.
+
+The engine family keeps re-deriving folklore — "the word-packed walk
+beats the dense einsum step ~33x on XLA:CPU", "H=32 beats H=64",
+"the packed closure routs f32 past Np=512" — because every process
+starts from heuristics. This module persists measured winners under
+``<store-root>/.cache/autotune.json`` keyed by **(kind, backend,
+geometry bucket)** so route selection (``reach.check_packed``, the
+lockstep dispatch seams, ``txn.cycles``, the facade's group width)
+consults recorded winners BEFORE falling back to heuristics.
+
+Writers are the sweep tools — ``tools/ablate_lane.py --bodies``,
+``tools/batch_width.py --record``, ``tools/closure_sweep.py`` — and
+``bench.py`` rungs that measure both bodies anyway. Records are
+atomic (tmp + ``os.replace``), best-effort (a read-only disk never
+fails a check), and versioned.
+
+Staleness (the ``transfer_guard`` discipline applied to folklore): an
+entry records the jax version and backend it was measured under; a
+lookup from a different jax version or schema version is counted
+``autotune.stale`` and ignored — a winner measured on last year's XLA
+must not silently steer this year's. Hits/misses are
+``autotune.{hit,miss}``; records are ``autotune.record``.
+
+``JEPSEN_TPU_NO_AUTOTUNE=1`` disables both lookup and record
+(heuristics only — the pre-table behavior).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from jepsen_tpu import obs
+
+_VERSION = 1
+
+# in-process cache of the loaded table, invalidated by file mtime so a
+# sweep in another process is picked up without a restart
+_CACHE: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """Consulted per call (tests toggle the gate at runtime)."""
+    return not os.environ.get("JEPSEN_TPU_NO_AUTOTUNE")
+
+
+def table_path() -> Optional[str]:
+    """``<persist-root>/autotune.json`` (the persist root already
+    resolves ``<store-root>/.cache`` / ``JEPSEN_TPU_CACHE_DIR`` /
+    ``JEPSEN_TPU_NO_PERSIST``), or None when persistence is off."""
+    from jepsen_tpu import store
+    root = store.persist_root()
+    if root is None:
+        return None
+    return os.path.join(root, "autotune.json")
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+        return str(jax.__version__)
+    # jtlint: ok fallback — no jax on the lint/tools path: entries key on "none"
+    except Exception:                                   # noqa: BLE001
+        return "none"
+
+
+def backend() -> str:
+    """The platform winners are keyed under. Never initializes jax
+    backends itself on failure — "cpu" is the honest unknown."""
+    try:
+        import jax
+        return str(jax.default_backend())
+    # jtlint: ok fallback — backend probe: "cpu" keys the lookup, checking unaffected
+    except Exception:                                   # noqa: BLE001
+        return "cpu"
+
+
+def _bucket_pow2(x: int) -> int:
+    return 1 << max(0, (max(int(x), 1) - 1).bit_length())
+
+
+def walk_key(S: int, W: int, M: int, returns: int) -> str:
+    """Geometry bucket of the post-hoc returns walk: exact (S, W, M)
+    — they select compiled programs — and the return count bucketed
+    to powers of two (winners are stable across nearby lengths)."""
+    return f"S{_bucket_pow2(S)}-W{int(W)}-M{int(M)}" \
+           f"-R{_bucket_pow2(returns)}"
+
+
+def lockstep_key(S: int, W: int, M: int, H: int) -> str:
+    """Geometry bucket of one lockstep dispatch group."""
+    return f"S{_bucket_pow2(S)}-W{int(W)}-M{int(M)}-H{_bucket_pow2(H)}"
+
+
+def closure_key(n: int) -> str:
+    """Geometry bucket of the txn closure: padded node count."""
+    return f"Np{_bucket_pow2(n)}"
+
+
+def _load() -> Dict[str, Any]:
+    path = table_path()
+    if path is None:
+        return {}
+    try:
+        mtime = os.path.getmtime(path)
+    # jtlint: ok fallback — no table on disk is the ordinary first-run miss (winner() counts it)
+    except OSError:
+        return {}
+    with _LOCK:
+        if _CACHE.get("path") == path and _CACHE.get("mtime") == mtime:
+            return _CACHE["data"]
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            raise ValueError("autotune table is not a map")
+    # jtlint: ok fallback — corrupt table counts stale below and reads as empty
+    except Exception:                                   # noqa: BLE001
+        obs.count("autotune.stale")
+        return {}
+    with _LOCK:
+        _CACHE.update({"path": path, "mtime": mtime, "data": data})
+    return data
+
+
+def winner(kind: str, geom_key: str, *,
+           backend_name: Optional[str] = None) -> Optional[str]:
+    """The recorded winning body for ``(kind, backend, geom_key)``,
+    or None (miss / stale / disabled). ``kind`` is one of ``walk``,
+    ``lockstep``, ``closure``, ``group``."""
+    if not enabled():
+        return None
+    data = _load()
+    if not data:
+        obs.count("autotune.miss")
+        return None
+    if int(data.get("version", -1)) != _VERSION:
+        obs.count("autotune.stale")
+        return None
+    be = backend_name if backend_name is not None else backend()
+    entry = (data.get("entries") or {}).get(f"{kind}|{be}|{geom_key}")
+    if entry is None:
+        obs.count("autotune.miss")
+        return None
+    if entry.get("jax") != _jax_version():
+        # measured under a different XLA: folklore, not a winner
+        obs.count("autotune.stale")
+        return None
+    obs.count("autotune.hit")
+    return str(entry.get("body")) if entry.get("body") else None
+
+
+def record(kind: str, geom_key: str, body: str, *,
+           metric: Optional[float] = None,
+           detail: Optional[Dict[str, Any]] = None,
+           backend_name: Optional[str] = None) -> Optional[str]:
+    """Persist a measured winner (atomic read-modify-write). Returns
+    the table path, or None when persistence/autotune is off. Callers
+    pass the measured figure of merit in ``metric`` (higher = better;
+    informational — the body string is what selection consumes)."""
+    if not enabled():
+        return None
+    path = table_path()
+    if path is None:
+        return None
+    try:
+        data = _load()
+        if int(data.get("version", -1)) != _VERSION:
+            data = {"version": _VERSION, "entries": {}}
+        entries = data.setdefault("entries", {})
+        be = backend_name if backend_name is not None else backend()
+        entry: Dict[str, Any] = {"body": body, "jax": _jax_version()}
+        if metric is not None:
+            entry["metric"] = round(float(metric), 6)
+        if detail:
+            entry["detail"] = detail
+        entries[f"{kind}|{be}|{geom_key}"] = entry
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        with _LOCK:
+            _CACHE.pop("mtime", None)   # force re-read (mtime changed)
+        obs.count("autotune.record")
+        return path
+    except OSError:
+        # read-only/full disk: recording folklore must never fail the
+        # measurement that produced it
+        obs.count("autotune.record_failed")
+        return None

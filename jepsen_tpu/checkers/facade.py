@@ -383,15 +383,23 @@ def auto_check_many_packed(model: Model, packed_list,
     route cannot hold every history (dense/union overflow, or a
     too-concurrent key). Mirrors how :func:`auto_check_packed` is the
     one-history chain; results align with ``packed_list``."""
-    from jepsen_tpu.checkers import reach, transfer
+    from jepsen_tpu.checkers import autotune, reach, transfer
     from jepsen_tpu.checkers.events import ConcurrencyOverflow
     from jepsen_tpu.models.memo import StateExplosion
 
     transfer.record_mode()
+    ekw = _engine_kw(kw, _REACH_MANY_KW)
+    if "group" not in ekw:
+        # recorded winners before heuristics: a lockstep group width
+        # measured by tools/batch_width.py --record outranks the
+        # built-in _BATCH_GROUP default (H=32-beats-H=64 folklore,
+        # persisted instead of re-derived)
+        g = autotune.winner("group", "default")
+        if g and str(g).isdigit():
+            ekw["group"] = int(g)
     try:
         with obs.span("facade.check-many", histories=len(packed_list)):
-            out = reach.check_many(model, packed_list,
-                                   **_engine_kw(kw, _REACH_MANY_KW))
+            out = reach.check_many(model, packed_list, **ekw)
         obs.engine_selected("reach-many", histories=len(packed_list),
                             engines=sorted({r.get("engine", "?")
                                             for r in out}))

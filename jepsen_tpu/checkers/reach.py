@@ -474,21 +474,20 @@ class FrontierCarry:
         import jax
         import jax.numpy as jnp
 
+        from jepsen_tpu.checkers import reach_word
+
         self.W, self.M = int(W), int(M)
         self.S = int(R0_host.shape[0])
         self.advanced_returns = 0
-        if self.M <= 32:
-            self._word_dt = np.uint32
-        elif self.M <= 64:
-            # uint64 words need x64 mode — jax silently downcasts
-            # 64-bit arrays to 32 otherwise, which would truncate the
-            # mask axis
-            self._word_dt = (np.uint64 if jax.config.jax_enable_x64
-                             else None)
-        else:
-            self._word_dt = None
-        self.words = (self._word_dt is not None
-                      and table is not None
+        # one uint32 word per state for M <= 32; uint32 word VECTORS
+        # (reach_word, ceil(M/32) words) beyond — so W > 5 sessions
+        # run word-packed WITHOUT x64 mode (the former uint64 body,
+        # which jax silently downcasts outside x64, is retired)
+        self._nw = 1 if self.M <= 32 else reach_word.n_words(self.M)
+        S_t = int(table.shape[0]) if table is not None else self.S
+        multi_ok = (self.M <= 32
+                    or reach_word.admits(S_t, self.W, self.M))
+        self.words = (table is not None and multi_ok
                       and not os.environ.get(
                           "JEPSEN_TPU_NO_WORD_WALK"))
         if self.words:
@@ -496,7 +495,6 @@ class FrontierCarry:
             # sentinel column for pad slots) is the only operand —
             # the O(O*S^2) dense P tensor is never materialized on
             # this path (callers pass it lazily via p_build)
-            S_t = table.shape[0]
             Tpad = np.concatenate(
                 [table, -np.ones((S_t, 1), table.dtype)],
                 axis=1).astype(np.int32)
@@ -504,10 +502,14 @@ class FrontierCarry:
             # array is rebuilt per carry seed, so the identity-keyed
             # cache could never hit — it would only pin dead copies
             self._T = jax.device_put(Tpad)
-            # the [S, M] bool seed packs to S words — fewer wire
-            # bytes than even the bit-packed dense seed
-            words = _pack_frontier_words(R0_host[:S_t], self.M,
-                                         self._word_dt)
+            # the [S, M] bool seed packs to S word vectors — fewer
+            # wire bytes than even the bit-packed dense seed
+            if self._nw == 1:
+                words = _pack_frontier_words(R0_host[:S_t], self.M,
+                                             np.uint32)
+            else:
+                words = reach_word.pack_words(
+                    np.ascontiguousarray(R0_host[:S_t], bool))
             transfer.count_put(int(words.nbytes),
                                int(R0_host.size * 4))
             self._R = jax.device_put(words)
@@ -559,7 +561,7 @@ class FrontierCarry:
         nb = int(so.nbytes + rs.nbytes)
         transfer.count_put(nb, int((rs.size + so.size) * 4))
         if self.words:
-            R, any_dead, first = _jitted_word_walk()(
+            R, any_dead, first = self._word_fn()(
                 self._T, self._R, jnp.asarray(rs), jnp.asarray(so))
             self._R = R
             if not bool(any_dead):
@@ -607,7 +609,7 @@ class FrontierCarry:
             return -1
         rs, so = self._pad_block(ret_slot, slot_ops)
         if self.words:
-            _R, any_dead, first = _jitted_word_walk()(
+            _R, any_dead, first = self._word_fn()(
                 self._T, self._R, jnp.asarray(rs), jnp.asarray(so))
             if not bool(any_dead):
                 return -1
@@ -619,12 +621,26 @@ class FrontierCarry:
             return -1
         return self._refine(rs, so, int(ptr), R_block, n)
 
+    def _word_fn(self):
+        """The jitted word-walk body: the single-word kernel for
+        M <= 32 (the battle-tested PR-10 program), the multi-word
+        ``reach_word`` kernel beyond — same (T, R, rs, so) ->
+        (R, any_dead, first) contract, neither donated."""
+        if self._nw == 1:
+            return _jitted_word_walk()
+        from jepsen_tpu.checkers import reach_word
+        return reach_word._jitted_walk_words()
+
     def fetch(self) -> np.ndarray:
         """The carried set back on host as bool [S, M] (geometry
         re-encode before a memo rebuild / slot growth; counted as an
         eager fetch)."""
         obs.count("fetch.eager")
         if self.words:
+            if self._nw > 1:
+                from jepsen_tpu.checkers import reach_word
+                return reach_word.unpack_words(np.asarray(self._R),
+                                               self.M)
             return _unpack_frontier_words(np.asarray(self._R), self.M)
         return np.asarray(self._R).astype(bool)
 
@@ -1330,6 +1346,24 @@ _ABORT_SEG = 32768
 _ABORTED = {"valid": "unknown", "cause": "aborted", "engine": "reach"}
 
 
+def _posthoc_body(S: int, W: int, M: int, n_returns: int) -> str:
+    """Kernel-body selection for the single-history post-hoc walk:
+    the persisted autotune table first (a ``walk`` winner recorded by
+    ``tools/ablate_lane.py --bodies`` / ``bench.py``), then the
+    ``JEPSEN_TPU_WORD_POSTHOC=1`` force, else the dense/pallas chain
+    as before. Returns ``"word"`` or ``"dense"``; ``"word"`` is only
+    answered where the word body admits the geometry."""
+    from jepsen_tpu.checkers import reach_word
+    if not (reach_word.enabled() and reach_word.admits(S, W, M)):
+        return "dense"
+    if os.environ.get("JEPSEN_TPU_WORD_POSTHOC"):
+        return "word"
+    from jepsen_tpu.checkers import autotune
+    w = autotune.winner("walk",
+                        autotune.walk_key(S, W, M, n_returns))
+    return w if w in ("word", "dense") else "dense"
+
+
 def check_packed(model: Model, packed: h.PackedHistory, *,
                  max_states: int = 100_000, max_slots: int = 20,
                  max_dense: int = 1 << 22,
@@ -1349,6 +1383,35 @@ def check_packed(model: Model, packed: h.PackedHistory, *,
     W = max(stream.W, 1)
     if _fast_ok(S_pad, W, M, memo.n_ops):
         rs = ev.returns_view(stream)
+        if (should_abort is None
+                and _posthoc_body(memo.n_states, W, M,
+                                  rs.n_returns) == "word"):
+            # word-packed kernel body (reach_word): the mask axis as
+            # uint32 word vectors per state, selected by a recorded
+            # autotune winner (or forced) BEFORE the pallas/dense
+            # chain; exact per-step death, one fallback on failure
+            from jepsen_tpu.checkers import reach_word
+            try:
+                with obs.span("reach.walk", engine="reach-word",
+                              returns=int(rs.n_returns)):
+                    dead, _ = reach_word.walk_returns_words(
+                        memo.table, rs.ret_slot[:rs.n_returns],
+                        rs.slot_ops[:rs.n_returns], M)
+                elapsed = _time.monotonic() - t0
+                if dead < 0:
+                    return _result_valid("reach-word", stream, memo,
+                                         elapsed)
+                out = _result_invalid(
+                    "reach-word", stream, memo, packed,
+                    int(rs.ret_event[dead]), elapsed)
+                _attach_witness(out, memo, rs, _build_P(memo, S_pad),
+                                S_pad, M, W, int(dead), packed)
+                return out
+            except Exception as e:                      # noqa: BLE001
+                # exactly one record; the pallas/dense chain below is
+                # the recorded fallback body
+                obs.engine_fallback("word-walk", type(e).__name__,
+                                    returns=int(rs.n_returns))
         P_np = _build_P(memo, S_pad)
         if (_use_pallas() and _pallas_fits(S_pad, M, memo.n_ops)
                 and should_abort is None):

@@ -643,9 +643,13 @@ class BatchInflight:
     the NEXT group's walk (and pay its marshalling/compile host time)
     before fetching the previous group's verdicts, overlapping host
     work with device walks across bucket groups. ``device`` (when set)
-    is the mesh device this group's lane block walks on."""
+    is the mesh device this group's lane block walks on. ``body``
+    records the kernel body this group walked (``dense`` = the Pallas
+    batch kernel, ``word`` = the vmapped word-packed scan); a word
+    walk carries its queued device results in ``word_out``."""
     __slots__ = ("P", "geom", "host_args", "R_lens", "dsegs",
-                 "ckpts", "final", "interpret", "device", "degraded")
+                 "ckpts", "final", "interpret", "device", "degraded",
+                 "body", "word_out")
 
     def __init__(self, P, geom, host_args, R_lens, dsegs, ckpts,
                  final, interpret, device=None):
@@ -661,6 +665,8 @@ class BatchInflight:
         # set by collect_returns_batch when a lazy-fetch fallback
         # degraded this walk's collect to eager full-array fetches
         self.degraded = False
+        self.body = "dense"
+        self.word_out = None
 
 
 class BatchPrepared:
@@ -671,18 +677,21 @@ class BatchPrepared:
     dispatching thread. The prepare/dispatch split is what lets the
     streaming pipeline pack group g+1 while group g walks on device.
     A mesh scheduler sets ``device`` before dispatching to pin this
-    group's lane block to one chip (None = jax's default device)."""
+    group's lane block to one chip (None = jax's default device).
+    ``body`` (None = resolve at dispatch: autotune winner, force
+    gate, else dense) selects the kernel body this group walks."""
     __slots__ = ("P", "geom", "host_args", "R_lens", "interpret",
-                 "device")
+                 "device", "body")
 
     def __init__(self, P, geom, host_args, R_lens, interpret,
-                 device=None):
+                 device=None, body=None):
         self.P = P
         self.geom = geom
         self.host_args = host_args
         self.R_lens = R_lens
         self.interpret = interpret
         self.device = device
+        self.body = body
 
 
 def prepare_returns_batch(P: np.ndarray, ret_slots: List[np.ndarray],
@@ -713,10 +722,82 @@ def _pipe_walk_on(device, host_args, geom, n_pass: int, interpret: bool,
                             device=device)
 
 
+def _lockstep_body(geom) -> str:
+    """Kernel-body selection for one lockstep dispatch group: the
+    persisted autotune table first (a ``lockstep`` winner recorded by
+    ``tools/batch_width.py --bodies``), then the
+    ``JEPSEN_TPU_WORD_POSTHOC=1`` force, else the Pallas batch kernel
+    (``dense``). ``word`` only where the word body admits."""
+    from jepsen_tpu.checkers import autotune, reach_word
+
+    _B, W, M, S, H, _O1, _R_pad = geom
+    if not (reach_word.enabled() and reach_word.admits(S, W, M)):
+        return "dense"
+    if os.environ.get("JEPSEN_TPU_WORD_POSTHOC"):
+        return "word"
+    w = autotune.winner("lockstep", autotune.lockstep_key(S, W, M, H))
+    return w if w in ("word", "dense") else "dense"
+
+
+def _dispatch_words(prep: BatchPrepared) -> BatchInflight:
+    """Queue the word-packed lockstep walk (the ``reach_word`` body):
+    one shared transition table derived from P, per-lane word-vector
+    frontiers, the whole group as ONE vmapped scan — nothing fetched
+    (the queued device results ride ``word_out`` into the collect)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers import reach_word
+
+    _B, W, M, S, H, _O1, R_pad = prep.geom
+    ops_flat, rs_rh, P, _R0 = prep.host_args
+    Tpad = reach_word.pad_table(reach_word.table_from_P(P))
+    NW = reach_word.n_words(M)
+    R0w = np.zeros((H, S, NW), np.uint32)
+    R0w[:, 0, 0] = 1                     # mask 0, state 0 per lane
+    rs_hr = np.ascontiguousarray(rs_rh.T.astype(np.int32))
+    so_hrw = np.ascontiguousarray(np.swapaxes(
+        np.asarray(ops_flat).reshape(R_pad, H, W), 0, 1)
+        .astype(np.int32))
+    transfer.count_put(
+        int(Tpad.nbytes + R0w.nbytes + rs_hr.nbytes + so_hrw.nbytes),
+        int(Tpad.nbytes + H * S * M * 4
+            + (rs_hr.size + so_hrw.size) * 4))
+
+    def _go():
+        return reach_word._jitted_walk_words_batch()(
+            jnp.asarray(Tpad), jnp.asarray(R0w), jnp.asarray(rs_hr),
+            jnp.asarray(so_hrw))
+
+    if prep.device is not None:
+        with jax.default_device(prep.device):
+            out = _go()
+    else:
+        out = _go()
+    obs.count("lockstep.word_groups")
+    fl = BatchInflight(prep.P, prep.geom, prep.host_args, prep.R_lens,
+                       {}, [], None, prep.interpret,
+                       device=prep.device)
+    fl.body = "word"
+    fl.word_out = out
+    return fl
+
+
 def dispatch_prepared(prep: BatchPrepared) -> BatchInflight:
     """Queue a prepared group's walk (device puts + compiles +
     dispatches — all jax work) without fetching anything. Pair with
-    :func:`collect_returns_batch`."""
+    :func:`collect_returns_batch`. The kernel body is resolved here
+    (:func:`_lockstep_body` unless the caller pinned ``prep.body``);
+    a word-body dispatch failure records exactly one ``word-walk``
+    obs fallback and the group walks the dense Pallas kernel."""
+    body = prep.body if prep.body in ("word", "dense") \
+        else _lockstep_body(prep.geom)
+    if body == "word":
+        try:
+            return _dispatch_words(prep)
+        except Exception as e:                          # noqa: BLE001
+            obs.engine_fallback("word-walk", type(e).__name__,
+                                lanes=prep.geom[4])
     W = prep.geom[1]
     n_fast = min(W, _FAST_PASSES)
     dsegs: dict = {}
@@ -763,6 +844,29 @@ def collect_returns_batch(fl: BatchInflight) -> np.ndarray:
     geom, host_args, R_lens, dsegs = (fl.geom, fl.host_args, fl.R_lens,
                                       fl.dsegs)
     B, W, M, S, H, O1, R_pad = geom
+    if fl.body == "word":
+        try:
+            _R, any_dead, first = fl.word_out
+            any_np = np.asarray(any_dead)
+            first_np = np.asarray(first)
+            dead = np.full(H, -1, np.int64)
+            for h in np.nonzero(any_np)[0]:
+                # exact per-step death (identity pads cannot kill a
+                # live set), clamped to the lane's real length
+                dead[int(h)] = min(int(first_np[int(h)]),
+                                   max(int(R_lens[int(h)]) - 1, 0))
+            return dead
+        except Exception as e:                          # noqa: BLE001
+            # the queued word walk died at fetch (jax dispatch is
+            # async — errors surface at first consumption): one
+            # record, then the group re-walks the dense body from the
+            # retained host operands
+            obs.engine_fallback("word-walk", type(e).__name__,
+                                lanes=H, collect=True)
+            redo = BatchPrepared(P, geom, host_args, R_lens,
+                                 interpret, device=fl.device,
+                                 body="dense")
+            return collect_returns_batch(dispatch_prepared(redo))
     n_fast = min(W, _FAST_PASSES)
     ckpts, final = fl.ckpts, fl.final
     HS = H * S

@@ -1,0 +1,264 @@
+"""Word-packed kernel body for the dense-reachability returns walk —
+the whole mask axis as machine words, fire passes as bitwise algebra.
+
+PR 10 landed the first instance of this body inside the streaming
+session's :class:`~jepsen_tpu.checkers.reach.FrontierCarry` (one
+uint32/uint64 word per state, ~33x the dense einsum step on XLA:CPU,
+where the gather/einsum chain is thunk-dispatch-bound). This module
+lifts it out as a FIRST-CLASS kernel body the post-hoc engines select
+through the ``reach`` dispatch seams, and generalizes the single word
+to **word vectors**: the frontier is ``R[S, NW]`` uint32 with bit
+``m & 31`` of word ``m >> 5`` = config ``(s, m)`` reachable, so
+``M = 2**W > 32`` geometries (W > 5) run WITHOUT x64 mode — the
+uint64 body (which jax silently downcasts outside x64) is retired in
+favor of two-or-more uint32 words.
+
+Fire algebra (semantics of ``reach._ret_step``, W passes per return):
+
+- slot ``j < 5`` moves a config's mask bit WITHIN its word: the
+  bit-j-clear half shifts up by ``2**j`` (``(R & ~cmask32[j]) <<
+  2**j`` — the clear positions stay inside their 32-block, so no bit
+  crosses a word boundary);
+- slot ``j >= 5`` moves WHOLE WORDS: bit ``j`` of mask ``m`` is bit
+  ``j - 5`` of its word index, so the fire is a word-axis
+  permutation — the same reshape/stack trick the dense walk plays on
+  the mask axis, one level up.
+- the transition gather is unchanged: per pending slot, each state's
+  shifted contribution OR-scatters through the transition column
+  (row ``S`` = discard), reduced with :func:`jax.lax.reduce` over
+  (source state, slot).
+
+Projection on the returning slot is the inverse shift (within-word
+``>> 2**j`` on the bit-set half, or the word-axis down-permutation),
+selected per step from the dynamic slot index. Death indices are
+exact per step (identity pads — ``ret_slot = -1`` — cannot kill a
+live set), so the post-hoc entry needs no unroll-window refinement.
+
+Selection: :func:`jepsen_tpu.checkers.autotune` winners first (the
+persisted table), then heuristics; ``JEPSEN_TPU_NO_WORD_WALK=1``
+opts every word body out. Differential tests pin this body
+bit-identical to the dense ``_walk_returns`` einsum program and the
+lockstep batch kernel across ragged buckets, crashes, and injected
+violations (``tests/test_word_kernels.py``).
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from jepsen_tpu import obs
+
+# geometry admission: the transition-gather intermediate is
+# [S, S, W, NW] words per fire pass — bound it so a state-rich or
+# slot-rich geometry keeps the dense/einsum bodies
+_MAX_GATHER_ELEMS = 1 << 22
+_MAX_WORDS = 32                          # NW <= 32  ==>  W <= 10
+
+
+def enabled() -> bool:
+    """``JEPSEN_TPU_NO_WORD_WALK=1`` opts out every word-packed walk
+    body (the carried-frontier one and the post-hoc ones alike);
+    consulted per call."""
+    return not os.environ.get("JEPSEN_TPU_NO_WORD_WALK")
+
+
+def n_words(M: int) -> int:
+    """uint32 words per state for a mask axis of ``M = 2**W``."""
+    return max(1, int(M) >> 5)
+
+
+def admits(S: int, W: int, M: int) -> bool:
+    nw = n_words(M)
+    return (nw <= _MAX_WORDS
+            and S * S * max(W, 1) * nw <= _MAX_GATHER_ELEMS)
+
+
+# -- packing helpers (host side) -------------------------------------------
+
+def pack_words(R: np.ndarray) -> np.ndarray:
+    """bool [S, M] -> uint32 [S, NW]; bit ``m & 31`` of word
+    ``m >> 5`` = R[s, m]. For M < 32 the high bits are simply never
+    set."""
+    S, M = R.shape
+    if M < 32:
+        R = np.concatenate(
+            [R, np.zeros((S, 32 - M), bool)], axis=1)
+    packed = np.packbits(np.ascontiguousarray(R, np.uint8),
+                         axis=1, bitorder="little")
+    return packed.view(np.uint32).reshape(S, -1)
+
+
+def unpack_words(words: np.ndarray, M: int) -> np.ndarray:
+    """uint32 [S, NW] -> bool [S, M] (inverse of :func:`pack_words`)."""
+    S = words.shape[0]
+    b = np.unpackbits(words.view(np.uint8).reshape(S, -1),
+                      axis=1, bitorder="little")
+    return b[:, :M].astype(bool)
+
+
+def table_from_P(P: np.ndarray) -> np.ndarray:
+    """Recover the flat transition table the word body gathers from a
+    per-op transition-matrix tensor ``P[o, s, t]`` (one-hot rows,
+    all-zero = no transition): ``T[s, o]`` = target state or -1. The
+    lockstep seams carry only P, so the word body derives T instead
+    of threading the memo through every scheduler."""
+    O1, S, _ = P.shape
+    tgt = P.argmax(axis=2).astype(np.int32)          # [O1, S]
+    has = P.max(axis=2) > 0.5
+    T = np.where(has, tgt, -1).astype(np.int32)      # [O1, S]
+    return np.ascontiguousarray(T.T)                 # [S, O1]
+
+
+def pad_table(table: np.ndarray) -> np.ndarray:
+    """Append the -1 sentinel column (pad slots gather it and
+    discard)."""
+    S = table.shape[0]
+    return np.concatenate(
+        [table, -np.ones((S, 1), table.dtype)], axis=1) \
+        .astype(np.int32)
+
+
+# -- the kernel body --------------------------------------------------------
+
+def _cmask32(W: int) -> np.ndarray:
+    """32-bit within-word masks: bit m of ``cmask32[j]`` set iff mask
+    position ``m`` has bit j set (j < 5; the pattern repeats every 32
+    mask positions, so one word serves every word of the vector)."""
+    m = np.arange(32)
+    return np.array(
+        [sum(1 << int(x) for x in m[(m >> j) & 1 == 1])
+         for j in range(min(W, 5))] or [0], np.uint32)
+
+
+def _walk_words(Tpad, R0, ret_slot, slot_ops):
+    """Multi-word returns walk: ``Tpad`` i32[S, O+1] (col O = -1
+    sentinel), ``R0`` uint32[S, NW], blocks of (ret_slot, slot_ops)
+    as in :func:`reach._walk_returns`. Returns ``(R, any_dead,
+    first_dead)`` with the EXACT step index of the first death."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    S = Tpad.shape[0]
+    O1 = Tpad.shape[1] - 1
+    W = slot_ops.shape[1]
+    NW = R0.shape[1]
+    cmask = jnp.asarray(_cmask32(W))
+    # firing slot j moves mask m to m | (1 << j): a shift by 2**j BIT
+    # POSITIONS, i.e. multiplication by 2**(2**j) (bit-exact on the
+    # bit-j-clear half; j < 5 stays within one 32-bit word)
+    mult = jnp.asarray(
+        np.array([np.uint32(1) << (1 << j) for j in range(min(W, 5))]
+                 or [np.uint32(1)], np.uint32))
+    s_idx = jnp.arange(S)
+    zero = np.zeros((), np.uint32)[()]
+
+    def _shift_up(R, jj: int):
+        """Static fire shift of slot ``jj``: the bit-jj-clear half of
+        every config moves to the bit-set half."""
+        if jj < 5:
+            lo = R & (~cmask[jj])
+            return lo * mult[jj]                     # << 2**jj, exact
+        jb = jj - 5
+        Rr = R.reshape(S, NW >> (jb + 1), 2, 1 << jb)
+        lo = Rr[:, :, 0, :]
+        return jnp.stack([jnp.zeros_like(lo), lo],
+                         axis=2).reshape(S, NW)
+
+    def step(R, inp):
+        j, ops_row = inp
+        o = jnp.where(ops_row < 0, O1, ops_row)
+        tcols = Tpad[:, o]                           # [S, W]
+        tgt = jnp.where(tcols < 0, S, tcols)         # row S = discard
+        for _ in range(W):
+            shifted = jnp.stack([_shift_up(R, jj) for jj in range(W)],
+                                axis=1)              # [S, W, NW]
+            oh = s_idx[:, None, None] == tgt[None, :, :]
+            contrib = jnp.where(oh[:, :, :, None],
+                                shifted[None, :, :, :],
+                                jnp.zeros((), jnp.uint32))
+            fired = lax.reduce(contrib, zero, lax.bitwise_or, (1, 2))
+            R = R | fired
+        jj = jnp.maximum(j, 0)
+        # projection: keep the bit-j-set half, clearing the bit — the
+        # exact inverse shift, selected by the dynamic slot index
+        jw = jnp.minimum(jj, mult.shape[0] - 1)
+        within = (R & cmask[jw]) // mult[jw]
+        jb = jnp.maximum(jj - 5, 0).astype(jnp.uint32)
+        wsel = jnp.arange(NW, dtype=jnp.uint32)
+        src_w = (wsel | (jnp.uint32(1) << jb)).astype(jnp.int32)
+        gathered = jnp.take(R, jnp.minimum(src_w, NW - 1), axis=1)
+        keep = ((wsel >> jb) & 1) == 0
+        cross = jnp.where(keep[None, :], gathered,
+                          jnp.zeros((), jnp.uint32))
+        proj = jnp.where(jj < 5, within, cross)
+        R = jnp.where(j >= 0, proj, R)
+        return R, R.max() == zero
+
+    R, deads = lax.scan(step, R0, (ret_slot, slot_ops))
+    return R, deads.any(), deads.argmax()
+
+
+@functools.cache
+def _jitted_walk_words():
+    # deliberately NOT donated: the word-packed carry is a few machine
+    # words per state, and donating it was measured to corrupt the
+    # aliased buffer under concurrent jax dispatch on the CPU client
+    # (the PR-10 chaos finding; the regression test pins it)
+    import jax
+    return jax.jit(_walk_words)
+
+
+@functools.cache
+def _jitted_walk_words_batch():
+    """vmap over the lane axis (lockstep batch seam): one shared
+    transition table, per-lane streams and frontiers."""
+    import jax
+    return jax.jit(jax.vmap(_walk_words, in_axes=(None, 0, 0, 0)))
+
+
+def _pad_pow2(n: int, floor: int = 64) -> int:
+    return max(floor, 1 << max(0, (n - 1)).bit_length())
+
+
+def walk_returns_words(table: np.ndarray, ret_slot: np.ndarray,
+                       slot_ops: np.ndarray, M: int,
+                       R0: Optional[np.ndarray] = None
+                       ) -> Tuple[int, np.ndarray]:
+    """Post-hoc single-history entry: walk the full return stream on
+    the word-packed body. ``table`` i32[S, O] (memo layout, no
+    sentinel column — it is appended here); ``R0`` bool[S, M]
+    (default: initial state 0, mask 0). Returns ``(dead,
+    final_words)``: ``dead`` the exact first dead return index (-1 =
+    linearizable), ``final_words`` the final frontier uint32[S, NW].
+    Blocks pad to powers of two (identity steps) so a serving daemon
+    compiles log2-many walk geometries."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers import transfer
+
+    S = int(table.shape[0])
+    W = int(slot_ops.shape[1])
+    n = int(ret_slot.shape[0])
+    Tpad = pad_table(table)
+    if R0 is None:
+        R0 = np.zeros((S, M), bool)
+        R0[0, 0] = True
+    R0w = pack_words(np.ascontiguousarray(R0, bool))
+    n_pad = _pad_pow2(max(n, 1))
+    rs = np.full(n_pad, -1, np.int32)
+    so = np.full((n_pad, W), -1, np.int32)
+    rs[:n] = ret_slot
+    so[:n] = slot_ops
+    transfer.count_put(
+        int(Tpad.nbytes + R0w.nbytes + rs.nbytes + so.nbytes),
+        int(Tpad.nbytes + R0.size * 4 + (rs.size + so.size) * 4))
+    R, any_dead, first = _jitted_walk_words()(
+        jnp.asarray(Tpad), jnp.asarray(R0w), jnp.asarray(rs),
+        jnp.asarray(so))
+    obs.count("reach.word_walk")
+    if not bool(any_dead):
+        return -1, np.asarray(R)
+    return min(int(first), max(n - 1, 0)), np.asarray(R)

@@ -159,7 +159,9 @@ def _cmd_check_serve(args) -> int:
         breaker=serve.CircuitBreaker(
             threshold=args.breaker_threshold,
             cooldown_s=args.breaker_cooldown),
-        dispatch_deadline_s=args.dispatch_deadline or None)
+        dispatch_deadline_s=args.dispatch_deadline or None,
+        session_tenant_cap=args.session_tenant_cap,
+        session_idle_ttl_s=args.session_idle_ttl or None)
 
     def _term(signum, frame):
         # SIGTERM == the orchestrator's polite stop: drain, then exit
@@ -355,6 +357,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      help="wall-clock cap per dispatch; a hung "
                           "dispatch past it is aborted and its "
                           "survivors requeued (0 = no cap)")
+    csp.add_argument("--session-tenant-cap", type=int, default=64,
+                     help="max OPEN streaming sessions per tenant "
+                          "(429 cause tenant-cap past it; 0 = "
+                          "unlimited)")
+    csp.add_argument("--session-idle-ttl", type=float, default=3600.0,
+                     help="force-close open sessions idle this many "
+                          "seconds (exact close verdict + journal "
+                          "marker; 0 = never)")
     csp.set_defaults(fn=_cmd_check_serve)
 
     ckp = sub.add_parser(

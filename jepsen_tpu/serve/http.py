@@ -166,7 +166,9 @@ class Daemon:
                  journal_keep_terminal: int = 256,
                  retry_policy: Optional[recovery.RetryPolicy] = None,
                  breaker: Optional[recovery.CircuitBreaker] = None,
-                 dispatch_deadline_s: Optional[float] = None) -> None:
+                 dispatch_deadline_s: Optional[float] = None,
+                 session_tenant_cap: int = 64,
+                 session_idle_ttl_s: Optional[float] = 3600.0) -> None:
         # the queue bounds request COUNT; this bounds request BYTES —
         # both are needed for "backpressure, never OOM": worst-case
         # queued history memory is queue_depth * max_body_bytes-ish
@@ -224,9 +226,17 @@ class Daemon:
             self.registry.on_terminal = (
                 lambda req: jnl.finish(req.id, req.status, req.result))
         # streaming check sessions: long-lived checks whose carried
-        # frontier the dispatcher advances per append block
-        self.sessions = sn.SessionRegistry()
+        # frontier the dispatcher advances per append block. Bounded
+        # three ways: globally (max_open), per tenant (one tenant
+        # must not exhaust the global bound), and in time (an open
+        # session idle past the TTL is force-closed by the sweeper —
+        # an abandoned session pins device state forever otherwise)
+        self.sessions = sn.SessionRegistry(
+            tenant_max_open=session_tenant_cap,
+            idle_ttl_s=session_idle_ttl_s)
         self.dispatcher.sessions = self.sessions
+        self._sweeper: Optional[threading.Thread] = None
+        self._sweeper_stop = threading.Event()
         handler = type("Handler", (_Handler,), {"daemon_ref": self})
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self._serve_thread: Optional[threading.Thread] = None
@@ -246,6 +256,7 @@ class Daemon:
             self.dispatcher.start()
             self.replay_journal()
             self.replay_sessions()
+            self._start_sweeper()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http",
             daemon=True)
@@ -258,6 +269,7 @@ class Daemon:
         self.dispatcher.start()
         self.replay_journal()
         self.replay_sessions()
+        self._start_sweeper()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -267,6 +279,7 @@ class Daemon:
 
     def shutdown(self, drain_timeout: float = 30.0) -> bool:
         self.accepting = False
+        self._sweeper_stop.set()
         drained = self.dispatcher.drain(timeout=drain_timeout)
         self.dispatcher.stop()
         if self._serve_thread is not None:
@@ -409,6 +422,9 @@ class Daemon:
                     break
                 sess.seq = seq
                 sess.replayed += 1
+            # the replayed stream counts as activity: a session must
+            # not be swept as idle the instant its daemon restarts
+            sess.last_active_mono = time.monotonic()
             try:
                 self.sessions.add(sess)
             except RuntimeError as e:
@@ -428,6 +444,53 @@ class Daemon:
             n += 1
         if n:
             log.info("session replay: %d session(s) re-derived", n)
+        return n
+
+    # -- idle-session sweeper --------------------------------------------
+    def _start_sweeper(self) -> None:
+        """Background idle-TTL sweep: an abandoned open session pins
+        its carried device state (frontier buffer / closure masks)
+        and a tenant-cap slot forever; the sweeper force-closes
+        sessions idle past the TTL through the ordinary close path
+        (exact verdict, journal close marker — a replayed daemon will
+        not resurrect them)."""
+        ttl = self.sessions.idle_ttl_s
+        if not ttl or self._sweeper is not None:
+            return
+        interval = max(1.0, min(30.0, float(ttl) / 4.0))
+
+        def _sweep_loop() -> None:
+            while not self._sweeper_stop.wait(interval):
+                try:
+                    self.expire_idle_sessions()
+                # jtlint: ok fallback — sweep failures retry next tick; evictions are counted
+                except Exception:                       # noqa: BLE001
+                    log.exception("idle-session sweep failed")
+
+        self._sweeper = threading.Thread(
+            target=_sweep_loop, name="serve-session-sweeper",
+            daemon=True)
+        self._sweeper.start()
+
+    def expire_idle_sessions(self) -> int:
+        """Force-close open sessions idle past the registry TTL
+        (``serve.session.evicted_idle`` per eviction). Returns how
+        many closes were initiated."""
+        ttl = self.sessions.idle_ttl_s
+        if not ttl:
+            return 0
+        n = 0
+        for sess in self.sessions.idle_open(float(ttl)):
+            idle_s = round(time.monotonic() - sess.last_active_mono, 3)
+            obs.count("serve.session.evicted_idle")
+            self.registry.ledger_record(
+                sess.tenant, "session-evicted-idle",
+                session=sess.id, idle_s=idle_s)
+            log.info("session %s idle %.1fs > ttl %.1fs: force-close",
+                     sess.id, idle_s, ttl)
+            code, payload = self.session_close(sess.id)
+            if code in (200, 202):
+                n += 1
         return n
 
     # -- streaming sessions (called from HTTP worker threads) ------------
@@ -468,6 +531,11 @@ class Daemon:
         sess = sn.Session(sid, tenant, model_name, model, options)
         try:
             self.sessions.add(sess)
+        except sn.TenantSessionCap as e:
+            if self.journal is not None:
+                self.journal.discard_session(sid)
+            return 429, {"error": str(e), "cause": "tenant-cap",
+                         "retry-after-s": 1.0}
         except RuntimeError as e:
             if self.journal is not None:
                 self.journal.discard_session(sid)

@@ -84,6 +84,11 @@ class SessionClosed(RuntimeError):
     """Appends after close are a client error (HTTP 409)."""
 
 
+class TenantSessionCap(RuntimeError):
+    """One tenant hit its open-session cap (HTTP 429 with cause
+    ``tenant-cap`` — the global bound stays a plain RuntimeError)."""
+
+
 # -- register-family device engine ----------------------------------------
 
 class DeviceFrontierEngine(online.NativeStreamEngine):
@@ -297,6 +302,10 @@ class Session:
         self.opts = dict(opts or {})
         self.created_wall = time.time()
         self.created_mono = time.monotonic()
+        # idle-TTL clock: bumped on every append (and replayed
+        # append); an open session whose clock goes stale past the
+        # registry's idle_ttl_s is force-closed by the daemon sweeper
+        self.last_active_mono = self.created_mono
         self.lock = threading.RLock()
         self.seq = 0                        # admitted append blocks
         self.ops: List[Op] = []
@@ -385,6 +394,7 @@ class Session:
         with self.lock:
             if self.closed:
                 raise SessionClosed(f"session {self.id} is closed")
+            self.last_active_mono = time.monotonic()
             self.appends += 1
             self.ops.extend(ops)
             self.ops_total = len(self.ops)
@@ -611,23 +621,50 @@ class SessionRegistry:
     _GUARDED_BY = ("_by_id", "_closed_order")
 
     def __init__(self, max_open: int = 1024,
-                 keep_closed: int = 256) -> None:
+                 keep_closed: int = 256,
+                 tenant_max_open: int = 64,
+                 idle_ttl_s: Optional[float] = None) -> None:
         self._lock = threading.Lock()
         self._by_id: "OrderedDict[str, Session]" = OrderedDict()
         self._closed_order: "deque[str]" = deque()
         self._max_open = max_open
         self._keep_closed = keep_closed
+        self.tenant_max_open = tenant_max_open
+        self.idle_ttl_s = idle_ttl_s
 
     def add(self, sess: Session) -> None:
         with self._lock:
-            n_open = sum(1 for s in self._by_id.values()
-                         if not s.closed)
+            n_open = 0
+            n_tenant = 0
+            for s in self._by_id.values():
+                if not s.closed:
+                    n_open += 1
+                    if s.tenant == sess.tenant:
+                        n_tenant += 1
             if n_open >= self._max_open:
                 raise RuntimeError(
                     f"open-session bound reached ({self._max_open})")
+            if (self.tenant_max_open
+                    and n_tenant >= self.tenant_max_open):
+                # one tenant must not exhaust the global bound for
+                # everyone else (the fairness discipline the one-shot
+                # queue already has, applied to long-lived sessions)
+                obs.count("serve.session.tenant_cap")
+                raise TenantSessionCap(
+                    f"tenant {sess.tenant!r} open-session cap "
+                    f"reached ({self.tenant_max_open})")
             self._by_id[sess.id] = sess
         obs.count("serve.session.opened")
         self._gauge()
+
+    def idle_open(self, ttl_s: float) -> List[Session]:
+        """Open sessions whose last append is more than ``ttl_s``
+        seconds ago (the daemon sweeper force-closes these)."""
+        now = time.monotonic()
+        with self._lock:
+            return [s for s in self._by_id.values()
+                    if not s.closed
+                    and now - s.last_active_mono > ttl_s]
 
     def get(self, sid: str) -> Optional[Session]:
         with self._lock:
@@ -670,4 +707,9 @@ class SessionRegistry:
                 "per-tenant": per_tenant,
                 "appends": sum(s.appends for s in open_s),
                 "ops": sum(s.ops_total for s in open_s),
+                "tenant-cap": self.tenant_max_open,
+                "idle-ttl-s": self.idle_ttl_s,
+                "oldest-idle-s": (round(max(
+                    now - s.last_active_mono for s in open_s), 3)
+                    if open_s else None),
             }

@@ -31,7 +31,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Mapping, Optional, Sequence
 
-from jepsen_tpu import obs
+from jepsen_tpu import obs, util
 from jepsen_tpu.op import Op
 from jepsen_tpu.txn import cycles, host_ref, infer as infer_mod, ops
 from jepsen_tpu.txn.infer import DepGraph
@@ -131,24 +131,30 @@ def check_history(history: Sequence[Op], *,
     appends, G1a aborted reads — fail the history outright and skip
     the cycle stage (a poisoned order could fabricate cycles)."""
     t0 = _time.monotonic()
-    with obs.span("txn.collect"):
-        txns, fails = ops.collect(history)
-    with obs.span("txn.infer", txns=len(txns)):
-        graph = infer_mod.infer(txns, fails)
-    res: Dict[str, Any] = {}
-    if graph.direct:
-        kinds = sorted({d["type"] for d in graph.direct})
-        res = {"valid": False, "txns": graph.n, "edges": graph.e,
-               "edge-counts": graph.edge_counts(),
-               "engine": "txn-infer",
-               "anomalies": kinds, "anomaly": kinds[0],
-               "direct": [dict(d) for d in graph.direct[:32]],
-               "direct-count": len(graph.direct)}
-    else:
-        with obs.span("txn.cycles", txns=graph.n, edges=graph.e):
-            res = check_graph(graph, devices=devices,
-                              max_dense_txns=max_dense_txns,
-                              force_host=force_host)
+    # collect/infer allocate millions of long-lived micro-op tuples:
+    # every gen0/1 collection re-scans the growing survivor set, so
+    # GC is paused across the whole check (util.gc_paused — bounded,
+    # re-entrant; the deferred collection runs at the caller's next
+    # allocation). 100k rung: 2.6 -> 1.4 s host wall.
+    with util.gc_paused():
+        with obs.span("txn.collect"):
+            txns, fails = ops.collect(history)
+        with obs.span("txn.infer", txns=len(txns)):
+            graph = infer_mod.infer(txns, fails)
+        res: Dict[str, Any] = {}
+        if graph.direct:
+            kinds = sorted({d["type"] for d in graph.direct})
+            res = {"valid": False, "txns": graph.n, "edges": graph.e,
+                   "edge-counts": graph.edge_counts(),
+                   "engine": "txn-infer",
+                   "anomalies": kinds, "anomaly": kinds[0],
+                   "direct": [dict(d) for d in graph.direct[:32]],
+                   "direct-count": len(graph.direct)}
+        else:
+            with obs.span("txn.cycles", txns=graph.n, edges=graph.e):
+                res = check_graph(graph, devices=devices,
+                                  max_dense_txns=max_dense_txns,
+                                  force_host=force_host)
     res["failed-txns"] = len(fails)
     res["infer"] = dict(graph.counters)
     if graph.counters.get("ambiguous_appends"):

@@ -61,6 +61,28 @@ def device_enabled() -> bool:
     return not os.environ.get("JEPSEN_TPU_NO_TXN_DEVICE")
 
 
+def word_closure_enabled() -> bool:
+    """The word-packed closure body (rows as uint32 bitmask words,
+    the squaring ladder as AND + word-wise any/popcount) is the
+    DEFAULT kernel body; ``JEPSEN_TPU_NO_WORD_CLOSURE=1`` opts back
+    to the f32 einsum body — which is also the recorded fallback when
+    the word body fails (consulted per call)."""
+    return not os.environ.get("JEPSEN_TPU_NO_WORD_CLOSURE")
+
+
+def _closure_body(Np: int) -> str:
+    """Body selection: the persisted autotune table first (a
+    ``closure`` winner recorded by ``tools/closure_sweep.py`` /
+    ``bench.py``), then the word-packed default."""
+    if not word_closure_enabled():
+        return "f32"
+    from jepsen_tpu.checkers import autotune
+    w = autotune.winner("closure", autotune.closure_key(Np))
+    if w in ("word", "f32"):
+        return w
+    return "word"
+
+
 def admits(n: int, cap: Optional[int] = None) -> bool:
     return n <= (cap if cap is not None else max_dense())
 
@@ -124,6 +146,110 @@ def _closure_call(Np: int, packed_wire: bool):
     return jax.jit(fn)
 
 
+# -- word-packed closure body (the bit-parallel default) -----------------
+#
+# Four-Russians-style boolean matrix multiplication: each adjacency /
+# closure row lives as ceil(Np/32) uint32 words (bit ``k & 31`` of
+# word ``k >> 5`` = edge i -> k), 32x denser than the f32 masks. One
+# squaring step computes ``prod[b, i, k] = OR_j C[b,i,j] & C[b,j,k]``
+# as a word-wise AND between row-packed C and TRANSPOSE-packed C
+# reduced over the word axis (``any(words != 0)`` — the popcount>0
+# predicate without paying the count), so each multiply-accumulate of
+# the f32 einsum becomes one AND over 32 matrix entries. Both
+# packings are maintained (row- and transpose-packed) so no device
+# transpose is ever paid; the G-single contraction collapses to ONE
+# [Np, NW] AND (``any(Arw_w & reflT_w)``). Verdicts are bit-identical
+# to the f32 ladder and the host SCC (differentially tested); the
+# f32 body stays as the recorded fallback (`word-closure` obs stage)
+# and the ``JEPSEN_TPU_NO_WORD_CLOSURE=1`` opt-out.
+
+_WORD_NP_FLOOR = 32                      # words pack 32 columns
+
+
+def _pad_n_words(n: int) -> int:
+    return max(_WORD_NP_FLOOR, _pad_n(n))
+
+
+def _pack_rows(a: np.ndarray) -> np.ndarray:
+    """bool [..., K] (K % 32 == 0) -> uint32 [..., K/32], bit
+    ``k & 31`` of word ``k >> 5`` = a[..., k]."""
+    p = np.packbits(np.ascontiguousarray(a, np.uint8), axis=-1,
+                    bitorder="little")
+    return np.ascontiguousarray(p).view(np.uint32) \
+        .reshape(a.shape[:-1] + (a.shape[-1] // 32,))
+
+
+@lru_cache(maxsize=32)
+def _closure_word_call(Np: int):
+    """One compiled word-packed closure program per padded geometry:
+    operands are the row-packed and transpose-packed adjacency words
+    (host-packed — 32x fewer wire bytes than even uint8) and the
+    row-packed rw mask; verdict is the same 4 bools."""
+    import jax
+    import jax.numpy as jnp
+
+    NW = Np // 32
+    n_iter = max(1, math.ceil(math.log2(Np)))
+    pw = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+
+    def pack_last(dense_bool):
+        """bool [..., Np] -> uint32 [..., NW] (sum of distinct bits
+        == OR; no carries)."""
+        x = dense_bool.reshape(dense_bool.shape[:-1] + (NW, 32)) \
+            .astype(jnp.uint32)
+        return (x * pw).sum(-1).astype(jnp.uint32)
+
+    def fn(Cw, CwT, Arw_w):
+        for _ in range(n_iter):
+            # prod[b, i, k] = any_w (Cw[b,i,w] & CwT[b,k,w]) — the
+            # AND+popcount boolean matmul, fused by XLA into one
+            # reduction loop (no [Np, Np, NW] materialization)
+            prod = jnp.any(
+                (Cw[:, :, None, :] & CwT[:, None, :, :]) != 0,
+                axis=-1)
+            Cw = Cw | pack_last(prod)
+            CwT = CwT | pack_last(jnp.swapaxes(prod, 1, 2))
+        i = jnp.arange(Np)
+        dwords = Cw[:, i, i >> 5]                        # [3, Np]
+        cyc = (((dwords >> (i & 31).astype(jnp.uint32)) & 1) > 0) \
+            .any(axis=1)
+        eye_w = ((jnp.arange(NW)[None, :] == (i >> 5)[:, None])
+                 .astype(jnp.uint32)
+                 * (jnp.uint32(1) << (i & 31).astype(jnp.uint32)
+                    )[:, None])                          # [Np, NW]
+        reflT_w = CwT[1] | eye_w
+        gs = jnp.any((Arw_w & reflT_w) != 0)
+        return jnp.concatenate([cyc, gs[None]])
+
+    return jax.jit(fn)
+
+
+def _word_closure_booleans(masks: np.ndarray, rw: np.ndarray,
+                           Np: int) -> np.ndarray:
+    """Run the word-packed one-shot closure. ``masks``/``rw`` are the
+    dense [3, Np, Np]/[Np, Np] bool masks; re-pads to the word floor
+    (words pack 32 columns) before packing."""
+    from jepsen_tpu.checkers import transfer
+
+    Npw = _pad_n_words(Np)
+    if Npw != masks.shape[1]:
+        grown = np.zeros((3, Npw, Npw), bool)
+        grown[:, :masks.shape[1], :masks.shape[2]] = masks
+        masks = grown
+        grown_rw = np.zeros((Npw, Npw), bool)
+        grown_rw[:rw.shape[0], :rw.shape[1]] = rw
+        rw = grown_rw
+    Cw = _pack_rows(masks)
+    CwT = _pack_rows(np.swapaxes(masks, 1, 2))
+    Arw_w = _pack_rows(rw)
+    transfer.count_put(
+        int(Cw.nbytes + CwT.nbytes + Arw_w.nbytes),
+        int((masks.size + rw.size) * 4))
+    out = np.asarray(_closure_word_call(Npw)(Cw, CwT, Arw_w))
+    obs.count("txn.closure.word")
+    return out
+
+
 def _put_wire(masks: np.ndarray, rw: np.ndarray
               ) -> Tuple[Any, Any, bool]:
     """Marshal the adjacency under the diet: bit-packed 8-per-byte
@@ -153,6 +279,19 @@ def closure_booleans(graph: DepGraph,
     masks, rw = _masks(graph, Np)
     if devices is not None and len(devices) > 1:
         out = _tiled_booleans(masks, rw, Np, list(devices))
+    elif _closure_body(Np) == "word":
+        try:
+            out = _word_closure_booleans(masks, rw, Np)
+        except Exception as e:                          # noqa: BLE001
+            # the f32 einsum body is the RECORDED fallback of the
+            # word-packed default: exactly one obs record, then the
+            # round-8 dispatch — a further failure raises to the
+            # caller's host-SCC ladder as before
+            obs.engine_fallback("word-closure", type(e).__name__,
+                                txns=graph.n, edges=graph.e)
+            w3, wrw, packed_wire = _put_wire(masks, rw)
+            out = np.asarray(_closure_call(Np, packed_wire)(w3, wrw))
+            obs.count("txn.closure.device")
     else:
         w3, wrw, packed_wire = _put_wire(masks, rw)
         out = np.asarray(_closure_call(Np, packed_wire)(w3, wrw))
@@ -239,6 +378,120 @@ def _pow2_at_least(n: int, floor: int = 8) -> int:
     return max(floor, 1 << max(0, (n - 1)).bit_length())
 
 
+@lru_cache(maxsize=32)
+def _inc_word_call(Np: int, d_pad: int, e_pad: int):
+    """Word-packed dirty-block update: the carried closure lives as
+    row-packed ``Cw`` + transpose-packed ``CwT`` [3, Np, NW] uint32
+    (+ ``Arw_w`` [Np, NW]) — 32x denser device residency than the f32
+    masks — and one append batch costs the same decomposition as the
+    f32 body (scatter -> [d, d] junction ladder -> two skinny joins),
+    with the scatter as 32 static bit-plane OR-scatters (per plane
+    all values share one bit, so ``.max`` IS bitwise-or) and the
+    join's [Np, Np] product never materialized: the add re-packs
+    through fused OR-reductions against the packed right rows. The
+    carried words are donated (in-place advance)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    NW = Np // 32
+    n_iter = max(1, math.ceil(math.log2(max(d_pad, 2))))
+    pw = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    zero32 = np.zeros((), np.uint32)[()]
+
+    def pack_last(dense_bool):
+        x = dense_bool.reshape(dense_bool.shape[:-1] + (NW, 32)) \
+            .astype(jnp.uint32)
+        return (x * pw).sum(-1).astype(jnp.uint32)
+
+    def unpack_last(words):
+        b = (words[..., :, None]
+             >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        return b.reshape(words.shape[:-1] + (Np,)) != 0
+
+    def _scatter_bits(dst_words, rows, cols, vals01):
+        """OR ``vals01`` (0/1 per entry, leading lane axes allowed)
+        as bit ``cols & 31`` into ``dst_words[..., rows, cols >> 5]``
+        — 32 static bit-plane passes. WITHIN a pass every nonzero
+        value carries the same single bit, so scatter-``max`` into a
+        zero scratch IS bitwise-or even under duplicate (row, word)
+        slots; ACROSS passes the scratches combine with ``|`` (a
+        direct ``.at[].max`` on the accumulator would clobber
+        previously set different bits: max(1, 8) = 8)."""
+        cw = cols >> 5
+        cb = (cols & 31).astype(jnp.uint32)
+        for t in range(32):
+            val = jnp.where(cb == t, jnp.uint32(1) << t, zero32)
+            add = jnp.zeros_like(dst_words).at[..., rows, cw].max(
+                vals01.astype(jnp.uint32) * val)
+            dst_words = dst_words | add
+        return dst_words
+
+    def fn(Cw, CwT, Arw_w, esrc, edst, elane, erw, dsel):
+        s = jnp.where(esrc < 0, 0, esrc)
+        d = jnp.where(edst < 0, 0, edst)
+        el = elane != 0                                   # [3, e_pad]
+        Cw = _scatter_bits(Cw, s, d, el)
+        CwT = _scatter_bits(CwT, d, s, el)
+        Arw_w = _scatter_bits(Arw_w, s, d, erw != 0)
+        dd = jnp.where(dsel < 0, 0, dsel)
+        valid = (dsel >= 0).astype(jnp.float32)
+        dw = dd >> 5
+        db = (dd & 31).astype(jnp.uint32)
+        # dirty-block extraction from the packed rows: H[b, j, k] =
+        # bit dd[k] of row dd[j]
+        rows_w = Cw[:, dd, :] * (valid.astype(jnp.uint32)
+                                 )[None, :, None]         # [3, d, NW]
+        Hw = rows_w[:, :, dw]                             # [3, d, d]
+        H = (((Hw >> db[None, None, :]) & 1).astype(jnp.float32)
+             * valid[None, :, None] * valid[None, None, :])
+        for _ in range(n_iter):
+            prod = jnp.einsum("bij,bjk->bik", H, H,
+                              preferred_element_type=jnp.float32)
+            H = jnp.where(prod > 0, 1.0, H)
+        # left = (C ∨ I)[:, dd] dense skinny [3, Np, d]
+        eyeD = (jnp.arange(Np)[:, None] == dd[None, :]) \
+            .astype(jnp.float32) * valid[None, :]
+        colw = Cw[:, :, dw]                               # [3, Np, d]
+        left = jnp.maximum(
+            ((colw >> db[None, None, :]) & 1).astype(jnp.float32)
+            * valid[None, None, :], eyeD[None])
+        thru = jnp.einsum("bik,bkl->bil", left, H,
+                          preferred_element_type=jnp.float32)
+        # right = (C ∨ I)[dd, :] kept PACKED: the [Np, Np] add image
+        # re-packs through a fused OR-reduce instead of a dense f32
+        # product
+        eyeD_w = pack_last(eyeD.T)                        # [d, NW]
+        right_w = rows_w | eyeD_w[None]                   # [3, d, NW]
+        m = thru > 0                                      # [3, Np, d]
+        add_w = lax.reduce(
+            jnp.where(m[:, :, :, None], right_w[:, None, :, :],
+                      zero32),
+            zero32, lax.bitwise_or, (2,))                 # [3, Np, NW]
+        Cw = Cw | add_w
+        # transpose-packed update: addT[b, j, i] = OR_k right[b,k,j]
+        # & thru[b,i,k] — pack thru over i, mask by the dense right
+        right_dense = unpack_last(right_w)                # [3, d, Np]
+        thruT_w = pack_last(jnp.swapaxes(m, 1, 2))        # [3, d, NW]
+        addT_w = lax.reduce(
+            jnp.where(right_dense[:, :, :, None],
+                      thruT_w[:, :, None, :], zero32),
+            zero32, lax.bitwise_or, (1,))                 # [3, Np, NW]
+        CwT = CwT | addT_w
+        i = jnp.arange(Np)
+        dwords = Cw[:, i, i >> 5]
+        cyc = (((dwords >> (i & 31).astype(jnp.uint32)) & 1) > 0) \
+            .any(axis=1)
+        eye_w = ((jnp.arange(NW)[None, :] == (i >> 5)[:, None])
+                 .astype(jnp.uint32)
+                 * (jnp.uint32(1) << (i & 31).astype(jnp.uint32)
+                    )[:, None])
+        gs = jnp.any((Arw_w & (CwT[1] | eye_w)) != 0)
+        return Cw, CwT, Arw_w, jnp.concatenate([cyc, gs[None]])
+
+    return jax.jit(fn, donate_argnums=(0, 1, 2))
+
+
 class IncrementalClosure:
     """Device-resident incremental transitive closure for one txn
     session. ``add_block(n_txns, src, dst, et)`` folds an append
@@ -252,21 +505,38 @@ class IncrementalClosure:
         self._cap = (max_dense_txns if max_dense_txns is not None
                      else max_dense())
         self.Np = 0
+        # body pinned at construction (a session must not flip bodies
+        # mid-stream — the carried state formats differ)
+        self.packed = _closure_body(_WORD_NP_FLOOR) == "word"
         self._C = None                      # f32 [3, Np, Np] on device
         self._Arw = None                    # f32 [Np, Np] on device
+        self._Cw = None                     # u32 [3, Np, NW] (packed)
+        self._CwT = None                    # u32 [3, Np, NW] (packed)
+        self._Arw_w = None                  # u32 [Np, NW]    (packed)
         self.updates = 0
 
     def _seed(self, Np: int) -> None:
         import jax
         import jax.numpy as jnp
         self.Np = Np
+        if self.packed:
+            NW = Np // 32
+            self._Cw = jax.device_put(
+                jnp.zeros((3, Np, NW), jnp.uint32))
+            self._CwT = jax.device_put(
+                jnp.zeros((3, Np, NW), jnp.uint32))
+            self._Arw_w = jax.device_put(
+                jnp.zeros((Np, NW), jnp.uint32))
+            return
         self._C = jax.device_put(jnp.zeros((3, Np, Np), jnp.float32))
         self._Arw = jax.device_put(jnp.zeros((Np, Np), jnp.float32))
 
     def _regrow(self, n: int) -> None:
         """Re-embed the carried masks into the next power-of-two
-        geometry (closure is preserved: new nodes have no edges)."""
-        Np2 = _pad_n(n)
+        geometry (closure is preserved: new nodes have no edges). The
+        packed re-embed copies WORDS: the old Np is a multiple of 32,
+        so old columns occupy whole words of the new layout."""
+        Np2 = _pad_n_words(n) if self.packed else _pad_n(n)
         if n > self._cap:
             raise ClosureOverflow(
                 f"session graph {n} txns > dense cap {self._cap}")
@@ -274,13 +544,34 @@ class IncrementalClosure:
             self._seed(Np2)
             return
         import jax
+        from jepsen_tpu.checkers import transfer
+        if self.packed:
+            NW2 = Np2 // 32
+            Cw = np.asarray(self._Cw)
+            CwT = np.asarray(self._CwT)
+            Aw = np.asarray(self._Arw_w)
+            NW = Cw.shape[2]
+            Cw2 = np.zeros((3, Np2, NW2), np.uint32)
+            CwT2 = np.zeros((3, Np2, NW2), np.uint32)
+            Aw2 = np.zeros((Np2, NW2), np.uint32)
+            Cw2[:, :self.Np, :NW] = Cw
+            CwT2[:, :self.Np, :NW] = CwT
+            Aw2[:self.Np, :NW] = Aw
+            transfer.count_put(
+                int(Cw2.nbytes + CwT2.nbytes + Aw2.nbytes),
+                int((2 * 3 + 1) * Np2 * Np2 * 4))
+            self.Np = Np2
+            self._Cw = jax.device_put(Cw2)
+            self._CwT = jax.device_put(CwT2)
+            self._Arw_w = jax.device_put(Aw2)
+            obs.count("txn.closure.regrow")
+            return
         C = np.asarray(self._C)
         Arw = np.asarray(self._Arw)
         C2 = np.zeros((3, Np2, Np2), np.float32)
         Arw2 = np.zeros((Np2, Np2), np.float32)
         C2[:, :self.Np, :self.Np] = C
         Arw2[:self.Np, :self.Np] = Arw
-        from jepsen_tpu.checkers import transfer
         transfer.count_put(int(C2.nbytes + Arw2.nbytes),
                            int(C2.nbytes + Arw2.nbytes))
         self.Np = Np2
@@ -290,7 +581,7 @@ class IncrementalClosure:
 
     @property
     def P_empty(self) -> bool:
-        return self._C is None
+        return (self._Cw is None) if self.packed else (self._C is None)
 
     def add_block(self, n_txns: int, src: np.ndarray, dst: np.ndarray,
                   et: np.ndarray) -> Dict[str, bool]:
@@ -327,6 +618,18 @@ class IncrementalClosure:
         wire = int(esrc.nbytes + edst.nbytes + elane.nbytes
                    + erw.nbytes + dsel.nbytes)
         transfer.count_put(wire, wire)
+        if self.packed:
+            self._Cw, self._CwT, self._Arw_w, out = _inc_word_call(
+                self.Np, d_pad, e_pad)(
+                self._Cw, self._CwT, self._Arw_w, jnp.asarray(esrc),
+                jnp.asarray(edst), jnp.asarray(elane),
+                jnp.asarray(erw), jnp.asarray(dsel))
+            self.updates += 1
+            obs.count("txn.closure.incremental")
+            obs.count("txn.closure.incremental_word")
+            o = np.asarray(out)
+            return {"cyc_ww": bool(o[0]), "cyc_wwwr": bool(o[1]),
+                    "cyc_full": bool(o[2]), "gsingle": bool(o[3])}
         self._C, self._Arw, out = _inc_call(self.Np, d_pad, e_pad)(
             self._C, self._Arw, jnp.asarray(esrc), jnp.asarray(edst),
             jnp.asarray(elane), jnp.asarray(erw), jnp.asarray(dsel))

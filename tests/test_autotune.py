@@ -1,0 +1,239 @@
+"""The persisted autotune table (ISSUE 13): record/winner round-trip,
+staleness (schema + jax-version), the opt-out and no-persist gates,
+corrupt-table tolerance, cross-process pickup (mtime invalidation +
+a warm SECOND process honoring a recorded winner), and route
+selection consulting recorded winners in ``reach.check_packed``,
+``txn/cycles``, and the facade's group width."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures, models, obs
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers import autotune, reach
+from jepsen_tpu.txn import cycles
+from jepsen_tpu.txn.infer import DepGraph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def table_dir(tmp_path, monkeypatch):
+    """Opt persistence back in (the suite defaults it off) under a
+    throwaway root."""
+    monkeypatch.delenv("JEPSEN_TPU_NO_PERSIST", raising=False)
+    monkeypatch.setenv("JEPSEN_TPU_CACHE_DIR", str(tmp_path))
+    yield str(tmp_path)
+
+
+def test_record_winner_round_trip(table_dir):
+    with obs.capture() as cap:
+        path = autotune.record("closure", "Np64", "word",
+                               metric=123.4, detail={"f32_s": 0.5})
+        assert path == os.path.join(table_dir, "autotune.json")
+        assert autotune.winner("closure", "Np64") == "word"
+        # a different kind/geometry/backend is a miss, not a bleed
+        assert autotune.winner("walk", "Np64") is None
+        assert autotune.winner("closure", "Np128") is None
+        assert autotune.winner("closure", "Np64",
+                               backend_name="tpu") is None
+    assert cap.counters.get("autotune.record") == 1
+    assert cap.counters.get("autotune.hit") == 1
+    assert cap.counters.get("autotune.miss") == 3
+    data = json.load(open(path))
+    assert data["version"] == 1
+    entry = data["entries"][f"closure|{autotune.backend()}|Np64"]
+    assert entry["body"] == "word" and entry["metric"] == 123.4
+
+
+def test_stale_on_jax_version_and_schema(table_dir):
+    path = autotune.record("walk", "S8-W5-M32-R128", "word")
+    data = json.load(open(path))
+    for e in data["entries"].values():
+        e["jax"] = "0.0.1-not-this-one"
+    json.dump(data, open(path, "w"))
+    with obs.capture() as cap:
+        assert autotune.winner("walk", "S8-W5-M32-R128") is None
+    assert cap.counters.get("autotune.stale") == 1
+    # schema-version mismatch is stale too (and record() rebuilds)
+    data["version"] = 99
+    for e in data["entries"].values():
+        e["jax"] = autotune._jax_version()
+    json.dump(data, open(path, "w"))
+    with obs.capture() as cap:
+        assert autotune.winner("walk", "S8-W5-M32-R128") is None
+    assert cap.counters.get("autotune.stale") == 1
+    autotune.record("walk", "S8-W5-M32-R128", "dense")
+    assert json.load(open(path))["version"] == 1
+    assert autotune.winner("walk", "S8-W5-M32-R128") == "dense"
+
+
+def test_corrupt_table_reads_empty(table_dir):
+    path = os.path.join(table_dir, "autotune.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    with obs.capture() as cap:
+        assert autotune.winner("closure", "Np64") is None
+    assert cap.counters.get("autotune.stale") == 1
+    # and a record over it rebuilds a clean table
+    autotune.record("closure", "Np64", "f32")
+    assert autotune.winner("closure", "Np64") == "f32"
+
+
+def test_disabled_and_no_persist_gates(table_dir, monkeypatch):
+    autotune.record("closure", "Np64", "word")
+    monkeypatch.setenv("JEPSEN_TPU_NO_AUTOTUNE", "1")
+    with obs.capture() as cap:
+        assert autotune.winner("closure", "Np64") is None
+        assert autotune.record("closure", "Np64", "f32") is None
+    assert not cap.counters                 # no hit/miss/record noise
+    monkeypatch.delenv("JEPSEN_TPU_NO_AUTOTUNE")
+    monkeypatch.setenv("JEPSEN_TPU_NO_PERSIST", "1")
+    assert autotune.table_path() is None
+    assert autotune.winner("closure", "Np64") is None
+    assert autotune.record("closure", "Np64", "f32") is None
+
+
+def test_mtime_invalidation_picks_up_external_write(table_dir):
+    path = autotune.record("closure", "Np64", "word")
+    assert autotune.winner("closure", "Np64") == "word"
+    data = json.load(open(path))
+    key = f"closure|{autotune.backend()}|Np64"
+    data["entries"][key]["body"] = "f32"
+    json.dump(data, open(path, "w"))
+    os.utime(path, (os.path.getmtime(path) + 2,) * 2)
+    assert autotune.winner("closure", "Np64") == "f32"
+
+
+def test_geometry_buckets():
+    assert autotune.closure_key(40) == "Np64"
+    assert autotune.closure_key(64) == "Np64"
+    assert autotune.walk_key(6, 5, 32, 1000) == "S8-W5-M32-R1024"
+    assert autotune.lockstep_key(6, 5, 32, 32) == "S8-W5-M32-H32"
+
+
+# -- route selection consults recorded winners ------------------------------
+
+def test_posthoc_route_honors_recorded_winner(table_dir):
+    """A recorded ``walk`` winner steers ``check_packed`` to the word
+    body with NO force gate set — and a ``dense`` record steers it
+    away."""
+    model = models.cas_register()
+    hist = fixtures.gen_history("cas", n_ops=150, processes=4,
+                                seed=23)
+    packed = h.pack(h.index(hist))
+    memo, stream, _T, _S, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    key = autotune.walk_key(memo.n_states, max(stream.W, 1), M,
+                            _returns_count(model, packed))
+    autotune.record("walk", key, "word")
+    with obs.capture() as cap:
+        res = reach.check_packed(model, packed)
+    assert res["engine"] == "reach-word"
+    assert cap.counters.get("autotune.hit", 0) >= 1
+    autotune.record("walk", key, "dense")
+    res2 = reach.check_packed(model, packed)
+    assert res2["engine"] != "reach-word"
+    assert res2["valid"] == res["valid"]
+
+
+def _returns_count(model, packed):
+    from jepsen_tpu.checkers import events as ev
+    memo, stream, _T, _S_pad, _M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    return ev.returns_view(stream).n_returns
+
+
+def test_closure_route_honors_recorded_winner(table_dir):
+    """A recorded ``closure`` f32 winner opts the one-shot closure
+    out of the word default (and back)."""
+    r = np.random.default_rng(5)
+    n, e = 40, 80
+    src = r.integers(0, n, e).astype(np.int32)
+    dst = r.integers(0, n, e).astype(np.int32)
+    keep = src != dst
+    g = DepGraph(n=n, src=src[keep], dst=dst[keep],
+                 et=r.integers(0, 3, int(keep.sum()))
+                 .astype(np.int8), txns=tuple(range(n)))
+    key = autotune.closure_key(cycles._pad_n_words(cycles._pad_n(n)))
+    autotune.record("closure", key, "f32")
+    with obs.capture() as cap:
+        cycles.closure_booleans(g)
+    assert "txn.closure.word" not in cap.counters
+    assert cap.counters.get("txn.closure.device") == 1
+    autotune.record("closure", key, "word")
+    with obs.capture() as cap:
+        cycles.closure_booleans(g)
+    assert cap.counters.get("txn.closure.word") == 1
+
+
+def test_facade_group_width_honors_recorded_winner(table_dir,
+                                                   monkeypatch):
+    """A recorded ``group`` winner reaches ``reach.check_many`` as
+    the lockstep group width (explicit group= still outranks it)."""
+    from jepsen_tpu.checkers import facade
+
+    seen = {}
+
+    def fake_check_many(model, packed_list, **kw):
+        seen.update(kw)
+        return [{"valid": True, "engine": "stub"}
+                for _ in packed_list]
+
+    monkeypatch.setattr(reach, "check_many", fake_check_many)
+    autotune.record("group", "default", "16")
+    model = models.cas_register()
+    packed = [h.pack(h.index(fixtures.gen_history(
+        "cas", n_ops=20, processes=2, seed=1)))]
+    facade.auto_check_many_packed(model, packed, {})
+    assert seen.get("group") == 16
+    seen.clear()
+    facade.auto_check_many_packed(model, packed, {"group": 8})
+    assert seen.get("group") == 8
+
+
+@pytest.mark.slow
+def test_warm_second_process_honors_winner(table_dir):
+    """The acceptance bar: a winner recorded in THIS process steers
+    route selection in a FRESH process (cold imports, warm table) —
+    an ``autotune.hit`` and the word engine with no force gate."""
+    model = models.cas_register()
+    hist = fixtures.gen_history("cas", n_ops=120, processes=4,
+                                seed=29)
+    packed = h.pack(h.index(hist))
+    memo, stream, _T, _S, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    key = autotune.walk_key(memo.n_states, max(stream.W, 1), M,
+                            _returns_count(model, packed))
+    autotune.record("walk", key, "word")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JEPSEN_TPU_CACHE_DIR=table_dir)
+    env.pop("JEPSEN_TPU_NO_PERSIST", None)
+    code = (
+        "import json, os\n"
+        "from jepsen_tpu import fixtures, models, obs\n"
+        "from jepsen_tpu import history as h\n"
+        "from jepsen_tpu.checkers import reach\n"
+        "hist = fixtures.gen_history('cas', n_ops=120, processes=4,"
+        " seed=29)\n"
+        "with obs.capture() as cap:\n"
+        "    res = reach.check_packed(models.cas_register(),"
+        " h.pack(h.index(hist)))\n"
+        "print(json.dumps({'engine': res['engine'],"
+        " 'hits': cap.counters.get('autotune.hit', 0)}))\n")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["engine"] == "reach-word"
+    assert rep["hits"] >= 1

@@ -571,3 +571,149 @@ def test_web_engine_renders_open_sessions_row(tmp_path):
         "sessions": {"open": 0, "closed": 3}}))
     html_out = web._engine_html(str(tmp_path))
     assert "no open sessions" in html_out
+
+
+# -- per-tenant caps + idle-TTL expiry (ISSUE 13) ---------------------------
+
+def test_session_registry_tenant_cap():
+    """One tenant must not exhaust the global open bound: the third
+    open on a capped tenant raises TenantSessionCap (counted), other
+    tenants are unaffected, and a close frees the slot."""
+    from jepsen_tpu.serve.session import TenantSessionCap
+    reg = SessionRegistry(max_open=10, tenant_max_open=2)
+    m = models.cas_register()
+    s1 = Session("ca", "t1", "cas-register", m)
+    s2 = Session("cb", "t1", "cas-register", m)
+    reg.add(s1)
+    reg.add(s2)
+    with obs.capture() as cap:
+        with pytest.raises(TenantSessionCap):
+            reg.add(Session("cc", "t1", "cas-register", m))
+    assert cap.counters.get("serve.session.tenant_cap") == 1
+    reg.add(Session("cd", "t2", "cas-register", m))   # other tenant ok
+    s1.closed = True
+    reg.mark_closed(s1)
+    reg.add(Session("ce", "t1", "cas-register", m))   # slot freed
+    c = reg.census()
+    assert c["tenant-cap"] == 2
+    assert c["per-tenant"] == {"t1": 2, "t2": 1}
+    # tenant_max_open=0 disables the per-tenant bound
+    reg0 = SessionRegistry(max_open=10, tenant_max_open=0)
+    for i in range(5):
+        reg0.add(Session(f"z{i}", "t", "cas-register", m))
+
+
+def test_session_tenant_cap_http_429(tmp_path):
+    """The daemon answers 429 cause tenant-cap at the per-tenant
+    bound and discards the journaled open (a capped open must not be
+    resurrected by replay)."""
+    from jepsen_tpu import serve
+    d = serve.Daemon(port=0, store_root=str(tmp_path),
+                     session_tenant_cap=2).start(dispatch=False)
+    url = f"http://127.0.0.1:{d.port}"
+    try:
+        sids = []
+        for _ in range(2):
+            code, r = _http(url, "POST", "/session",
+                            {"model": "cas-register", "tenant": "tt"})
+            assert code == 201
+            sids.append(r["session"])
+        code, r = _http(url, "POST", "/session",
+                        {"model": "cas-register", "tenant": "tt"})
+        assert code == 429 and r["cause"] == "tenant-cap"
+        assert "retry-after-s" in r
+        code, _ = _http(url, "POST", "/session",
+                        {"model": "cas-register", "tenant": "other"})
+        assert code == 201
+        assert d.journal is not None
+        assert set(sids) <= set(d.journal.open_session_ids())
+        assert len(d.journal.open_session_ids()) == 3
+    finally:
+        d.shutdown()
+
+
+def test_session_idle_ttl_expiry(tmp_path):
+    """An open session idle past the TTL is force-closed through the
+    ordinary close path: exact verdict, journal close marker (a
+    replaying daemon will NOT resurrect it), eviction counter +
+    ledger record; an active session is untouched."""
+    import time as _time
+    from jepsen_tpu import serve
+    d = serve.Daemon(port=0, store_root=str(tmp_path),
+                     session_idle_ttl_s=3600.0).start()
+    url = f"http://127.0.0.1:{d.port}"
+    try:
+        code, r = _http(url, "POST", "/session",
+                        {"model": "cas-register", "tenant": "tt"})
+        assert code == 201
+        stale_sid = r["session"]
+        hist = fixtures.gen_history("cas", n_ops=20, processes=2,
+                                    seed=5)
+        code, _ = _http(url, "POST", f"/session/{stale_sid}/append",
+                        {"history": [op.to_dict() for op in hist],
+                         "seq": 1})
+        assert code == 200
+        code, r = _http(url, "POST", "/session",
+                        {"model": "cas-register", "tenant": "tt"})
+        fresh_sid = r["session"]
+        # age the first session past the TTL without sleeping
+        sess = d.sessions.get(stale_sid)
+        sess.last_active_mono = _time.monotonic() - 7200.0
+        assert [s.id for s in d.sessions.idle_open(3600.0)] \
+            == [stale_sid]
+        with obs.capture() as cap:
+            assert d.expire_idle_sessions() == 1
+        assert cap.counters.get("serve.session.evicted_idle") == 1
+        code, st = _http(url, "GET", f"/session/{stale_sid}")
+        assert code == 200 and st["status"] == "closed"
+        assert st["result"]["valid"] is True
+        code, st = _http(url, "GET", f"/session/{fresh_sid}")
+        assert code == 200 and st["status"] == "open"
+        # closed = closed: appends now 409, and a restarted daemon
+        # does not resurrect the evicted session as open
+        code, _ = _http(url, "POST", f"/session/{stale_sid}/append",
+                        {"history": [op.to_dict() for op in hist],
+                         "seq": 2})
+        assert code == 409
+    finally:
+        d.shutdown()
+    d2 = serve.Daemon(port=0, store_root=str(tmp_path),
+                      session_idle_ttl_s=3600.0).start()
+    try:
+        url2 = f"http://127.0.0.1:{d2.port}"
+        code, st = _http(url2, "GET", f"/session/{stale_sid}")
+        assert code == 200 and st["status"] == "closed"
+    finally:
+        d2.shutdown()
+
+
+def test_session_replay_resets_idle_clock(tmp_path):
+    """A replayed session's idle clock restarts at replay — a daemon
+    restart must not mass-evict every session that was open across
+    the crash."""
+    import time as _time
+    from jepsen_tpu import serve
+    root = str(tmp_path / "store")
+    d1 = serve.Daemon(port=0, store_root=root).start()
+    url = f"http://127.0.0.1:{d1.port}"
+    code, r = _http(url, "POST", "/session",
+                    {"model": "cas-register", "tenant": "tt"})
+    assert code == 201
+    sid = r["session"]
+    hist = fixtures.gen_history("cas", n_ops=15, processes=2, seed=8)
+    code, _ = _http(url, "POST", f"/session/{sid}/append",
+                    {"history": [op.to_dict() for op in hist],
+                     "seq": 1})
+    assert code == 200
+    d1.httpd.server_close()
+    d1.dispatcher.stop()
+    t_restart = _time.monotonic()
+    d2 = serve.Daemon(port=0, store_root=root,
+                      session_idle_ttl_s=3600.0).start()
+    try:
+        sess = d2.sessions.get(sid)
+        assert sess is not None and not sess.closed
+        assert sess.last_active_mono >= t_restart
+        assert d2.expire_idle_sessions() == 0
+    finally:
+        d2.shutdown()

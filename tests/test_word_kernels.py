@@ -1,0 +1,455 @@
+"""Bit-parallel kernel bodies (ISSUE 13): the word-packed txn closure
+(one-shot + incremental, across regrowths and mesh tiling) and the
+word-packed post-hoc returns walk (single-history + lockstep batch,
+multi-word M > 32) differentially pinned bit-identical to the f32 /
+dense einsum bodies and the host references, plus the forced-failure
+exactly-one-fallback contracts and the packing-unit round-trips.
+
+Host-only: everything runs under JAX_PLATFORMS=cpu — the word bodies
+are the same XLA programs the device runs."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures, models, obs, txn
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers import preproc_native, reach, reach_word
+from jepsen_tpu.txn import cycles, host_ref
+from jepsen_tpu.txn.infer import DepGraph
+
+needs_native = pytest.mark.skipif(
+    not preproc_native.available(),
+    reason="native monitor core unavailable")
+
+
+def _rand_graph(n: int, e: int, seed: int) -> DepGraph:
+    r = np.random.default_rng(seed)
+    src = r.integers(0, n, e).astype(np.int32)
+    dst = r.integers(0, n, e).astype(np.int32)
+    keep = src != dst
+    return DepGraph(n=n, src=src[keep], dst=dst[keep],
+                    et=r.integers(0, 3, int(keep.sum()))
+                    .astype(np.int8), txns=tuple(range(n)))
+
+
+# -- packing units ----------------------------------------------------------
+
+@pytest.mark.parametrize("S,M", [(3, 8), (6, 32), (6, 64), (9, 256)])
+def test_pack_unpack_words_round_trip(S, M):
+    r = np.random.default_rng(S * M)
+    R = r.random((S, M)) < 0.3
+    words = reach_word.pack_words(R)
+    assert words.dtype == np.uint32
+    assert words.shape == (S, max(1, M // 32))
+    np.testing.assert_array_equal(reach_word.unpack_words(words, M), R)
+
+
+def test_table_from_P_inverts_one_hot():
+    """``table_from_P`` recovers the flat transition table from the
+    per-op transition-matrix tensor the lockstep seams carry."""
+    S, O = 4, 3
+    T = np.array([[1, -1, 3],
+                  [2, 0, -1],
+                  [-1, -1, -1],
+                  [3, 2, 1]], np.int32)
+    P = np.zeros((O, S, S), np.float32)
+    for s in range(S):
+        for o in range(O):
+            if T[s, o] >= 0:
+                P[o, s, T[s, o]] = 1.0
+    np.testing.assert_array_equal(reach_word.table_from_P(P), T)
+
+
+def test_closure_pack_rows_layout():
+    a = np.zeros((2, 64), bool)
+    a[0, 0] = a[0, 33] = a[1, 63] = True
+    w = cycles._pack_rows(a)
+    assert w.shape == (2, 2) and w.dtype == np.uint32
+    assert w[0, 0] == 1 and w[0, 1] == (1 << 1)
+    assert w[1, 1] == np.uint32(1 << 31)
+
+
+# -- word-packed txn closure: one-shot --------------------------------------
+
+@pytest.mark.parametrize("kind", fixtures.TXN_ANOMALY_KINDS)
+def test_word_closure_injected_anomaly_differential(kind):
+    """The word body, the f32 body, and the host SCC reference answer
+    identically — anomalies AND witness — on injected-anomaly
+    histories, and the word body actually decided the default run."""
+    hist = fixtures.gen_txn_history(40, seed=5) + \
+        [o.with_(index=-1) for o in fixtures.txn_anomaly_block(kind)]
+    with obs.capture() as cap:
+        word = txn.check_history(hist)
+    assert cap.counters.get("txn.closure.word") == 1
+    assert not cap.fallbacks()
+    os.environ["JEPSEN_TPU_NO_WORD_CLOSURE"] = "1"
+    try:
+        f32 = txn.check_history(hist)
+    finally:
+        os.environ.pop("JEPSEN_TPU_NO_WORD_CLOSURE", None)
+    host = txn.check_history(hist, force_host=True)
+    assert word["anomalies"] == f32["anomalies"] == host["anomalies"]
+    assert kind in word["anomalies"]
+    assert word["witness"] == f32["witness"] == host["witness"]
+    assert word["valid"] == f32["valid"] == host["valid"]
+
+
+def test_word_closure_random_graph_booleans():
+    """closure_booleans on random graphs: word body == f32 body ==
+    host classify_booleans, across densities (incl. edge-free and
+    near-complete)."""
+    for n, e, seed in ((5, 0, 0), (17, 20, 1), (40, 90, 2),
+                       (64, 500, 3), (90, 4000, 4)):
+        g = _rand_graph(n, max(e, 1), seed)
+        word = cycles.closure_booleans(g)
+        os.environ["JEPSEN_TPU_NO_WORD_CLOSURE"] = "1"
+        try:
+            f32 = cycles.closure_booleans(g)
+        finally:
+            os.environ.pop("JEPSEN_TPU_NO_WORD_CLOSURE", None)
+        ref = host_ref.classify_booleans(g)
+        assert word == f32 == ref, (n, e, seed)
+
+
+def test_word_closure_opt_out_routes_f32():
+    hist = fixtures.gen_txn_history(30, seed=6)
+    os.environ["JEPSEN_TPU_NO_WORD_CLOSURE"] = "1"
+    try:
+        with obs.capture() as cap:
+            res = txn.check_history(hist)
+    finally:
+        os.environ.pop("JEPSEN_TPU_NO_WORD_CLOSURE", None)
+    assert res["valid"] is True
+    assert "txn.closure.word" not in cap.counters
+    assert cap.counters.get("txn.closure.device") == 1
+    assert not cap.fallbacks()
+
+
+def test_word_closure_forced_failure_exactly_one_fallback(monkeypatch):
+    """A word-body death records exactly ONE ``word-closure`` obs
+    fallback and the f32 einsum body decides the same verdict — never
+    a silent downgrade, never a double record."""
+    hist = fixtures.gen_txn_history(25, seed=8) + \
+        [o.with_(index=-1)
+         for o in fixtures.txn_anomaly_block("G-single")]
+    ref = txn.check_history(hist, force_host=True)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected word-closure failure")
+
+    monkeypatch.setattr(cycles, "_word_closure_booleans", boom)
+    with obs.capture() as cap:
+        res = txn.check_history(hist)
+    fbs = [f for f in cap.fallbacks() if f["stage"] == "word-closure"]
+    assert len(fbs) == 1 and fbs[0]["cause"] == "RuntimeError"
+    assert res["engine"] == "txn-mxu"          # f32 body, same engine
+    assert cap.counters.get("txn.closure.device") == 1
+    assert res["anomalies"] == ref["anomalies"]
+    assert res["witness"] == ref["witness"]
+
+
+def test_word_closure_vs_mesh_tiled():
+    """The word body and the mesh-tiled f32 closure (devices > 1)
+    answer identically — the tiling seam and the packing seam must
+    not drift."""
+    import jax
+    devs = jax.devices()[:4]
+    if len(devs) < 2:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    for kind in ("G0", "G-single"):
+        hist = fixtures.gen_txn_history(30, seed=11) + \
+            [o.with_(index=-1)
+             for o in fixtures.txn_anomaly_block(kind)]
+        word = txn.check_history(hist)
+        tiled = txn.check_history(hist, devices=devs)
+        assert tiled["engine"] == "txn-mxu-tiled"
+        assert word["anomalies"] == tiled["anomalies"]
+        assert word["witness"] == tiled["witness"]
+
+
+# -- word-packed txn closure: incremental -----------------------------------
+
+def _inc_blocks(seed: int, steps: int = 6, grow: int = 7):
+    rng = np.random.RandomState(seed)
+    edges: list = []
+    for step in range(steps):
+        n = 5 + step * grow
+        k = rng.randint(3, 9)
+        new = [(int(rng.randint(0, n)), int(rng.randint(0, n)),
+                int(rng.randint(0, 3))) for _ in range(k)]
+        fresh = [e for e in new
+                 if e[0] != e[1] and e not in set(edges)]
+        edges.extend(fresh)
+        yield n, fresh, list(edges)
+
+
+def test_incremental_word_matches_host_across_regrowths():
+    """Per-block packed incremental closure booleans equal the host
+    SCC reference at every step, across TWO geometry regrowths
+    (Np 32 -> 64, word-floor padding)."""
+    clo = cycles.IncrementalClosure()
+    assert clo.packed is True
+    for n, fresh, edges in _inc_blocks(3):
+        b = clo.add_block(
+            n, np.asarray([e[0] for e in fresh], np.int32),
+            np.asarray([e[1] for e in fresh], np.int32),
+            np.asarray([e[2] for e in fresh], np.int32))
+        g = DepGraph(
+            n=n, src=np.asarray([e[0] for e in edges], np.int32),
+            dst=np.asarray([e[1] for e in edges], np.int32),
+            et=np.asarray([e[2] for e in edges], np.int8), txns=())
+        assert b == host_ref.classify_booleans(g), n
+    assert clo.Np >= 64 and clo.Np % 32 == 0
+
+
+def test_incremental_word_vs_f32_block_sequence(monkeypatch):
+    """The packed and f32 incremental bodies walk the same block
+    sequence to identical booleans at every step (the body is pinned
+    at construction; a session must never flip formats mid-stream)."""
+    clo_w = cycles.IncrementalClosure()
+    monkeypatch.setenv("JEPSEN_TPU_NO_WORD_CLOSURE", "1")
+    clo_f = cycles.IncrementalClosure()
+    monkeypatch.delenv("JEPSEN_TPU_NO_WORD_CLOSURE")
+    assert clo_w.packed and not clo_f.packed
+    with obs.capture() as cap:
+        for n, fresh, _edges in _inc_blocks(9, steps=5):
+            src = np.asarray([e[0] for e in fresh], np.int32)
+            dst = np.asarray([e[1] for e in fresh], np.int32)
+            et = np.asarray([e[2] for e in fresh], np.int32)
+            assert clo_w.add_block(n, src, dst, et) \
+                == clo_f.add_block(n, src, dst, et), n
+    assert cap.counters.get("txn.closure.incremental_word", 0) >= 5
+
+
+# -- word-packed post-hoc walk ----------------------------------------------
+
+def _check_both_bodies(model, packed):
+    os.environ["JEPSEN_TPU_WORD_POSTHOC"] = "1"
+    try:
+        word = reach.check_packed(model, packed)
+    finally:
+        os.environ.pop("JEPSEN_TPU_WORD_POSTHOC", None)
+    os.environ["JEPSEN_TPU_NO_WORD_WALK"] = "1"
+    try:
+        dense = reach.check_packed(model, packed)
+    finally:
+        os.environ.pop("JEPSEN_TPU_NO_WORD_WALK", None)
+    return word, dense
+
+
+@pytest.mark.parametrize("kind,procs,seed,corrupt",
+                         [("cas", 4, 0, False), ("cas", 5, 1, True),
+                          ("register", 3, 2, True),
+                          ("cas", 8, 3, False), ("cas", 8, 4, True)])
+def test_word_posthoc_walk_differential(kind, procs, seed, corrupt):
+    """The word-packed post-hoc walk and the dense einsum walk are
+    the same check: verdict AND failing op identical across ragged
+    concurrency, corruption, and the multi-word (procs=8 -> M=256)
+    geometry."""
+    hist = fixtures.gen_history(kind, n_ops=220, processes=procs,
+                                seed=seed)
+    if corrupt:
+        hist = fixtures.corrupt(hist, seed=seed + 50)
+    model = (models.cas_register() if kind == "cas"
+             else models.register())
+    packed = h.pack(h.index(hist))
+    word, dense = _check_both_bodies(model, packed)
+    assert word["engine"] == "reach-word"
+    assert word["valid"] == dense["valid"]
+    assert word.get("op") == dense.get("op")
+    if corrupt:
+        assert word["valid"] is False
+
+
+def test_word_posthoc_walk_crash_ops_differential():
+    """info (crashed) ops leave open invocations — the pending-slot
+    accounting the word fire algebra must mirror exactly."""
+    hist = fixtures.gen_history("cas", n_ops=200, processes=5,
+                                seed=13, crash_p=0.015)
+    model = models.cas_register()
+    packed = h.pack(h.index(hist))
+    word, dense = _check_both_bodies(model, packed)
+    assert word["engine"] == "reach-word"
+    assert word["valid"] == dense["valid"]
+    assert word.get("op") == dense.get("op")
+
+
+def test_word_posthoc_multiword_runs_without_x64():
+    """M > 32 (W > 5) runs word-packed WITHOUT x64 mode — the retired
+    uint64 body needed it; the uint32 word vectors must not."""
+    import jax
+    assert not jax.config.jax_enable_x64
+    hist = fixtures.gen_history("cas", n_ops=400, processes=8,
+                                seed=3)
+    model = models.cas_register()
+    packed = h.pack(h.index(hist))
+    memo, stream, _T, _S_pad, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    assert M > 32 and reach_word.n_words(M) > 1
+    with obs.capture() as cap:
+        word, dense = _check_both_bodies(model, packed)
+    assert word["engine"] == "reach-word"
+    assert cap.counters.get("reach.word_walk") == 1
+    assert word["valid"] == dense["valid"]
+
+
+def test_word_posthoc_forced_failure_exactly_one_fallback(monkeypatch):
+    """A word-walk death re-enters the dense/pallas chain with
+    exactly ONE ``word-walk`` obs record; the verdict is the dense
+    body's."""
+    hist = fixtures.corrupt(fixtures.gen_history(
+        "cas", n_ops=180, processes=4, seed=7), seed=9)
+    model = models.cas_register()
+    packed = h.pack(h.index(hist))
+    _word, dense = _check_both_bodies(model, packed)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected word-walk failure")
+
+    monkeypatch.setenv("JEPSEN_TPU_WORD_POSTHOC", "1")
+    monkeypatch.setattr(reach_word, "walk_returns_words", boom)
+    with obs.capture() as cap:
+        res = reach.check_packed(model, packed)
+    fbs = [f for f in cap.fallbacks() if f["stage"] == "word-walk"]
+    assert len(fbs) == 1 and fbs[0]["cause"] == "RuntimeError"
+    assert res["engine"] != "reach-word"
+    assert res["valid"] == dense["valid"]
+    assert res.get("op") == dense.get("op")
+
+
+def test_word_walk_witness_attached_on_violation():
+    """A word-decided violation still carries the witness/refutation
+    the dense path attaches (the serving layer and web UI consume
+    it)."""
+    hist = fixtures.corrupt(fixtures.gen_history(
+        "cas", n_ops=150, processes=4, seed=17), seed=4)
+    model = models.cas_register()
+    packed = h.pack(h.index(hist))
+    word, dense = _check_both_bodies(model, packed)
+    assert word["valid"] is False and word["engine"] == "reach-word"
+    assert word.get("op") == dense.get("op")
+    for k in ("witness",):
+        assert (k in word) == (k in dense)
+
+
+# -- word-packed lockstep batch body ----------------------------------------
+
+def _force_lockstep(monkeypatch):
+    """Route check_many's lockstep lane on CPU (the
+    test_independent_lockstep idiom): pallas gates open, return floor
+    off, the dense batch kernel in interpret mode (the word body
+    needs no interpret — it is plain jnp — but the dense reference
+    and any fallback do)."""
+    from jepsen_tpu.checkers import reach_batch
+    monkeypatch.setattr(reach, "_use_pallas", lambda: True)
+    monkeypatch.setattr(reach, "_PALLAS_MIN_RETURNS", 0)
+    monkeypatch.setattr(reach_batch, "_INTERPRET_DEFAULT", True)
+
+
+@needs_native
+def test_lockstep_word_body_matches_dense(monkeypatch):
+    """check_many on the lockstep lane with the word body forced per
+    group vs the default dense Pallas kernel: per-history verdicts
+    and failing ops identical across ragged lengths + corruption."""
+    _force_lockstep(monkeypatch)
+    model = models.cas_register()
+    hists = []
+    for i, n in enumerate((60, 110, 75, 140, 90, 60)):
+        hist = fixtures.gen_history("cas", n_ops=n, processes=4,
+                                    seed=100 + i)
+        if i % 3 == 1:
+            hist = fixtures.corrupt(hist, seed=i)
+        hists.append(h.pack(h.index(hist)))
+    monkeypatch.setenv("JEPSEN_TPU_WORD_POSTHOC", "1")
+    with obs.capture() as cap:
+        word = reach.check_many(model, hists)
+    assert cap.counters.get("lockstep.word_groups", 0) >= 1
+    monkeypatch.delenv("JEPSEN_TPU_WORD_POSTHOC")
+    monkeypatch.setenv("JEPSEN_TPU_NO_WORD_WALK", "1")
+    dense = reach.check_many(model, hists)
+    assert [r["valid"] for r in word] == [r["valid"] for r in dense]
+    assert [r.get("op") for r in word] == [r.get("op")
+                                           for r in dense]
+    assert any(r["valid"] is False for r in word)
+
+
+@needs_native
+def test_lockstep_word_dispatch_failure_falls_to_dense(monkeypatch):
+    """A word-body dispatch death records exactly one ``word-walk``
+    fallback and the group walks the dense kernel — verdicts equal
+    the all-dense run."""
+    from jepsen_tpu.checkers import reach_batch
+
+    _force_lockstep(monkeypatch)
+    model = models.cas_register()
+    hists = [h.pack(h.index(fixtures.corrupt(
+        fixtures.gen_history("cas", n_ops=80, processes=3,
+                             seed=200 + i), seed=i)))
+             for i in range(4)]
+    monkeypatch.setenv("JEPSEN_TPU_NO_WORD_WALK", "1")
+    dense = reach.check_many(model, hists)
+    monkeypatch.delenv("JEPSEN_TPU_NO_WORD_WALK")
+
+    def boom(*a, **k):
+        raise RuntimeError("injected lockstep word failure")
+
+    monkeypatch.setenv("JEPSEN_TPU_WORD_POSTHOC", "1")
+    monkeypatch.setattr(reach_batch, "_dispatch_words", boom)
+    with obs.capture() as cap:
+        word = reach.check_many(model, hists)
+    fbs = [f for f in cap.fallbacks() if f["stage"] == "word-walk"]
+    assert len(fbs) >= 1
+    assert "lockstep.word_groups" not in cap.counters
+    assert [r["valid"] for r in word] == [r["valid"] for r in dense]
+    assert [r.get("op") for r in word] == [r.get("op")
+                                           for r in dense]
+
+
+# -- multi-word frontier carry (streaming seam) -----------------------------
+
+@needs_native
+def test_frontier_carry_multiword_wide_geometry(monkeypatch):
+    """A W > 5 stream (8 concurrent processes -> M = 256) carries a
+    word-vector frontier — the geometry that previously required x64
+    — and answers identically to the dense carry."""
+    from jepsen_tpu.serve.session import DeviceFrontierEngine
+
+    model = models.cas_register()
+    hist = fixtures.corrupt(fixtures.gen_history(
+        "cas", n_ops=320, processes=8, seed=31), seed=6)
+    blocks = [hist[i:i + 64] for i in range(0, len(hist), 64)]
+    results = []
+    for no_word in ("", "1"):
+        monkeypatch.setenv("JEPSEN_TPU_NO_WORD_WALK", no_word)
+        eng = DeviceFrontierEngine(model)
+        v = None
+        for b in blocks:
+            eng.feed_many(list(b))
+            v = v or eng.advance()
+        v = v or eng.advance(run_over=True)
+        if no_word == "" and eng._carry is not None:
+            assert eng._carry.words
+            assert eng._carry._nw == reach_word.n_words(
+                eng._carry.M)
+        results.append((v and v["op"], v and v["settled-returns"]))
+    assert results[0] == results[1]
+
+
+# -- fuzz wiring ------------------------------------------------------------
+
+def test_fuzz_tool_word_trials():
+    """tools/fuzz.py --word wiring: a handful of word-vs-dense
+    post-hoc trials come back clean (and the txn trials now
+    triple-check word/f32/host)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "fuzz.py")
+    spec = importlib.util.spec_from_file_location("fuzz_word_test",
+                                                  path)
+    fuzz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fuzz)
+    assert fuzz.word_trials(4, seed=11) == []

@@ -11,9 +11,14 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 _BLOCK = 1024
 
@@ -360,12 +365,95 @@ VARIANTS = {
 }
 
 
+def body_sweep(ops: int, repeat: int, record: bool) -> int:
+    """Post-hoc KERNEL-BODY sweep (any backend, incl. XLA:CPU): the
+    word-packed walk (``reach_word``) vs the dense einsum walk on one
+    generated cas history, verdict-asserted identical, winner
+    PERSISTED as the autotune ``walk`` entry route selection
+    (``reach.check_packed``) consults. The Pallas variant ladder
+    below stays the on-chip microscope; this is the cross-body
+    decision the table exists for."""
+    import json as _json
+
+    import numpy as np
+
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import autotune, events as ev
+    from jepsen_tpu.checkers import reach, reach_word
+    from jepsen_tpu.history import pack
+
+    hist = fixtures.gen_history("cas", n_ops=ops, processes=5,
+                                seed=42)
+    model = models.cas_register()
+    packed = pack(hist)
+    memo, stream, _T, S_pad, M = reach._prep(
+        model, packed, max_states=100_000, max_slots=20,
+        max_dense=1 << 22)
+    W = max(stream.W, 1)
+    rs = ev.returns_view(stream)
+    n = rs.n_returns
+
+    def _one(body: str):
+        import os as _os
+        env = "JEPSEN_TPU_WORD_POSTHOC"
+        no_word = "JEPSEN_TPU_NO_WORD_WALK"
+        old = {k: _os.environ.pop(k, None) for k in (env, no_word)}
+        try:
+            if body == "word":
+                _os.environ[env] = "1"
+            else:
+                _os.environ[no_word] = "1"
+            res = reach.check_packed(model, packed)   # warm
+            best = float("inf")
+            for _ in range(max(1, repeat)):
+                t0 = time.monotonic()
+                res = reach.check_packed(model, packed)
+                best = min(best, time.monotonic() - t0)
+            return res, best
+        finally:
+            for k, v in old.items():
+                _os.environ.pop(k, None)
+                if v is not None:
+                    _os.environ[k] = v
+
+    res_w, t_word = _one("word")
+    res_d, t_dense = _one("dense")
+    assert res_w["valid"] == res_d["valid"], (res_w, res_d)
+    winner = "word" if t_word <= t_dense else "dense"
+    row = {"geometry": {"S": memo.n_states, "W": W, "M": M,
+                        "returns": int(n)},
+           "word_s": round(t_word, 4), "dense_s": round(t_dense, 4),
+           "winner": winner,
+           "speedup": round(t_dense / max(t_word, 1e-9), 2),
+           "word_engine": res_w.get("engine"),
+           "dense_engine": res_d.get("engine")}
+    if record:
+        row["recorded"] = autotune.record(
+            "walk", autotune.walk_key(memo.n_states, W, M, n), winner,
+            metric=n / max(min(t_word, t_dense), 1e-9),
+            detail={"word_s": row["word_s"],
+                    "dense_s": row["dense_s"]})
+    print(_json.dumps(row), flush=True)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", type=int, default=100_000)
     ap.add_argument("--variants", default=",".join(VARIANTS))
     ap.add_argument("--repeat", type=int, default=2)
+    ap.add_argument("--bodies", action="store_true",
+                    help="sweep the word-packed vs dense post-hoc "
+                         "kernel BODIES (any backend) and persist "
+                         "the winner in the autotune table instead "
+                         "of running the Pallas variant ladder")
+    ap.add_argument("--no-record", action="store_true",
+                    help="with --bodies: measure only, do not write "
+                         "the autotune table")
     args = ap.parse_args()
+    if args.bodies:
+        return body_sweep(args.ops, args.repeat,
+                          record=not args.no_record)
 
     import jax
     from jepsen_tpu import fixtures, models

@@ -102,6 +102,12 @@ def main() -> int:
                          "width ladder")
     ap.add_argument("--keys", type=int, default=12,
                     help="key count for --ragged")
+    ap.add_argument("--record", action="store_true",
+                    help="persist the winning lockstep width in the "
+                         "autotune table (the ``group`` winner the "
+                         "facade consults before the built-in "
+                         "default) — H=32-beats-H=64 folklore, "
+                         "measured instead of re-derived")
     args = ap.parse_args()
     if args.ragged:
         return ragged_sweep(args.ops, args.keys, args.repeat)
@@ -171,6 +177,16 @@ def main() -> int:
         }
         out.append(row)
         print(json.dumps(row), flush=True)
+    if args.record and out:
+        from jepsen_tpu.checkers import autotune
+        best = max(out, key=lambda r: r["agg_ops_s"])
+        path = autotune.record(
+            "group", "default", str(best["H"]),
+            metric=float(best["agg_ops_s"]),
+            detail={"widths": {str(r["H"]): r["agg_ops_s"]
+                               for r in out}})
+        print(json.dumps({"recorded": path, "group": best["H"]}),
+              flush=True)
     return 0
 
 

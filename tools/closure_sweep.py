@@ -1,0 +1,131 @@
+"""Closure-body sweep: measure the word-packed vs f32 txn closure
+across padded-geometry rungs and PERSIST the winners in the autotune
+table (``<store-root>/.cache/autotune.json``), so ``txn/cycles.py``
+route selection consults a measured record instead of re-deriving
+folklore per process.
+
+Each rung builds a random cyclic dependency graph at the target
+padded size, times both one-shot bodies warm (best of ``--repeat``),
+asserts their 4 booleans equal each other AND the host Tarjan/SCC
+reference (a sweep must never record a winner that changes
+verdicts), and records the winner under ``closure|<backend>|Np<n>``.
+
+Usage: python tools/closure_sweep.py [--rungs 64,256,1024]
+       [--repeat 3] [--no-record]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def rand_graph(n: int, e: int, seed: int):
+    from jepsen_tpu.txn.infer import DepGraph
+    r = np.random.default_rng(seed)
+    src = r.integers(0, n, e)
+    dst = r.integers(0, n, e)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    et = r.integers(0, 3, len(src)).astype(np.int8)
+    return DepGraph(n=n, src=src.astype(np.int32),
+                    dst=dst.astype(np.int32), et=et,
+                    txns=tuple(range(n)))
+
+
+def _time_body(graph, body: str, repeat: int) -> float:
+    from jepsen_tpu.txn import cycles
+    env = "JEPSEN_TPU_NO_WORD_CLOSURE"
+    at = "JEPSEN_TPU_NO_AUTOTUNE"
+    old = os.environ.pop(env, None)
+    old_at = os.environ.pop(at, None)
+    try:
+        # a previously-recorded winner must not steer the arm being
+        # measured (with the table live, a recorded "f32" makes the
+        # "word" arm silently time f32 against itself)
+        os.environ[at] = "1"
+        if body == "f32":
+            os.environ[env] = "1"
+        cycles.closure_booleans(graph)              # warm/compile
+        best = float("inf")
+        for _ in range(max(1, repeat)):
+            t0 = time.monotonic()
+            cycles.closure_booleans(graph)
+            best = min(best, time.monotonic() - t0)
+        return best
+    finally:
+        os.environ.pop(env, None)
+        os.environ.pop(at, None)
+        if old is not None:
+            os.environ[env] = old
+        if old_at is not None:
+            os.environ[at] = old_at
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rungs", default="64,256,1024",
+                    help="comma-separated graph sizes (each pads to "
+                         "its power-of-two closure geometry)")
+    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--edges-per-node", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--no-record", action="store_true",
+                    help="measure + differential only; do not write "
+                         "the autotune table")
+    args = ap.parse_args()
+
+    from jepsen_tpu.checkers import autotune
+    from jepsen_tpu.txn import cycles, host_ref
+
+    rc = 0
+    for n in (int(x) for x in args.rungs.split(",")):
+        g = rand_graph(n, max(1, int(n * args.edges_per_node)),
+                       args.seed)
+        # verdict identity first: a body sweep that records a winner
+        # with different booleans would be poisoning route selection
+        ref = host_ref.classify_booleans(g)
+        os.environ["JEPSEN_TPU_NO_AUTOTUNE"] = "1"
+        try:
+            word_b = cycles.closure_booleans(g)
+            os.environ["JEPSEN_TPU_NO_WORD_CLOSURE"] = "1"
+            try:
+                f32_b = cycles.closure_booleans(g)
+            finally:
+                os.environ.pop("JEPSEN_TPU_NO_WORD_CLOSURE", None)
+        finally:
+            os.environ.pop("JEPSEN_TPU_NO_AUTOTUNE", None)
+        if not (word_b == f32_b == ref):
+            print(json.dumps({"rung": n, "error": "verdict mismatch",
+                              "word": word_b, "f32": f32_b,
+                              "host": ref}), flush=True)
+            rc = 1
+            continue
+        t_word = _time_body(g, "word", args.repeat)
+        t_f32 = _time_body(g, "f32", args.repeat)
+        winner = "word" if t_word <= t_f32 else "f32"
+        row = {"rung": n, "Np": cycles._pad_n(g.n),
+               "edges": int(g.e),
+               "word_s": round(t_word, 5), "f32_s": round(t_f32, 5),
+               "winner": winner,
+               "speedup": round(t_f32 / max(t_word, 1e-9), 2)}
+        if not args.no_record:
+            path = autotune.record(
+                "closure", autotune.closure_key(g.n), winner,
+                metric=1.0 / max(min(t_word, t_f32), 1e-9),
+                detail={"word_s": row["word_s"],
+                        "f32_s": row["f32_s"]})
+            row["recorded"] = path
+        print(json.dumps(row), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

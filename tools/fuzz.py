@@ -376,14 +376,21 @@ def txn_trials(k: int, seed: int) -> list:
             injected = rng.choice(fixtures.TXN_ANOMALY_KINDS)
             h = h + [op.with_(index=-1) for op in
                      fixtures.txn_anomaly_block(injected)]
-        dev = txn.check_history(h)
+        dev = txn.check_history(h)               # word-packed default
+        os.environ["JEPSEN_TPU_NO_WORD_CLOSURE"] = "1"
+        try:
+            f32 = txn.check_history(h)           # f32 fallback body
+        finally:
+            os.environ.pop("JEPSEN_TPU_NO_WORD_CLOSURE", None)
         host = txn.check_history(h, force_host=True)
         entry = {"trial": t, "seed": s, "injected": injected,
                  "device": dev.get("anomalies"),
+                 "f32": f32.get("anomalies"),
                  "host": host.get("anomalies"),
                  "engine": dev.get("engine")}
-        ok = (dev.get("valid") == host.get("valid")
+        ok = (dev.get("valid") == host.get("valid") == f32.get("valid")
               and dev.get("anomalies") == host.get("anomalies")
+              and f32.get("anomalies") == host.get("anomalies")
               and dev.get("witness") == host.get("witness"))
         if injected is not None:
             ok = ok and injected in (dev.get("anomalies") or ())
@@ -392,6 +399,65 @@ def txn_trials(k: int, seed: int) -> list:
             print(f"TXN MISMATCH {entry}", file=sys.stderr)
         if t % 25 == 24:
             print(f"txn {t + 1}/{k} ok "
+                  f"({time.monotonic() - t0:.0f}s)", flush=True)
+    return bad
+
+
+def word_trials(k: int, seed: int) -> list:
+    """Word-packed post-hoc walk differential: ``k`` random register
+    histories (the :func:`trial_params` mix — ragged concurrency,
+    crashes, injected violations) checked with the word body FORCED
+    (``JEPSEN_TPU_WORD_POSTHOC=1``) vs the dense body
+    (``JEPSEN_TPU_NO_WORD_WALK=1``): verdicts and failing ops must be
+    identical. Returns mismatch dicts (empty = clean)."""
+    import random as _random
+
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import reach
+    from jepsen_tpu.history import index, pack
+
+    rng = _random.Random(seed)
+    bad = []
+    t0 = time.monotonic()
+    for t in range(k):
+        s = rng.randrange(1 << 30)
+        kind = rng.choice(("cas", "register"))
+        n_ops = rng.randrange(60, 500)
+        procs = rng.randrange(2, 9)
+        h = fixtures.gen_history(kind, n_ops=n_ops, processes=procs,
+                                 seed=s)
+        if rng.random() < 0.5:
+            try:
+                h = fixtures.corrupt(h, seed=s)
+            except ValueError:
+                pass
+        packed = pack(index(h))
+        model = (models.cas_register() if kind == "cas"
+                 else models.register())
+        os.environ["JEPSEN_TPU_WORD_POSTHOC"] = "1"
+        try:
+            word = reach.check_packed(model, packed)
+        finally:
+            os.environ.pop("JEPSEN_TPU_WORD_POSTHOC", None)
+        os.environ["JEPSEN_TPU_NO_WORD_WALK"] = "1"
+        try:
+            dense = reach.check_packed(model, packed)
+        finally:
+            os.environ.pop("JEPSEN_TPU_NO_WORD_WALK", None)
+        ok = (word.get("valid") == dense.get("valid")
+              and word.get("op") == dense.get("op"))
+        if not ok:
+            entry = {"trial": t, "seed": s, "kind": kind,
+                     "word": {"valid": word.get("valid"),
+                              "op": word.get("op"),
+                              "engine": word.get("engine")},
+                     "dense": {"valid": dense.get("valid"),
+                               "op": dense.get("op"),
+                               "engine": dense.get("engine")}}
+            bad.append(entry)
+            print(f"WORD MISMATCH {entry}", file=sys.stderr)
+        if t % 50 == 49:
+            print(f"word {t + 1}/{k} ok "
                   f"({time.monotonic() - t0:.0f}s)", flush=True)
     return bad
 
@@ -412,8 +478,13 @@ def main() -> int:
     ap.add_argument("--txn", type=int, default=0, metavar="K",
                     help="additionally run K transactional-checker "
                          "trials (random list-append histories with "
-                         "injected ww/wr/rw cycles; device closure vs "
-                         "host SCC every trial)")
+                         "injected ww/wr/rw cycles; word-packed "
+                         "closure vs f32 body vs host SCC every "
+                         "trial)")
+    ap.add_argument("--word", type=int, default=0, metavar="K",
+                    help="additionally run K word-packed post-hoc "
+                         "walk trials (forced word body vs dense "
+                         "body; verdict + failing-op identity)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -437,6 +508,9 @@ def main() -> int:
         txn_bad: list = []
         if args.txn:
             txn_bad = txn_trials(args.txn, args.seed + 777)
+        word_bad: list = []
+        if args.word:
+            word_bad = word_trials(args.word, args.seed + 4242)
     # observability over the whole fuzz session: silent-degradation
     # counters (pallas → XLA downgrades, swallowed checker crashes,
     # lockstep → per-key fallbacks) become greppable output instead of
@@ -453,12 +527,14 @@ def main() -> int:
         "chunklock_mismatches": len(ckl_bad),
         "txn_trials": args.txn,
         "txn_mismatches": len(txn_bad),
+        "word_trials": args.word,
+        "word_mismatches": len(word_bad),
         "swallowed_checker_crashes": sum(
             v for k, v in cap.counters.items()
             if k.startswith("checker.swallowed.")),
         "obs": obs_counters,
         "elapsed_s": round(time.monotonic() - t0, 1)}))
-    return 1 if (mismatches or ckl_bad or txn_bad) else 0
+    return 1 if (mismatches or ckl_bad or txn_bad or word_bad) else 0
 
 
 if __name__ == "__main__":
