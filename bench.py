@@ -407,7 +407,69 @@ def serve_probe(quick: bool = True) -> dict:
     out["pad_waste_s"] = stats.get("counters", {}).get(
         "serve.pad_waste_s")
     out["device_s"] = stats.get("counters", {}).get("serve.device_s")
+    # the fleet rung (ISSUE 15): two replica daemons over ONE shared
+    # store root, loadgen round-robin across both, scaling efficiency
+    # against the single-daemon sustained rate measured above
+    try:
+        out["fleet"] = _fleet_serve_probe(
+            loadgen, baseline=out.get("sustained_req_s"), quick=quick)
+    except Exception as e:                              # noqa: BLE001
+        out["fleet"] = {"error": f"{type(e).__name__}: {e}"}
     return out
+
+
+def _fleet_serve_probe(loadgen, *, baseline, quick=True) -> dict:
+    """Spawn 2 ``check-serve`` replica subprocesses over one store
+    root (reusing the chaos harness's process manager), drive
+    loadgen's client-side round-robin at them, and report the merged
+    throughput + scaling efficiency + per-replica lease counters
+    (claims prove the shared-journal partition actually engaged)."""
+    import importlib.util
+    import os
+    import shutil
+    import tempfile
+
+    cpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "chaos.py")
+    spec = importlib.util.spec_from_file_location("bench_chaos", cpath)
+    chaos = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos)
+    root = tempfile.mkdtemp(prefix="bench-fleet-")
+    procs = [chaos.DaemonProc(
+        root, faults_env="",
+        log_path=os.path.join(root, f"r{i}.log"),
+        extra_args=["--replica-id", f"r{i}",
+                    "--lease-ttl", "10.0", "--lanes", "2"])
+        for i in range(2)]
+    try:
+        rep = loadgen.run_loadgen({
+            "quick": quick,
+            "replicas": [p.url for p in procs],
+            "baseline_req_s": baseline})
+        fleet = dict(rep.get("fleet") or {})
+        for k in ("sustained_req_s", "p50_s", "p99_s", "submitted",
+                  "completed", "verdict_mismatches", "error"):
+            if rep.get(k) is not None:
+                fleet[k] = rep[k]
+        leases = {}
+        for i, p in enumerate(procs):
+            code, st = loadgen._get(p.url, "/stats")
+            if code == 200:
+                leases[f"r{i}"] = {
+                    k: v for k, v in st.get("counters", {}).items()
+                    if k.startswith("serve.lease.")}
+        fleet["lease_counters"] = leases
+        return fleet
+    finally:
+        for p in procs:
+            try:
+                p.sigterm()
+            except Exception:                           # noqa: BLE001
+                try:
+                    p.sigkill()
+                except Exception:                       # noqa: BLE001
+                    pass
+        shutil.rmtree(root, ignore_errors=True)
 
 
 def session_probe(n_ops: int = 100_000, seed: int = 42,

@@ -161,7 +161,10 @@ def _cmd_check_serve(args) -> int:
             cooldown_s=args.breaker_cooldown),
         dispatch_deadline_s=args.dispatch_deadline or None,
         session_tenant_cap=args.session_tenant_cap,
-        session_idle_ttl_s=args.session_idle_ttl or None)
+        session_idle_ttl_s=args.session_idle_ttl or None,
+        lanes=args.lanes,
+        replica_id=args.replica_id or None,
+        lease_ttl_s=args.lease_ttl)
 
     def _term(signum, frame):
         # SIGTERM == the orchestrator's polite stop: drain, then exit
@@ -365,6 +368,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      help="force-close open sessions idle this many "
                           "seconds (exact close verdict + journal "
                           "marker; 0 = never)")
+    csp.add_argument("--lanes", type=int, default=1,
+                     help="dispatcher lanes (one dispatch thread + "
+                          "circuit breaker each); match the device "
+                          "count to keep every accelerator busy")
+    csp.add_argument("--replica-id", default="",
+                     help="fleet mode: unique name of this replica; "
+                          "N daemons with distinct ids over one "
+                          "--store-root partition the journal by "
+                          "per-entry lease (empty = single daemon)")
+    csp.add_argument("--lease-ttl", type=float, default=10.0,
+                     help="fleet lease time-to-live in seconds; a "
+                          "dead replica's work drains to survivors "
+                          "after this long")
     csp.set_defaults(fn=_cmd_check_serve)
 
     ckp = sub.add_parser(

@@ -15,17 +15,23 @@ Layers (one module each):
   serve ledgers.
 - :mod:`coalesce` — bounded admission queue, ``plan_buckets``-based
   geometry coalescing, oldest-tenant-first fairness, per-tenant
-  in-flight caps, queue-side deadline expiry. Pure host-side.
-- :mod:`engine` — the dispatcher thread feeding
-  ``facade.auto_check_packed`` / ``auto_check_many_packed`` (whose
-  batch route is the streaming lockstep scheduler), deadline/cancel
-  abort hooks, optional store persistence, stats.
+  in-flight caps, queue-side deadline expiry, and lane placement
+  (ready groups land on dispatch lanes round-robin, least-loaded on
+  ties). Pure host-side.
+- :mod:`engine` — N dispatcher LANES (one thread + circuit breaker
+  each) feeding ``facade.auto_check_packed`` /
+  ``auto_check_many_packed`` (whose batch route is the streaming
+  lockstep scheduler), deadline/cancel abort hooks, optional store
+  persistence, per-lane device-time attribution, stats.
 - :mod:`http` — the stdlib HTTP protocol (``POST /check``,
   ``GET /check/<id>``, ``GET /stats``) and the :class:`Daemon`
   composition root.
 - :mod:`journal` — the durable admission journal (WAL): admitted
   requests survive SIGKILL, replay on restart under their original
-  ids, and dedup duplicate POSTs by idempotency key.
+  ids, and dedup duplicate POSTs by idempotency key. In fleet mode
+  the journal also carries per-entry LEASES: N replica daemons over
+  one store root partition the pending work (claim/renew/steal), so
+  a SIGKILL'd replica's requests drain through the survivors.
 - :mod:`recovery` — deterministic bounded-backoff retry, group
   bisection (poison quarantine), and the device-path circuit
   breaker behind degraded host-side serving.
@@ -59,7 +65,8 @@ from jepsen_tpu.serve.recovery import CircuitBreaker, RetryPolicy
 from jepsen_tpu.serve.request import (CANCELLED, DISPATCHED, DONE,
                                       QUARANTINED, QUEUED, TIMEOUT,
                                       CheckRequest, Registry)
-from jepsen_tpu.serve.session import (DeviceFrontierEngine, Session,
+from jepsen_tpu.serve.session import (AdvanceAborted,
+                                      DeviceFrontierEngine, Session,
                                       SessionRegistry,
                                       TxnSessionEngine)
 
@@ -68,7 +75,7 @@ __all__ = [
     "Daemon", "parse_check_body", "resolve_model", "CheckRequest",
     "Registry", "Journal", "CircuitBreaker", "RetryPolicy",
     "Session", "SessionRegistry", "DeviceFrontierEngine",
-    "TxnSessionEngine",
+    "TxnSessionEngine", "AdvanceAborted",
     "QUEUED", "DISPATCHED", "DONE", "TIMEOUT", "CANCELLED",
     "QUARANTINED",
 ]

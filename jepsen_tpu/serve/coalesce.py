@@ -27,6 +27,18 @@ whose geometry it fits. The pieces:
 - **Deadlines**: requests whose deadline passes while queued are
   completed as ``timeout`` right here (fallback stage
   ``serve-timeout`` in the obs ledger) — they never waste a lane.
+- **Lane placement** (``lanes > 1``): with N dispatcher lanes (one
+  per device/device group, ``serve/engine.py``), each selected group
+  is placed onto the least-loaded lane, scanning from a round-robin
+  pointer so equal loads rotate — the multi-queue bookkeeping of
+  ``reach._LockstepDispatchState`` (``di = gi % n_dev`` plus
+  per-device group counts) lifted to the admission side. A group
+  placed on a busy sibling is *staged* for that lane; staged groups
+  are already marked in-flight, so the drain contract (depth==0 ∧
+  inflight=={}) still covers them. Session groups additionally
+  exclude their session from re-selection while one of its groups is
+  anywhere in flight: two lanes advancing one carried frontier would
+  reorder seq.
 
 Everything in this module is pure host-side bookkeeping — no jax, no
 device — so the scheduling policy is unit-testable in microseconds
@@ -36,6 +48,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 from jepsen_tpu import obs
@@ -103,18 +116,37 @@ class AdmissionQueue:
     pipelining, not by this queue). ``max_inflight_per_tenant`` caps
     how many of one tenant's requests may be walking on the device at
     once; requests over the cap simply stay queued for a later group.
+    ``lanes`` is the number of dispatcher consumers this queue feeds
+    (1 keeps the single-dispatcher behavior bit-identical).
     """
+
+    # jtlint lock discipline: every shared attribute — the queue, the
+    # tenant in-flight counts, and ALL lane-placement state — is only
+    # touched under the condition's lock (methods named *_locked are
+    # called with it held)
+    _GUARDED_BY = {"_nonempty": ("_queued", "_inflight", "_staged",
+                                 "_lane_load", "_rr",
+                                 "_inflight_sessions")}
 
     def __init__(self, max_depth: int = 256,
                  max_inflight_per_tenant: int = 8,
-                 group: int = 32) -> None:
+                 group: int = 32, lanes: int = 1) -> None:
         self.max_depth = int(max_depth)
         self.max_inflight_per_tenant = int(max_inflight_per_tenant)
         self.group = int(group)
-        self._lock = threading.Lock()
-        self._nonempty = threading.Condition(self._lock)
+        self.lanes = max(1, int(lanes))
+        self._nonempty = threading.Condition(threading.Lock())
         self._queued: List[rq.CheckRequest] = []
         self._inflight: Dict[str, int] = {}     # tenant -> walking now
+        # lane placement: per-lane staged (ready, placed, not yet
+        # picked up) groups + per-lane load (staged + dispatching
+        # groups) + the round-robin scan pointer + the set of session
+        # ids with a group anywhere in flight (seq-order guard)
+        self._staged: List["deque[List[rq.CheckRequest]]"] = \
+            [deque() for _ in range(self.lanes)]
+        self._lane_load: List[int] = [0] * self.lanes
+        self._rr = 0
+        self._inflight_sessions: set = set()
         self.on_timeout: Optional[Callable[[rq.CheckRequest], None]] = None
 
     # -- admission -------------------------------------------------------
@@ -136,7 +168,7 @@ class AdmissionQueue:
             self._queued.append(req)
             obs.count("serve.admitted")
             obs.gauge("serve.queue_depth", len(self._queued))
-            self._nonempty.notify()
+            self._nonempty.notify_all()
 
     def cancel(self, req_id: str) -> Optional["rq.CheckRequest"]:
         """Remove a still-queued request (client cancellation).
@@ -144,7 +176,7 @@ class AdmissionQueue:
         or unknown — dispatched requests cancel via their
         ``cancel_requested`` flag, observed by the group's abort
         hook)."""
-        with self._lock:
+        with self._nonempty:
             for i, r in enumerate(self._queued):
                 if r.id == req_id:
                     del self._queued[i]
@@ -153,12 +185,17 @@ class AdmissionQueue:
         return None
 
     def depth(self) -> int:
-        with self._lock:
+        with self._nonempty:
             return len(self._queued)
 
     def inflight(self) -> Dict[str, int]:
-        with self._lock:
+        with self._nonempty:
             return {t: n for t, n in self._inflight.items() if n > 0}
+
+    def lane_loads(self) -> List[int]:
+        """Per-lane load (staged + dispatching groups) — stats view."""
+        with self._nonempty:
+            return list(self._lane_load)
 
     # -- dispatch side ---------------------------------------------------
     def _expire_queued_locked(self, now: float
@@ -169,7 +206,8 @@ class AdmissionQueue:
                             if not r.expired(now)]
         return expired
 
-    def next_batch(self, timeout: Optional[float] = None
+    def next_batch(self, timeout: Optional[float] = None,
+                   lane: Optional[int] = None
                    ) -> List["rq.CheckRequest"]:
         """Block until work is available (or ``timeout`` elapses: empty
         list) and return ONE dispatch group, marked in-flight.
@@ -180,7 +218,16 @@ class AdmissionQueue:
         :func:`plan_admission` group (longest length bucket first —
         matching the lockstep scheduler's big-walk-first pipelining).
         Callers MUST pair every returned batch with
-        :meth:`mark_done`."""
+        :meth:`mark_done`.
+
+        ``lane`` identifies the calling dispatcher lane. ``None`` is
+        the single-consumer path (selection IS delivery — no placement
+        bookkeeping, the pre-lanes behavior). Lane consumers first
+        drain their own staged groups, then select fresh work: a fresh
+        group is placed (:meth:`_place_locked`) on the least-loaded
+        lane, which may be a SIBLING — then it is staged there and the
+        caller selects again, so a fast lane keeps feeding slow
+        siblings instead of idling."""
         deadline = (time.monotonic() + timeout
                     if timeout is not None else None)
         with self._nonempty:
@@ -188,33 +235,75 @@ class AdmissionQueue:
                 now = time.monotonic()
                 for r in self._expire_queued_locked(now):
                     self._timeout_queued(r)
+                if lane is not None and self._staged[lane]:
+                    return self._staged[lane].popleft()
                 batch = self._select_locked()
                 if batch:
+                    self._mark_selected_locked(batch, now)
+                    if lane is None:
+                        return batch
+                    target = self._place_locked()
+                    self._lane_load[target] += 1
                     for r in batch:
-                        self._inflight[r.tenant] = \
-                            self._inflight.get(r.tenant, 0) + 1
-                        # coalesce stamp: selected into a dispatch
-                        # group (the engine stamps t_dispatch when
-                        # the device call actually starts)
-                        r.t_coalesce = now
-                        r.status = rq.DISPATCHED
-                    obs.gauge("serve.queue_depth", len(self._queued))
-                    if len(batch) > 1:
-                        obs.count("serve.coalesced", len(batch))
-                    return batch
+                        r.lane = target
+                    if target == lane:
+                        return batch
+                    self._staged[target].append(batch)
+                    self._nonempty.notify_all()
+                    continue
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
                     return []
                 self._nonempty.wait(remaining)
 
+    def _mark_selected_locked(self, batch: List["rq.CheckRequest"],
+                              now: float) -> None:
+        """Move a freshly-selected group into in-flight accounting.
+        Done at SELECTION time (not pickup) so the drain contract —
+        depth==0 ∧ inflight=={} means nothing is pending — covers
+        groups staged for a busy lane too."""
+        for r in batch:
+            self._inflight[r.tenant] = \
+                self._inflight.get(r.tenant, 0) + 1
+            # coalesce stamp: selected into a dispatch group (the
+            # engine stamps t_dispatch when the device call starts)
+            r.t_coalesce = now
+            r.status = rq.DISPATCHED
+        if batch[0].session is not None:
+            self._inflight_sessions.add(batch[0].session.id)
+        obs.gauge("serve.queue_depth", len(self._queued))
+        if len(batch) > 1:
+            obs.count("serve.coalesced", len(batch))
+
+    def _place_locked(self) -> int:
+        """Pick the lane for a fresh group: least loaded, scanning
+        from the round-robin pointer so equal loads rotate lanes (the
+        ``reach._LockstepDispatchState`` multi-queue policy — strict
+        round-robin under uniform load, load-aware when a lane falls
+        behind on a long walk). The pointer advances past the winner."""
+        best = self._rr
+        for k in range(1, self.lanes):
+            di = (self._rr + k) % self.lanes
+            if self._lane_load[di] < self._lane_load[best]:
+                best = di
+        self._rr = (best + 1) % self.lanes
+        return best
+
     def _select_locked(self) -> List["rq.CheckRequest"]:
         if not self._queued:
             return []
-        # eligibility: per-tenant in-flight allowance, oldest first
+        # eligibility: per-tenant in-flight allowance, oldest first.
+        # A session with a group already in flight (on ANY lane) is
+        # skipped entirely: its carried frontier advances in seq
+        # order, so a second lane must not pick up block k+1 while
+        # block k is still walking.
         allowance: Dict[str, int] = {}
         eligible: List[rq.CheckRequest] = []
         for r in sorted(self._queued, key=lambda r: r.t_submit):
+            if r.session is not None \
+                    and r.session.id in self._inflight_sessions:
+                continue
             a = allowance.get(r.tenant)
             if a is None:
                 a = max(0, self.max_inflight_per_tenant
@@ -242,9 +331,11 @@ class AdmissionQueue:
                         if id(r) not in chosen]
         return batch
 
-    def mark_done(self, batch: Sequence["rq.CheckRequest"]) -> None:
-        """Release the batch's tenants' in-flight slots and wake the
-        dispatcher's next selection."""
+    def mark_done(self, batch: Sequence["rq.CheckRequest"],
+                  lane: Optional[int] = None) -> None:
+        """Release the batch's tenants' in-flight slots (and, for lane
+        consumers, the lane's load unit and the session's in-flight
+        exclusion) and wake the dispatchers' next selection."""
         with self._nonempty:
             for r in batch:
                 n = self._inflight.get(r.tenant, 0) - 1
@@ -252,7 +343,12 @@ class AdmissionQueue:
                     self._inflight[r.tenant] = n
                 else:
                     self._inflight.pop(r.tenant, None)
-            self._nonempty.notify()
+            if batch and batch[0].session is not None:
+                self._inflight_sessions.discard(batch[0].session.id)
+            if lane is not None and batch:
+                self._lane_load[lane] = \
+                    max(0, self._lane_load[lane] - 1)
+            self._nonempty.notify_all()
 
     def _timeout_queued(self, req: "rq.CheckRequest") -> None:
         obs.count("serve.timeout")

@@ -1,8 +1,19 @@
-"""The dispatcher: one long-lived thread between the admission queue
-and the device engines.
+"""The dispatcher: long-lived lane threads between the admission
+queue and the device engines.
 
-Each iteration takes ONE coalesced dispatch group from the queue and
-runs it through the same checker chains the CLI uses — so daemon
+The dispatcher runs N *lanes* (default 1), one thread per device or
+device group: the coalescer places each ready group onto a lane
+(round-robin, least-loaded tie-break — ``serve/coalesce.py``), so one
+daemon saturates a multi-chip mesh instead of serializing every group
+through one consumer. Per-lane state is isolated: each lane owns its
+own circuit breaker (a poisoned lane degrades to host-side serving
+alone; its siblings keep the device path) and its own device-ran
+attribution flag, so ``serve.device_s`` + ``serve.pad_waste_s`` ==
+dispatch wall holds per lane and in the per-lane
+``serve.lane.<k>.{device_s,pad_waste_s}`` sums.
+
+Each lane iteration takes ONE coalesced dispatch group from the queue
+and runs it through the same checker chains the CLI uses — so daemon
 verdicts are the standalone verdicts:
 
 - a group of one goes through :func:`facade.auto_check_packed` (the
@@ -119,6 +130,22 @@ class _TimeSeriesRing:
             return [dict(p) for p in self._points]
 
 
+class _Lane:
+    """One dispatch lane's isolated state. ``breaker`` is this lane's
+    own circuit breaker (cloned from the prototype's policy): device
+    failures on lane k open lane k's breaker only, so a poisoned lane
+    degrades to host-side serving while siblings keep the device
+    path. ``device_ran`` is the per-dispatch attribution flag — only
+    ever touched by this lane's own thread, no lock."""
+
+    def __init__(self, idx: int,
+                 breaker: recovery.CircuitBreaker) -> None:
+        self.idx = idx
+        self.breaker = breaker
+        self.device_ran = False
+        self.thread: Optional[threading.Thread] = None
+
+
 class Dispatcher:
     """Owns the dispatch thread. ``start()``/``stop()`` bracket the
     daemon's life; ``drain()`` waits for the queue to empty (tests,
@@ -131,7 +158,8 @@ class Dispatcher:
                  retry_policy: Optional[recovery.RetryPolicy] = None,
                  breaker: Optional[recovery.CircuitBreaker] = None,
                  dispatch_deadline_s: Optional[float] = None,
-                 journal: Optional[Any] = None) -> None:
+                 journal: Optional[Any] = None,
+                 lanes: int = 1) -> None:
         self.queue = queue
         self.registry = registry
         self.engine_kw = dict(engine_kw or {})
@@ -142,13 +170,21 @@ class Dispatcher:
         # breaker, and the hung-dispatch wall-clock cap past which the
         # group's should_abort fires and survivors requeue
         self.retry = retry_policy or recovery.RetryPolicy()
-        self.breaker = breaker or recovery.CircuitBreaker()
+        # per-lane breaker isolation: the passed breaker (or a fresh
+        # default) becomes lane 0's, and each further lane gets its
+        # own clone of the same policy — `self.breaker` stays the
+        # lane-0 alias for single-lane callers and existing tests
+        proto = breaker or recovery.CircuitBreaker()
+        self.lanes_n = max(1, int(lanes))
+        self._lanes = [_Lane(0, proto)]
+        for i in range(1, self.lanes_n):
+            self._lanes.append(_Lane(i, recovery.CircuitBreaker(
+                threshold=proto.threshold,
+                cooldown_s=proto.cooldown_s)))
+        self.breaker = proto
         self.dispatch_deadline_s = dispatch_deadline_s
         self.journal = journal          # durable WAL (set by Daemon)
         self.sessions = None            # SessionRegistry (set by Daemon)
-        # per-dispatch attribution flag, dispatcher-thread-only: did
-        # any engine attempt actually touch the device this iteration
-        self._device_ran = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.dispatch_counts: Dict[str, int] = {}
@@ -168,17 +204,25 @@ class Dispatcher:
         # warm the persistent caches once, before the first request
         from jepsen_tpu.checkers import reach
         reach._ensure_persistent_caches()
-        self._thread = threading.Thread(target=self._loop,
-                                        name="serve-dispatch",
-                                        daemon=True)
-        self._thread.start()
+        obs.gauge("serve.lanes", self.lanes_n)
+        for lane in self._lanes:
+            t = threading.Thread(target=self._loop, args=(lane,),
+                                 name=f"serve-dispatch-{lane.idx}",
+                                 daemon=True)
+            lane.thread = t
+            t.start()
+        # lane 0's thread doubles as the "is the dispatcher running"
+        # handle (drain() and older callers check it)
+        self._thread = self._lanes[0].thread
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
-        t = self._thread
-        if t is not None:
-            t.join(timeout)
+        end = time.monotonic() + timeout
+        for lane in self._lanes:
+            t = lane.thread
+            if t is not None:
+                t.join(max(0.1, end - time.monotonic()))
         # flush a still-open profiler capture: an armed profile that
         # never saw enough dispatches must not leave the trace
         # recording (and its promised capture dir empty) forever
@@ -202,19 +246,19 @@ class Dispatcher:
         return False
 
     # -- the loop --------------------------------------------------------
-    def _loop(self) -> None:
+    def _loop(self, lane: "_Lane") -> None:
         while not self._stop.is_set():
-            batch = self.queue.next_batch(timeout=0.1)
+            batch = self.queue.next_batch(timeout=0.1, lane=lane.idx)
             if not batch:
                 continue
             self._profile_maybe_start()
             try:
-                self._dispatch(batch)
+                self._dispatch(batch, lane)
             except Exception as e:                      # noqa: BLE001
                 # LAST-resort containment: the recovery ladder inside
                 # _dispatch handles engine failures; anything escaping
                 # it (bookkeeping bugs, injected tick faults) must not
-                # kill the dispatcher thread or strand the batch
+                # kill the lane thread or strand the batch
                 log.error("dispatch iteration crashed: %r", e,
                           exc_info=e)
                 obs.engine_fallback("serve-dispatch",
@@ -228,7 +272,7 @@ class Dispatcher:
                                                   f": {e}"},
                                      0.0, now)
             finally:
-                self.queue.mark_done(batch)
+                self.queue.mark_done(batch, lane=lane.idx)
                 obs.gauge("serve.inflight", 0)
                 self._profile_maybe_stop()
                 snap = obs.core.GLOBAL.snapshot()
@@ -340,11 +384,12 @@ class Dispatcher:
         return max(0, Hq - n_real)
 
     def _run_engine(self, batch: List["rq.CheckRequest"],
-                    kw: Dict[str, Any],
+                    kw: Dict[str, Any], lane: "_Lane",
                     feed_breaker: bool = True) -> List[Dict[str, Any]]:
-        """ONE engine attempt for the (sub)group: consult the circuit
-        breaker for the route, run it, feed the outcome back. Raises
-        on failure — recovery policy lives in :meth:`_run_recover`.
+        """ONE engine attempt for the (sub)group: consult the LANE's
+        circuit breaker for the route, run it, feed the outcome back.
+        Raises on failure — recovery policy lives in
+        :meth:`_run_recover`.
 
         ``feed_breaker=False`` (the bisect hunt's sub-attempts) still
         records SUCCESSES (they are honest evidence of device health)
@@ -357,17 +402,17 @@ class Dispatcher:
         # that crashes the checker on EVERY route; "device" models a
         # device-path outage (the breaker's food)
         faults.fire("dispatch", tenants=tenants)
-        if self.breaker.route() == "host":
+        if lane.breaker.route() == "host":
             obs.count("serve.breaker.degraded_dispatches")
             obs.decision("serve-breaker", "route", cause="host",
-                         lanes=len(batch))
+                         lanes=len(batch), lane=lane.idx)
             return self._run_host(batch, kw, fire_point=False)
         req0 = batch[0]
         try:
             faults.fire("device", tenants=tenants)
             # attribution flag: some device work ran this dispatch
             # iteration (even a failed attempt spent device time)
-            self._device_ran = True
+            lane.device_ran = True
             with obs.span("serve.dispatch",
                           model=req0.model_name, lanes=len(batch)):
                 if self._is_txn(req0.model):
@@ -388,9 +433,9 @@ class Dispatcher:
                         req0.model, packed_list, kw)[:len(batch)]
         except Exception:
             if feed_breaker:
-                self.breaker.record_failure()
+                lane.breaker.record_failure()
             raise
-        self.breaker.record_success()
+        lane.breaker.record_success()
         return results
 
     def _run_host(self, batch: List["rq.CheckRequest"],
@@ -424,7 +469,7 @@ class Dispatcher:
 
     def _run_recover(self, batch: List["rq.CheckRequest"],
                      kw: Dict[str, Any],
-                     retries_left: int,
+                     retries_left: int, lane: "_Lane",
                      top_level: bool = True) -> List[Dict[str, Any]]:
         """The recovery ladder: attempt → deterministic bounded-backoff
         retry → group bisect to corner the poison member → host-side
@@ -435,7 +480,7 @@ class Dispatcher:
         err: Optional[Exception] = None
         while True:
             try:
-                return self._run_engine(batch, kw,
+                return self._run_engine(batch, kw, lane,
                                         feed_breaker=top_level)
             except Exception as e:                      # noqa: BLE001
                 err = e
@@ -463,8 +508,10 @@ class Dispatcher:
             obs.decision("serve-retry", "bisect", lanes=len(batch),
                          cause=type(err).__name__)
             lo, hi = recovery.bisect(batch)
-            return self._run_recover(lo, kw, 0, top_level=False) \
-                + self._run_recover(hi, kw, 0, top_level=False)
+            return self._run_recover(lo, kw, 0, lane,
+                                     top_level=False) \
+                + self._run_recover(hi, kw, 0, lane,
+                                    top_level=False)
         # a singleton that failed its attempts: one last host-side
         # rescue (device flakiness must not quarantine an innocent
         # request), then quarantine with a structured error
@@ -482,7 +529,35 @@ class Dispatcher:
                      "cause": "quarantined",
                      "error": f"{type(e).__name__}: {e}"}]
 
-    def _dispatch_session(self, batch: List["rq.CheckRequest"]) -> None:
+    def _session_abort(self, t0: float):
+        """The session advance's ``should_abort`` hook: the dispatch
+        deadline applied to a streaming block. Composed into the
+        session's engine steps (``session._advance_engine`` polls it
+        between feed/advance/probe), so a hung advance aborts and the
+        session takes its ordinary permanent host fallback instead of
+        wedging the lane forever. Returns None when no deadline is
+        configured (the hook costs a closure per block otherwise)."""
+        deadline_s = self.dispatch_deadline_s
+        if deadline_s is None:
+            return None
+        fired = [False]
+
+        def _aborted() -> bool:
+            if self._stop.is_set():
+                return True
+            if time.monotonic() - t0 > deadline_s:
+                if not fired[0]:
+                    fired[0] = True
+                    obs.engine_fallback("serve-hang",
+                                        "DispatchDeadline",
+                                        session=True,
+                                        deadline_s=deadline_s)
+                return True
+            return False
+        return _aborted
+
+    def _dispatch_session(self, batch: List["rq.CheckRequest"],
+                          lane: "_Lane") -> None:
         """Session blocks: advance the carried frontier through each
         append (seq order — the coalescer sorted the group), resolve
         the close. No recovery ladder, no breaker, no lane pad: the
@@ -499,6 +574,7 @@ class Dispatcher:
             self.dispatch_counts[sig] = \
                 self.dispatch_counts.get(sig, 0) + 1
         obs.count("serve.dispatched", len(batch))
+        obs.count(f"serve.lane.{lane.idx}.dispatched")
         obs.gauge("serve.inflight", len(batch))
         t0 = time.monotonic()
         for r in batch:
@@ -518,8 +594,10 @@ class Dispatcher:
                             self.journal.session_close_marker(
                                 sess.id, res)
                     else:
-                        res = sess.advance_block(list(r.history),
-                                                 seq=r.seq)
+                        res = sess.advance_block(
+                            list(r.history), seq=r.seq,
+                            should_abort=self._session_abort(
+                                r.t_dispatch))
                 # jtlint: ok fallback — append/close client race: the member gets a 'closed' verdict
                 except SessionClosed as e:
                     res = {"valid": "unknown", "cause": "closed",
@@ -559,12 +637,17 @@ class Dispatcher:
         obs.count("serve.session.advance_wall_s",
                   time.monotonic() - t0)
 
-    def _dispatch(self, batch: List["rq.CheckRequest"]) -> None:
+    def _dispatch(self, batch: List["rq.CheckRequest"],
+                  lane: Optional["_Lane"] = None) -> None:
+        # single-lane callers (tests drive _dispatch directly) default
+        # to lane 0 — the pre-lanes behavior
+        if lane is None:
+            lane = self._lanes[0]
         # the self-nemesis trigger clock (scheduled clock jumps fire
         # here); never raises for the shipped fault grammar
         faults.fire("tick")
         if batch[0].session is not None:
-            self._dispatch_session(batch)
+            self._dispatch_session(batch, lane)
             return
         req0 = batch[0]
         sig = f"{req0.model_name}/H{len(batch)}"
@@ -572,6 +655,7 @@ class Dispatcher:
             self.dispatch_counts[sig] = \
                 self.dispatch_counts.get(sig, 0) + 1
         obs.count("serve.dispatched", len(batch))
+        obs.count(f"serve.lane.{lane.idx}.dispatched")
         obs.gauge("serve.inflight", len(batch))
         t0 = time.monotonic()
         for r in batch:
@@ -626,11 +710,12 @@ class Dispatcher:
         # re-emitted into every member request's stitched trace below
         # — ledgers are thread-isolated, so without this a client-side
         # obs.capture() around submit/poll would never see them
-        self._device_ran = False
+        lane.device_ran = False
         with obs.capture() as cap:
             try:
                 results = self._run_recover(batch, kw,
-                                            self.retry.max_retries)
+                                            self.retry.max_retries,
+                                            lane)
             except Exception as e:                      # noqa: BLE001
                 # the ladder itself must be crash-contained too
                 log.warning("serve recovery ladder crashed: %r", e,
@@ -658,14 +743,21 @@ class Dispatcher:
         # wall/lanes, the replicated pad lanes' share is padding waste
         # (a first-class counter). share*n_real + waste == wall, so
         # attributed device-seconds reconcile with dispatch wall by
-        # construction (asserted within 2% in tests).
+        # construction (asserted within 2% in tests). The per-lane
+        # copies make the same identity hold for each dispatch lane
+        # alone: sum_k lane.k.device_s + lane.k.pad_waste_s covers
+        # every device second the daemon spent, attributed to the
+        # lane that spent it.
         lanes = n_real + pad
-        if self._device_ran:
+        if lane.device_ran:
             share = elapsed / lanes
             waste = share * pad
             obs.histogram("serve.dispatch_wall_s", elapsed)
             obs.count("serve.device_s", share * n_real)
             obs.count("serve.pad_waste_s", waste)
+            obs.count(f"serve.lane.{lane.idx}.device_s",
+                      share * n_real)
+            obs.count(f"serve.lane.{lane.idx}.pad_waste_s", waste)
         else:
             # breaker-open dispatch served entirely host-side: no
             # kernel wall, no pad lanes — booking it as device time
@@ -852,10 +944,20 @@ class Dispatcher:
             "profile": self.profile_state(),
             # degradation surface: breaker state + retry policy, so
             # /stats, stats.json, and the /engine dashboard all see
-            # the same health the chaos harness asserts on
+            # the same health the chaos harness asserts on. With
+            # multiple lanes, "breaker" stays lane 0's (back-compat)
+            # and the per-lane view + any-lane-degraded aggregate
+            # live under "lanes".
             "breaker": self.breaker.to_json(),
-            "degraded": self.breaker.degraded,
+            "degraded": any(ln.breaker.degraded
+                            for ln in self._lanes),
             "retry": self.retry.to_json(),
+            "lanes": {
+                "n": self.lanes_n,
+                "loads": self.queue.lane_loads(),
+                "breakers": [ln.breaker.to_json()
+                             for ln in self._lanes],
+            },
         }
         if self.journal is not None:
             out["journal"] = self.journal.stats()
@@ -876,7 +978,11 @@ class Dispatcher:
         try:
             d = os.path.join(self.store_root, "serve")
             os.makedirs(d, exist_ok=True)
-            tmp = os.path.join(d, ".stats.json.tmp")
+            # per-thread tmp name: N lane threads write stats
+            # concurrently, and two writers sharing one tmp path
+            # would interleave open("w")/replace and tear stats.json
+            tmp = os.path.join(
+                d, f".stats.json.{threading.get_ident()}.tmp")
             with open(tmp, "w") as f:
                 json.dump({"ts": time.time(), **self.stats(snap)}, f,
                           default=str)

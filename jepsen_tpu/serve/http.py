@@ -50,6 +50,20 @@
   ``GET /session/<id>`` is the status view. Opens and appends are
   journaled before their acknowledgement, so sessions ride a
   SIGKILL: replay re-derives the frontier under the original id.
+
+Fleet mode (``replica_id=``): N daemons share ONE journal root.
+Every admitted request and open session carries a lease (replica id
++ wall-clock expiry) in the journal; a replica only dispatches work
+it holds the lease on, so the same entry is never double-dispatched.
+A background scan (every ``lease_ttl_s / 3``) renews the replica's
+own leases and adopts work whose holder stopped renewing — a
+SIGKILL'd replica's claims expire and drain through the survivors.
+Any replica answers ``GET /check/<id>`` (done markers live in the
+shared journal); duplicate POSTs dedup across replicas through the
+shared idempotency index. Sessions are PINNED to their claiming
+replica (the carried frontier is device state): an append landing on
+the wrong replica answers 409 with the pin while the lease is live,
+and adopts the session by journal replay once it expires.
 """
 from __future__ import annotations
 
@@ -168,7 +182,10 @@ class Daemon:
                  breaker: Optional[recovery.CircuitBreaker] = None,
                  dispatch_deadline_s: Optional[float] = None,
                  session_tenant_cap: int = 64,
-                 session_idle_ttl_s: Optional[float] = 3600.0) -> None:
+                 session_idle_ttl_s: Optional[float] = 3600.0,
+                 lanes: int = 1,
+                 replica_id: Optional[str] = None,
+                 lease_ttl_s: float = 10.0) -> None:
         # the queue bounds request COUNT; this bounds request BYTES —
         # both are needed for "backpressure, never OOM": worst-case
         # queued history memory is queue_depth * max_body_bytes-ish
@@ -180,7 +197,7 @@ class Daemon:
         self.queue = AdmissionQueue(
             max_depth=queue_depth,
             max_inflight_per_tenant=max_inflight_per_tenant,
-            group=group)
+            group=group, lanes=lanes)
         # durable admission journal (WAL): admitted requests are
         # journaled before their 202 and replayed on restart — only
         # with a store root (durability needs somewhere durable)
@@ -190,6 +207,15 @@ class Daemon:
             self.journal = jr.Journal(
                 store.serve_journal_dir(store_root),
                 keep_terminal=journal_keep_terminal)
+        # fleet mode: several replicas over one journal root, work
+        # partitioned by per-entry lease. A replica id without a
+        # journal would be a fleet with no shared state to fleet over.
+        self.replica_id = str(replica_id) if replica_id else None
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.fleet = (self.replica_id is not None
+                      and self.journal is not None)
+        self._fleet_stop = threading.Event()
+        self._fleet_thread: Optional[threading.Thread] = None
         # (tenant, idempotency key) -> request id (bounded; seeded
         # from the journal so the dedup window survives restarts;
         # tenant-scoped so one tenant's key cannot map onto — or leak
@@ -217,14 +243,22 @@ class Daemon:
                                      breaker=breaker,
                                      dispatch_deadline_s=
                                      dispatch_deadline_s,
-                                     journal=self.journal)
+                                     journal=self.journal,
+                                     lanes=lanes)
         if self.journal is not None:
             # every terminal transition — dispatcher publish, queued
             # timeout, cancel — marks the WAL entry complete, so a
             # restart never resurrects finished (or cancelled) work
+            # (and, in fleet mode, frees the lease for the verdict's
+            # entry — the done marker now answers for it everywhere)
             jnl = self.journal
-            self.registry.on_terminal = (
-                lambda req: jnl.finish(req.id, req.status, req.result))
+
+            def _on_terminal(req: "rq.CheckRequest") -> None:
+                jnl.finish(req.id, req.status, req.result)
+                if self.fleet:
+                    jnl.release(req.id, self.replica_id)
+
+            self.registry.on_terminal = _on_terminal
         # streaming check sessions: long-lived checks whose carried
         # frontier the dispatcher advances per append block. Bounded
         # three ways: globally (max_open), per tenant (one tenant
@@ -257,6 +291,7 @@ class Daemon:
             self.replay_journal()
             self.replay_sessions()
             self._start_sweeper()
+            self._start_fleet_scan()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http",
             daemon=True)
@@ -270,6 +305,7 @@ class Daemon:
         self.replay_journal()
         self.replay_sessions()
         self._start_sweeper()
+        self._start_fleet_scan()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -280,6 +316,9 @@ class Daemon:
     def shutdown(self, drain_timeout: float = 30.0) -> bool:
         self.accepting = False
         self._sweeper_stop.set()
+        self._fleet_stop.set()
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(5.0)
         drained = self.dispatcher.drain(timeout=drain_timeout)
         self.dispatcher.stop()
         if self._serve_thread is not None:
@@ -306,7 +345,17 @@ class Daemon:
         n = 0
         for rid in self.journal.pending_ids():
             if self.registry.get(rid) is not None:
-                continue            # already live (double replay call)
+                # already live HERE (double replay call / fleet-scan
+                # revisit): in fleet mode, renew the lease so sibling
+                # scans keep seeing a live holder
+                if self.fleet:
+                    self.journal.claim(rid, replica=self.replica_id,
+                                       ttl_s=self.lease_ttl_s)
+                continue
+            if self.fleet and not self.journal.claim(
+                    rid, replica=self.replica_id,
+                    ttl_s=self.lease_ttl_s):
+                continue    # a sibling's live lease: its work, not ours
             entry = self.journal.load_entry(rid)
             try:
                 if entry is None:
@@ -373,78 +422,96 @@ class Daemon:
         n = 0
         for sid in self.journal.open_session_ids():
             if self.sessions.get(sid) is not None:
-                continue            # already live (double replay call)
-            meta = self.journal.load_session(sid)
-            try:
-                if meta is None:
-                    raise ValueError("unreadable session entry")
-                model_name = str(meta["model"])
-                model = resolve_model(model_name)
-                opts = {k: v
-                        for k, v in (meta.get("options") or {}).items()
-                        if k in _CLIENT_OPTS}
-            except Exception as e:                      # noqa: BLE001
-                log.warning("session %s unreplayable: %s", sid, e)
-                obs.engine_fallback("serve-journal",
-                                    type(e).__name__, session=sid,
-                                    replay=True)
-                self.journal.session_close_marker(
-                    sid, {"valid": "unknown",
-                          "cause": "session-journal-corrupt",
-                          "error": f"{type(e).__name__}: {e}"})
+                # live here: renew the pin so siblings 409 appends to
+                # this session instead of adopting it out from under
+                # its device-resident frontier
+                if self.fleet:
+                    self.journal.claim(sid, replica=self.replica_id,
+                                       ttl_s=self.lease_ttl_s)
                 continue
-            sess = sn.Session(
-                sid, str(meta.get("tenant") or "anonymous"),
-                model_name, model, opts)
-            blocks = self.journal.session_appends(sid)
-            for seq, entry in blocks:
-                if seq != sess.seq + 1:
-                    # a seq GAP (missing/unreadable block file):
-                    # replay TRUNCATES here — advancing past the hole
-                    # would derive a frontier from a stream missing a
-                    # block AND falsely dedup the client's retry of
-                    # it. The client's retries re-apply from the
-                    # truncation point.
-                    obs.engine_fallback("serve-journal", "SeqGap",
-                                        session=sid, seq=seq,
-                                        expected=sess.seq + 1)
-                    break
-                try:
-                    ops = jr.history_from_edn(entry["history-edn"])
-                    sess.advance_block(ops, seq=seq)
-                except Exception as e:                  # noqa: BLE001
-                    # a torn block was never acknowledged: stop HERE
-                    # (same truncation argument — sess.seq must not
-                    # move past an unapplied block)
-                    obs.engine_fallback("serve-journal",
-                                        type(e).__name__, session=sid,
-                                        seq=seq)
-                    break
-                sess.seq = seq
-                sess.replayed += 1
-            # the replayed stream counts as activity: a session must
-            # not be swept as idle the instant its daemon restarts
-            sess.last_active_mono = time.monotonic()
-            try:
-                self.sessions.add(sess)
-            except RuntimeError as e:
-                # past the open-session bound: leave the session
-                # journaled (a later restart, after closes/GC, can
-                # still replay it) — a full registry must degrade a
-                # session, never abort the daemon's boot
-                log.warning("session %s not replayed: %s", sid, e)
-                obs.engine_fallback("serve-journal", "SessionBound",
-                                    session=sid, replay=True)
-                continue
-            self.registry.ledger_record(sess.tenant,
-                                        "session-replayed",
-                                        session=sid,
-                                        appends=len(blocks))
-            obs.count("serve.session.replayed")
-            n += 1
+            if self.fleet and not self.journal.claim(
+                    sid, replica=self.replica_id,
+                    ttl_s=self.lease_ttl_s):
+                continue    # pinned to a live sibling
+            if self._replay_one_session(sid):
+                n += 1
         if n:
             log.info("session replay: %d session(s) re-derived", n)
         return n
+
+    def _replay_one_session(self, sid: str) -> bool:
+        """Rebuild ONE journaled session through the engine (boot
+        replay and fleet adoption share this path — a session always
+        re-derives from its durable stream, never from copied state).
+        Returns whether a live session came out of it."""
+        meta = self.journal.load_session(sid)
+        try:
+            if meta is None:
+                raise ValueError("unreadable session entry")
+            model_name = str(meta["model"])
+            model = resolve_model(model_name)
+            opts = {k: v
+                    for k, v in (meta.get("options") or {}).items()
+                    if k in _CLIENT_OPTS}
+        except Exception as e:                          # noqa: BLE001
+            log.warning("session %s unreplayable: %s", sid, e)
+            obs.engine_fallback("serve-journal",
+                                type(e).__name__, session=sid,
+                                replay=True)
+            self.journal.session_close_marker(
+                sid, {"valid": "unknown",
+                      "cause": "session-journal-corrupt",
+                      "error": f"{type(e).__name__}: {e}"})
+            return False
+        sess = sn.Session(
+            sid, str(meta.get("tenant") or "anonymous"),
+            model_name, model, opts)
+        blocks = self.journal.session_appends(sid)
+        for seq, entry in blocks:
+            if seq != sess.seq + 1:
+                # a seq GAP (missing/unreadable block file):
+                # replay TRUNCATES here — advancing past the hole
+                # would derive a frontier from a stream missing a
+                # block AND falsely dedup the client's retry of
+                # it. The client's retries re-apply from the
+                # truncation point.
+                obs.engine_fallback("serve-journal", "SeqGap",
+                                    session=sid, seq=seq,
+                                    expected=sess.seq + 1)
+                break
+            try:
+                ops = jr.history_from_edn(entry["history-edn"])
+                sess.advance_block(ops, seq=seq)
+            except Exception as e:                      # noqa: BLE001
+                # a torn block was never acknowledged: stop HERE
+                # (same truncation argument — sess.seq must not
+                # move past an unapplied block)
+                obs.engine_fallback("serve-journal",
+                                    type(e).__name__, session=sid,
+                                    seq=seq)
+                break
+            sess.seq = seq
+            sess.replayed += 1
+        # the replayed stream counts as activity: a session must
+        # not be swept as idle the instant its daemon restarts
+        sess.last_active_mono = time.monotonic()
+        try:
+            self.sessions.add(sess)
+        except RuntimeError as e:
+            # past the open-session bound: leave the session
+            # journaled (a later restart, after closes/GC, can
+            # still replay it) — a full registry must degrade a
+            # session, never abort the daemon's boot
+            log.warning("session %s not replayed: %s", sid, e)
+            obs.engine_fallback("serve-journal", "SessionBound",
+                                session=sid, replay=True)
+            return False
+        self.registry.ledger_record(sess.tenant,
+                                    "session-replayed",
+                                    session=sid,
+                                    appends=len(blocks))
+        obs.count("serve.session.replayed")
+        return True
 
     # -- idle-session sweeper --------------------------------------------
     def _start_sweeper(self) -> None:
@@ -493,6 +560,38 @@ class Daemon:
                 n += 1
         return n
 
+    # -- fleet scan (renew own leases, adopt expired ones) ---------------
+    def _start_fleet_scan(self) -> None:
+        """Background lease maintenance, fleet mode only. Every
+        ``lease_ttl_s / 3`` (a renew cadence that survives two missed
+        ticks before the lease lapses) the replica re-runs the replay
+        paths: for work it already holds that is a lease RENEWAL; for
+        pending entries whose holder stopped renewing — a SIGKILL'd
+        sibling — the claim STEALS the expired lease and the entry
+        replays here. That single mechanism is both heartbeat and
+        failover: no separate membership protocol."""
+        if not self.fleet or self._fleet_thread is not None:
+            return
+        interval = max(0.2, self.lease_ttl_s / 3.0)
+
+        def _scan_loop() -> None:
+            while not self._fleet_stop.wait(interval):
+                try:
+                    self.fleet_scan()
+                # jtlint: ok fallback — a failed scan retries next tick; leases it missed renewing are re-claimable, never lost
+                except Exception:                       # noqa: BLE001
+                    log.exception("fleet scan failed")
+
+        self._fleet_thread = threading.Thread(
+            target=_scan_loop, name="serve-fleet-scan", daemon=True)
+        self._fleet_thread.start()
+
+    def fleet_scan(self) -> Tuple[int, int]:
+        """One renew-and-adopt pass (exposed for tests: deterministic
+        lease handoff without waiting on the scan thread). Returns
+        (requests adopted, sessions adopted)."""
+        return self.replay_journal(), self.replay_sessions()
+
     # -- streaming sessions (called from HTTP worker threads) ------------
     def session_open(self, body: bytes, content_type: str,
                      header_tenant: Optional[str]) -> Tuple[int, Dict]:
@@ -528,6 +627,12 @@ class Daemon:
                 obs.engine_fallback("serve-journal",
                                     type(e).__name__, session=sid)
                 return 500, {"error": f"journal write failed: {e}"}
+            if self.fleet:
+                # pin the session HERE before the id is returned: a
+                # sibling's scan racing this open must see the pin,
+                # not adopt a session whose opener is mid-reply
+                self.journal.claim(sid, replica=self.replica_id,
+                                   ttl_s=self.lease_ttl_s)
         sess = sn.Session(sid, tenant, model_name, model, options)
         try:
             self.sessions.add(sess)
@@ -542,9 +647,12 @@ class Daemon:
             return 429, {"error": str(e), "retry-after-s": 1.0}
         self.registry.ledger_record(tenant, "session-opened",
                                     session=sid, model=model_name)
-        return 201, {"session": sid, "status": "open",
-                     "tenant": tenant, "model": model_name,
-                     "engine": sess.engine_name}
+        out = {"session": sid, "status": "open",
+               "tenant": tenant, "model": model_name,
+               "engine": sess.engine_name}
+        if self.fleet:
+            out["pinned-to"] = self.replica_id
+        return 201, out
 
     def _parse_append(self, body: bytes, content_type: str
                       ) -> Tuple[list, Optional[int], Optional[float],
@@ -572,6 +680,42 @@ class Daemon:
         wait_s = float(data.get("wait-s", 30.0))
         return ops, seq, timeout_s, wait_s
 
+    def _adopt_session(self, sid: str
+                       ) -> Tuple[Optional[sn.Session],
+                                  Optional[Tuple[int, Dict]]]:
+        """Fleet resolution of a session that is NOT live locally
+        (and not closed — callers check that first). While the
+        claiming replica's lease is live the session is PINNED there:
+        the caller answers 409 with the pin, and the client retries
+        against it (the carried frontier is that replica's device
+        state — adopting a live session would fork it). Once the
+        lease expires — the holder died — this replica claims the pin
+        and re-derives the frontier from the journaled stream, and
+        the append proceeds HERE. Returns (session, None) or
+        (None, (code, payload))."""
+        if self.fleet \
+                and self.journal.load_session(sid) is not None:
+            holder = self.journal.lease_live(sid)
+            if holder is not None and holder != self.replica_id:
+                return None, (409, {
+                    "error": f"session {sid!r} is pinned to "
+                             f"replica {holder!r}",
+                    "session": sid, "pinned-to": holder,
+                    "cause": "session-pinned"})
+            if self.journal.claim(sid, replica=self.replica_id,
+                                  ttl_s=self.lease_ttl_s) \
+                    and self._replay_one_session(sid):
+                sess = self.sessions.get(sid)
+                if sess is not None:
+                    obs.count("serve.session.adopted")
+                    self.registry.ledger_record(
+                        sess.tenant, "session-adopted", session=sid,
+                        replica=self.replica_id)
+                    log.info("session %s adopted by replica %s",
+                             sid, self.replica_id)
+                    return sess, None
+        return None, (404, {"error": f"unknown session {sid!r}"})
+
     def session_append(self, sid: str, body: bytes,
                        content_type: str) -> Tuple[int, Dict]:
         if not self.accepting:
@@ -583,7 +727,9 @@ class Daemon:
             if term is not None:
                 return 409, {"error": f"session {sid!r} is closed",
                              "session": sid, "status": "closed"}
-            return 404, {"error": f"unknown session {sid!r}"}
+            sess, err = self._adopt_session(sid)
+            if sess is None:
+                return err
         try:
             ops, seq, timeout_s, wait_s = self._parse_append(
                 body, content_type)
@@ -671,7 +817,9 @@ class Daemon:
                 if term.get("result") is not None:
                     out["result"] = term["result"]
                 return 200, out
-            return 404, {"error": f"unknown session {sid!r}"}
+            sess, err = self._adopt_session(sid)
+            if sess is None:
+                return err
         if sess.closed:
             return 200, {"session": sid, "status": "closed",
                          "result": dict(sess.result or {})}
@@ -728,6 +876,13 @@ class Daemon:
             if term.get("result") is not None:
                 out["result"] = term["result"]
             return 200, out
+        if self.fleet and self.journal.load_session(sid) is not None:
+            # a status GET answers from the shared journal without
+            # moving the pin (only appends/closes adopt): any replica
+            # can tell the client where the session lives
+            return 200, {"session": sid, "status": "open",
+                         "fleet": True,
+                         "pinned-to": self.journal.lease_live(sid)}
         return 404, {"error": f"unknown session {sid!r}"}
 
     # -- request handling (called from HTTP worker threads) -------------
@@ -783,6 +938,17 @@ class Daemon:
                 return 202, {"id": known,
                              "status": term.get("status", "done"),
                              "deduped": True}
+            if self.fleet \
+                    and self.journal.load_entry(known) is not None:
+                # pending on a SIBLING replica (journaled, not
+                # terminal, not in this registry): dedup to it — the
+                # client polls GET /check/<id>, which any replica
+                # answers from the shared journal
+                obs.count("serve.journal.deduped")
+                return 202, {"id": known, "status": "queued",
+                             "deduped": True, "fleet": True,
+                             "claimed-by":
+                                 self.journal.lease_live(known)}
             with self._idem_lock:
                 if known not in self._admitting:
                     # not mid-admission and resolvable on no tier:
@@ -830,6 +996,17 @@ class Daemon:
             idem_key=idem)
         if idem is not None:
             known = self._reserve_idem(tenant, idem, req.id)
+            if known is None and self.fleet:
+                # the local index only knows THIS replica's
+                # admissions (plus the boot-time seed): a sibling may
+                # already hold the key — rescan the shared journal
+                # index before letting this admission through
+                sibling = self.journal.idempotency_index().get(
+                    (tenant, idem))
+                if sibling is not None and sibling != req.id:
+                    self._settle_idem(tenant, idem, req.id,
+                                      admitted=False)
+                    known = sibling
             if known is not None:
                 dup = self._dedup_response(tenant, idem, known)
                 if dup is not None:
@@ -853,6 +1030,14 @@ class Daemon:
                     timeout_s=timeout_s, idempotency_key=idem,
                     history=ops)
                 req.journaled = True
+                if self.fleet:
+                    # lease the entry to THIS replica before the 202:
+                    # a sibling's scan racing the admission must see
+                    # a live holder, never adopt-and-double-dispatch
+                    # (fresh id — the exclusive-create cannot collide)
+                    self.journal.claim(req.id,
+                                       replica=self.replica_id,
+                                       ttl_s=self.lease_ttl_s)
             except OSError as e:
                 obs.engine_fallback("serve-journal",
                                     type(e).__name__, append=True)
@@ -897,6 +1082,16 @@ class Daemon:
                 code = (500 if out["status"] == rq.QUARANTINED
                         else 200)
                 return code, out
+            if self.fleet \
+                    and self.journal.load_entry(req_id) is not None:
+                # pending on another replica: answer the poll from
+                # the shared journal (status detail lives with the
+                # claiming replica; the verdict will land in the
+                # shared done marker either way)
+                return 200, {"id": req_id, "status": "queued",
+                             "fleet": True,
+                             "claimed-by":
+                                 self.journal.lease_live(req_id)}
             return 404, {"error": f"unknown request {req_id!r}"}
         # a quarantined request is a structured 500: the daemon is
         # healthy, THIS request poisoned its dispatches
@@ -954,7 +1149,13 @@ class Daemon:
         return 200, req.to_json()
 
     def stats(self) -> Dict[str, Any]:
-        return self.dispatcher.stats()
+        out = self.dispatcher.stats()
+        if self.fleet:
+            out["fleet"] = {
+                "replica": self.replica_id,
+                "lease-ttl-s": self.lease_ttl_s,
+                "leases": self.journal.stats().get("leases", 0)}
+        return out
 
     def health(self) -> Dict[str, Any]:
         """Liveness + degradation: ``ok`` means the daemon serves;
@@ -966,6 +1167,9 @@ class Daemon:
                                "breaker": breaker.to_json()}
         if self.journal is not None:
             out["journal"] = {"pending": self.journal.pending_count()}
+        if self.fleet:
+            out["fleet"] = {"replica": self.replica_id,
+                            "lease-ttl-s": self.lease_ttl_s}
         return out
 
 

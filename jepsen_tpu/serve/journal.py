@@ -35,16 +35,36 @@ with an fsync, so a torn write is an absent entry (the client never
 got its 202), never a corrupt one; a corrupt entry found anyway is
 quarantined at replay, not looped on.
 
+**Fleet mode** (multiple daemons over ONE journal root) adds per-entry
+*leases*: ``<id>.lease.json`` carrying the claiming replica id and a
+wall-clock expiry. A fresh claim is a kernel-atomic exclusive create
+(``O_CREAT|O_EXCL`` — ``os.replace`` clobbers, so it cannot be the
+claim primitive); renewals and expired-lease steals serialize through
+a cross-process ``flock`` on ``.fleet.lock``, so two replicas racing
+for one entry admit exactly one. A SIGKILL'd replica's leases expire
+on the wall clock and its queued work drains through survivors (they
+steal at their next journal scan). The lease suffix is disjoint from
+every other suffix, so the one-shot and session views are blind to
+lease files by construction.
+
 Pure host-side stdlib — no jax, unit-testable in microseconds.
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+try:
+    import fcntl
+    _HAVE_FLOCK = True
+# jtlint: ok fallback — import-time capability probe; _fleet_lock degrades to the in-process lock (single-replica semantics) and documents it
+except ImportError:                     # pragma: no cover - non-POSIX
+    _HAVE_FLOCK = False
 
 from jepsen_tpu import edn
 from jepsen_tpu import obs
@@ -62,6 +82,9 @@ _SESS_SUFFIX = ".sess.json"
 _SAPP_MID = ".a"
 _SAPP_SUFFIX = ".sapp.json"
 _SDONE_SUFFIX = ".sdone.json"
+# fleet mode: one .lease.json per claimed entry (one-shot request id
+# or session id) — replica id + wall-clock expiry
+_LEASE_SUFFIX = ".lease.json"
 
 
 def history_to_edn(history) -> str:
@@ -195,8 +218,10 @@ class Journal:
 
     def discard(self, req_id: str) -> None:
         """Remove an entry that was never admitted (backpressure
-        retraction after the append)."""
-        for p in (self._req_path(req_id), self._done_path(req_id)):
+        retraction after the append) — its lease file, if any, goes
+        with it (a GC'd entry must not leave an orphan claim)."""
+        for p in (self._req_path(req_id), self._done_path(req_id),
+                  self._lease_path(req_id)):
             try:
                 os.unlink(p)
             # jtlint: ok fallback — best-effort unlink of a retracted entry
@@ -216,6 +241,162 @@ class Journal:
                     {"valid": "unknown", "cause": "cancelled"})
         term = self.lookup_terminal(req_id)
         return bool(term) and term.get("status") == "cancelled"
+
+    # -- leases (fleet mode) ---------------------------------------------
+    def _lease_path(self, entry_id: str) -> str:
+        return os.path.join(self.root, entry_id + _LEASE_SUFFIX)
+
+    @contextlib.contextmanager
+    def _fleet_lock(self):
+        """Cross-PROCESS critical section for lease renew/steal: the
+        read-holder-then-overwrite window must be serialized across
+        replicas (two stealers racing through it unserialized could
+        both "win" one expired lease). ``flock`` on a shared lock
+        file — replicas of one fleet share a store root on one host,
+        which is exactly flock's domain; platforms without fcntl fall
+        back to the in-process lock (single-replica semantics)."""
+        if not _HAVE_FLOCK:
+            with self._lock:
+                yield
+            return
+        fd = os.open(os.path.join(self.root, ".fleet.lock"),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            finally:
+                os.close(fd)
+
+    @staticmethod
+    def _read_lease(path: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        # jtlint: ok fallback — absent and torn both READ as "no live holder" by design: a torn lease is stealable (its writer died mid-write or loses the fleet-locked steal race), and the steal itself records
+        except (OSError, ValueError):
+            return None
+
+    def claim(self, entry_id: str, *, replica: str,
+              ttl_s: float) -> bool:
+        """Claim one journal entry (or session id) for ``replica``
+        with a wall-clock lease of ``ttl_s`` seconds. Returns True
+        when this replica now holds the lease: a fresh claim (the
+        kernel-atomic link-into-place fast path), a renewal of its
+        own live lease, or a steal of an expired/torn one. False when
+        another replica holds a live lease (or the claim write
+        failed)."""
+        path = self._lease_path(entry_id)
+        replica = str(replica)
+        payload = {"id": entry_id, "replica": replica,
+                   "expires-at": round(time.time() + float(ttl_s), 6),
+                   "claimed-at": round(time.time(), 6)}
+        # fast path: write the FULL payload to a private tmp, then
+        # hard-link it into place — the lease appears atomically with
+        # its content (an O_EXCL create + write would expose an empty
+        # file a concurrent reader mistakes for torn-and-stealable)
+        tmp = path + f".{os.getpid()}.{threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            try:
+                os.link(tmp, path)
+            except FileExistsError:
+                return self._claim_slow(path, payload, replica)
+        except OSError as e:
+            obs.engine_fallback("serve-lease", type(e).__name__,
+                                id=entry_id)
+            return False
+        finally:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+        obs.count("serve.lease.claimed")
+        return True
+
+    def _claim_slow(self, path: str, payload: Dict[str, Any],
+                    replica: str) -> bool:
+        """The existing-lease path: renew own, refuse live foreign,
+        steal expired/torn — all under the fleet lock."""
+        with self._fleet_lock():
+            holder = self._read_lease(path)
+            live = (holder is not None
+                    and float(holder.get("expires-at") or 0.0)
+                    > time.time())
+            if live and holder.get("replica") != replica:
+                return False
+            try:
+                self._write(path, payload)
+            except OSError as e:
+                obs.engine_fallback("serve-lease", type(e).__name__,
+                                    id=payload["id"])
+                return False
+            if live:
+                obs.count("serve.lease.renewed")
+            elif holder is not None:
+                # an expired (or torn) lease changed hands: the dead
+                # replica's queued work drains through this survivor
+                obs.count("serve.lease.expired")
+                obs.count("serve.lease.stolen")
+                obs.decision("serve-lease", "steal",
+                             cause=str(holder.get("replica")),
+                             id=payload["id"], by=replica)
+            else:
+                obs.count("serve.lease.claimed")
+            return True
+
+    def release(self, entry_id: str, replica: str) -> None:
+        """Drop this replica's lease (the entry went terminal). A
+        foreign lease is left alone: releasing a lease we LOST
+        (expired and stolen while we were finishing) must not unlink
+        the thief's live claim."""
+        path = self._lease_path(entry_id)
+        with self._fleet_lock():
+            holder = self._read_lease(path)
+            if holder is None \
+                    or holder.get("replica") != str(replica):
+                return
+            try:
+                os.unlink(path)
+            # jtlint: ok fallback — best-effort unlink of an owned lease; it expires anyway
+            except OSError:
+                return
+        obs.count("serve.lease.released")
+
+    def lease_holder(self, entry_id: str) -> Optional[Dict[str, Any]]:
+        """The raw lease payload (``replica`` / ``expires-at``), or
+        None when unclaimed or torn."""
+        return self._read_lease(self._lease_path(entry_id))
+
+    def lease_live(self, entry_id: str) -> Optional[str]:
+        """The replica id holding a LIVE (unexpired) lease, or None."""
+        holder = self.lease_holder(entry_id)
+        if holder is None or float(
+                holder.get("expires-at") or 0.0) <= time.time():
+            return None
+        return str(holder.get("replica"))
+
+    def leases(self) -> Dict[str, Dict[str, Any]]:
+        """Every lease file's payload by entry id (chaos gates assert
+        each entry is claimed by at most one live lease — trivially
+        one FILE per entry; this view exposes holder + expiry)."""
+        try:
+            names = os.listdir(self.root)
+        # jtlint: ok fallback — directory-scan view: an unlistable root degrades to the empty view, same contract as _ids/open_session_ids
+        except OSError:
+            return {}
+        out: Dict[str, Dict[str, Any]] = {}
+        for n in names:
+            if n.endswith(_LEASE_SUFFIX) and not n.endswith(".tmp"):
+                eid = n[:-len(_LEASE_SUFFIX)]
+                holder = self.lease_holder(eid)
+                if holder is not None:
+                    out[eid] = holder
+        return out
 
     # -- streaming sessions ----------------------------------------------
     def _sess_path(self, sid: str) -> str:
@@ -346,7 +527,8 @@ class Journal:
         """Remove every file of one session (GC of closed sessions)."""
         for seq, _e in self.session_appends(sid):
             self.discard_session_append(sid, seq)
-        for p in (self._sess_path(sid), self._sdone_path(sid)):
+        for p in (self._sess_path(sid), self._sdone_path(sid),
+                  self._lease_path(sid)):
             try:
                 os.unlink(p)
             # jtlint: ok fallback — best-effort unlink during session GC
@@ -480,8 +662,16 @@ class Journal:
     def stats(self) -> Dict[str, Any]:
         ids = self._ids()
         pending = sum(1 for fin in ids.values() if not fin)
+        try:
+            leases = sum(1 for n in os.listdir(self.root)
+                         if n.endswith(_LEASE_SUFFIX)
+                         and not n.endswith(".tmp"))
+        # jtlint: ok fallback — stats view: an unlistable root reports zero leases, same contract as the other directory-scan views
+        except OSError:
+            leases = 0
         return {"pending": pending,
                 "terminal": len(ids) - pending,
                 "sessions-open": self.open_session_count(),
+                "leases": leases,
                 "keep_terminal": self.keep_terminal,
                 "root": self.root}

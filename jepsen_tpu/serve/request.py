@@ -78,6 +78,10 @@ class CheckRequest:
     kind: str = "check"
     session: Optional[Any] = None           # serve.session.Session
     seq: int = 0                            # per-session append order
+    # dispatch lane this request's group was placed on (stamped by
+    # the coalescer's lane placement; None on the single-consumer
+    # path) — surfaces in to_json so clients can see the fan-out
+    lane: Optional[int] = None
     # stage timestamps (time.monotonic): admit -> coalesce (selected
     # into a dispatch group) -> dispatch (engine call starts) ->
     # collect (engine call returned) -> done (verdict published).
@@ -185,6 +189,8 @@ class CheckRequest:
             out["latency-s"] = round(self.t_done - self.t_submit, 6)
         if self.device_s is not None:
             out["device-s"] = round(self.device_s, 9)
+        if self.lane is not None:
+            out["lane"] = int(self.lane)
         wf = self.waterfall()
         if wf:
             out["waterfall"] = wf

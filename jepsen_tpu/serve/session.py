@@ -84,6 +84,17 @@ class SessionClosed(RuntimeError):
     """Appends after close are a client error (HTTP 409)."""
 
 
+class AdvanceAborted(RuntimeError):
+    """A session advance exceeded the dispatcher's wall-clock cap
+    (``--dispatch-deadline``): raised from the ``should_abort`` hook
+    between engine steps. Deliberately an ordinary Exception to the
+    advance ladder — the session takes its ordinary PERMANENT host
+    fallback (one ``session-advance`` obs fallback, host monitor
+    replays the accumulated stream), exactly like any other device-
+    path death, so a hung device advance cannot wedge a lane while
+    the verdict contract stays intact."""
+
+
 class TenantSessionCap(RuntimeError):
     """One tenant hit its open-session cap (HTTP 429 with cause
     ``tenant-cap`` — the global bound stays a plain RuntimeError)."""
@@ -385,12 +396,21 @@ class Session:
 
     # -- appends ---------------------------------------------------------
     def advance_block(self, ops: Sequence[Op],
-                      seq: Optional[int] = None) -> Dict[str, Any]:
+                      seq: Optional[int] = None,
+                      should_abort: Optional[Any] = None
+                      ) -> Dict[str, Any]:
         """Feed one event block and return the incremental verdict +
         tail-alarm status. Fail-fast is permanent: once a violation
         is proven, every later append returns it unchanged (the
         sticky verdict — linearizability/serializability are
-        prefix-closed, nothing can repair them)."""
+        prefix-closed, nothing can repair them).
+
+        ``should_abort`` (the dispatcher's deadline hook) is polled
+        between engine steps; when it fires, the device advance
+        aborts via :class:`AdvanceAborted` and the ordinary permanent
+        host fallback below produces the verdict — the host replay
+        path never polls it (it IS the fallback target; aborting it
+        would leave no verdict at all)."""
         with self.lock:
             if self.closed:
                 raise SessionClosed(f"session {self.id} is closed")
@@ -412,7 +432,7 @@ class Session:
                     if not self.is_txn:
                         faults.fire("session-advance",
                                     tenants=[self.tenant])
-                    v = self._advance_engine(ops)
+                    v = self._advance_engine(ops, should_abort)
                 except online._Overflow as e:
                     # capacity, not death: recorded route decision
                     obs.decision("session-advance", "route",
@@ -442,17 +462,32 @@ class Session:
                 tail_hit = bool((v or {}).get("tail-alarm"))
             return self._append_verdict(len(ops), tail_hit, seq)
 
-    def _advance_engine(self, ops: Sequence[Op]
+    def _advance_engine(self, ops: Sequence[Op],
+                        should_abort: Optional[Any] = None
                         ) -> Optional[Dict[str, Any]]:
+        def _check_abort() -> None:
+            # polled between engine steps (feed / frontier walk /
+            # tail probe): the granularity the one-shot segmented
+            # walk's abort hook has per segment, applied to the
+            # session's per-block device calls
+            if should_abort is not None and should_abort():
+                raise AdvanceAborted(
+                    "session advance aborted past the dispatch "
+                    "deadline")
         if self._host is not None:
+            # the host monitor is the fallback TARGET: it never
+            # aborts (aborting it would leave the block verdict-less)
             for op in ops:
                 self._host.observe(op)
             self._host.flush()
             return self._host.violation
         if self.is_txn:
+            _check_abort()
             return self._eng.advance_block(ops)
         self._eng.feed_many(list(ops))
+        _check_abort()
         v = self._eng.advance()
+        _check_abort()
         if v is None:
             v = self._eng.tail_alarm()
         return v

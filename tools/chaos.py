@@ -91,7 +91,8 @@ class DaemonProc:
     def __init__(self, store_root: str, *, faults_env: str = "",
                  log_path: str, breaker_threshold: int = 3,
                  breaker_cooldown: float = 1.0,
-                 group: int = 8) -> None:
+                 group: int = 8,
+                 extra_args: Optional[List[str]] = None) -> None:
         self.port = _free_port()
         self.url = f"http://127.0.0.1:{self.port}"
         env = dict(os.environ)
@@ -106,7 +107,8 @@ class DaemonProc:
              "--port", str(self.port), "--store-root", store_root,
              "--group", str(group),
              "--breaker-threshold", str(breaker_threshold),
-             "--breaker-cooldown", str(breaker_cooldown)],
+             "--breaker-cooldown", str(breaker_cooldown)]
+            + list(extra_args or []),
             cwd=REPO, env=env, stdout=self.log, stderr=self.log)
 
     def sigkill(self) -> None:
@@ -503,6 +505,301 @@ def run_chaos(opts: Dict[str, Any]) -> Dict[str, Any]:
     return report
 
 
+# -- the fleet harness ---------------------------------------------------
+
+def run_fleet(opts: Dict[str, Any]) -> Dict[str, Any]:
+    """N-replica fleet over ONE store root: SIGKILL a replica
+    mid-load and assert its leased work drains through the survivors.
+
+    Gates (the fleet analogues of the single-daemon invariants):
+
+    1. Every 202 — including those leased to the victim at the kill —
+       reaches a terminal state via a SURVIVOR, with verdicts equal
+       to ground truth and the standalone facade.
+    2. Lease failover is visible: any entry the victim held at the
+       kill shows up in survivor counters as expired+stolen.
+    3. No double-dispatch: every terminal response is STABLE and
+       bit-identical from every surviving replica (a second dispatch
+       with a divergent outcome would flip one of them).
+    4. Cross-replica idempotency: a duplicate POST to a *different*
+       replica dedups to the original id through the shared journal.
+    5. A streaming session pinned to the victim is adopted by a
+       survivor after lease expiry and closes with the exact
+       standalone-facade verdict.
+    """
+    quick = bool(opts.get("quick"))
+    seed = int(opts.get("seed", 7))
+    n_replicas = max(2, int(opts.get("replicas", 2)))
+    keep_store = bool(opts.get("keep_store"))
+    lease_ttl = 1.5
+    root = opts.get("store_root") or tempfile.mkdtemp(
+        prefix="jepsen-fleet-")
+    os.makedirs(root, exist_ok=True)
+    report: Dict[str, Any] = {"store_root": root, "seed": seed,
+                              "quick": quick, "replicas": n_replicas,
+                              "lease_ttl_s": lease_ttl,
+                              "violations": []}
+
+    def violate(msg: str) -> None:
+        report["violations"].append(msg)
+
+    procs: List[DaemonProc] = []
+    for i in range(n_replicas):
+        procs.append(DaemonProc(
+            root, faults_env="",
+            log_path=os.path.join(root, f"fleet-r{i}.log"),
+            extra_args=["--replica-id", f"r{i}",
+                        "--lease-ttl", str(lease_ttl),
+                        "--lanes", "2"]))
+    urls = [p.url for p in procs]
+    report["urls"] = urls
+    victim, survivors = procs[0], procs[1:]
+
+    def _stats(url: str) -> Dict[str, float]:
+        code, st = _get(url, "/stats")
+        return st.get("counters", {}) if code == 200 else {}
+
+    try:
+        for p in procs:
+            if not _wait_ready(p.url):
+                violate(f"replica {p.url} never became ready")
+                return report
+
+        # ---- wave 1: round-robin across every replica ----
+        wave1 = build_cases(seed=seed, n=8 if quick else 16,
+                            sizes=[8, 12] if quick else [8, 12, 16],
+                            violation_frac=0.3, tenant_prefix="fleet")
+        for i, c in enumerate(wave1):
+            submit_cases(urls[i % len(urls)], [c])
+
+        # gate 4: duplicate POST to a DIFFERENT replica than the one
+        # that admitted it must dedup to the original id (the shared
+        # journal index is the source of truth, not process memory)
+        dup = next((c for c in wave1 if c["id"]), None)
+        if dup is not None:
+            code, resp = _post(urls[1], dup["payload"])
+            if code not in (200, 202) or resp.get("id") != dup["id"] \
+                    or not resp.get("deduped"):
+                violate(f"cross-replica re-POST did not dedup to the "
+                        f"original id: {code} {resp}")
+            report["cross_replica_dedup"] = resp
+
+        # every replica answers GET /check/<id> for every id (done
+        # markers + journal are shared) — poll wave 1 via replica 1
+        poll_terminal(urls[1], wave1, timeout=600)
+
+        # ---- streaming session PINNED to the victim ----
+        from jepsen_tpu import fixtures as _fx
+        sess_hist = _fx.gen_history("cas", n_ops=36 if quick else 72,
+                                    processes=3, seed=seed + 2000)
+        blk = 12
+        sess_blocks = [sess_hist[i:i + blk]
+                       for i in range(0, len(sess_hist), blk)]
+        sess_id = None
+        code, resp = _lg._post_json(victim.url, "/session",
+                                    {"model": "cas-register",
+                                     "tenant": "fleet-sess"})
+        if code != 201:
+            violate(f"session open on victim failed: {code} {resp}")
+        else:
+            sess_id = resp["session"]
+            if resp.get("pinned-to") != "r0":
+                violate(f"session not pinned to its opener: {resp}")
+            code, r = _lg._post_json(
+                victim.url, f"/session/{sess_id}/append",
+                {"history": [op.to_dict() for op in sess_blocks[0]],
+                 "seq": 1, "wait-s": 120})
+            if code != 200 or r.get("valid-so-far") is not True:
+                violate(f"pre-kill session append bad: {code} {r}")
+
+        # ---- kill wave: submitted to the VICTIM, then SIGKILL
+        # before it can finish — this is the leased work that must
+        # drain through the survivors ----
+        kill_wave = build_cases(seed=seed + 1000,
+                                n=6 if quick else 10,
+                                sizes=[12, 16], violation_frac=0.3,
+                                tenant_prefix="kill")
+        submit_cases(victim.url, kill_wave)
+        t_kill = time.monotonic()
+        victim.sigkill()
+        report["killed"] = "r0"
+
+        # the victim is dead, so its on-disk lease state is frozen
+        # until the TTL: count the entries it still held
+        jdir = os.path.join(root, "serve", "journal")
+        victim_pending = []
+        for f in os.listdir(jdir):
+            if not f.endswith(".lease.json"):
+                continue
+            eid = f[:-len(".lease.json")]
+            if os.path.exists(os.path.join(jdir,
+                                           eid + ".done.json")):
+                continue
+            try:
+                with open(os.path.join(jdir, f)) as fh:
+                    holder = json.load(fh).get("replica")
+            except (OSError, ValueError):
+                continue
+            if holder == "r0":
+                victim_pending.append(eid)
+        report["victim_pending_at_kill"] = len(victim_pending)
+
+        # ---- gate 1: everything drains through the survivors ----
+        first_done = poll_terminal(urls[1], kill_wave, timeout=600)
+        if first_done is not None:
+            report["failover_to_first_verdict_s"] = round(
+                first_done - t_kill, 3)
+        for c in wave1 + kill_wave:
+            if c["id"] and (c["final"] is None
+                            or c["final"].get("status")
+                            not in _TERMINAL):
+                violate(f"request {c['id']} never drained through "
+                        f"the survivors: {c['final']}")
+
+        # gate 2: if the victim held leases at the kill, the
+        # survivors must have visibly expired + stolen them
+        if victim_pending:
+            stolen = sum(_stats(u).get("serve.lease.stolen", 0)
+                         for u in urls[1:])
+            if stolen < 1:
+                violate(f"victim held {len(victim_pending)} leases "
+                        f"but no survivor recorded a steal")
+            report["leases_stolen"] = stolen
+
+        # verdicts: ground truth + standalone facade differential
+        from jepsen_tpu import history as h
+        from jepsen_tpu import models
+        from jepsen_tpu.checkers import facade
+        mismatches = 0
+        for c in wave1 + kill_wave:
+            st = c["final"] or {}
+            if st.get("status") != "done":
+                continue
+            valid = (st.get("result") or {}).get("valid")
+            if valid is not c["expect"]:
+                mismatches += 1
+                violate(f"verdict mismatch for {c['id']}: got "
+                        f"{valid!r}, ground truth {c['expect']!r}")
+                continue
+            stand = facade.auto_check_packed(
+                models.cas_register(), h.pack(c["ops"]), {})
+            if stand["valid"] is not valid:
+                mismatches += 1
+                violate(f"fleet verdict diverges from standalone "
+                        f"facade for {c['id']}")
+            elif valid is False and \
+                    st["result"].get("op") != stand.get("op"):
+                mismatches += 1
+                violate(f"witness op diverges for {c['id']}")
+        report["verdict_mismatches"] = mismatches
+        report["checked_done"] = sum(
+            1 for c in wave1 + kill_wave
+            if (c["final"] or {}).get("status") == "done")
+
+        # gate 3: terminal responses are stable and identical from
+        # EVERY surviving replica (double-dispatch with a divergent
+        # outcome would flip one of these)
+        for c in wave1 + kill_wave:
+            if not c["id"] or (c["final"] or {}).get("status") \
+                    not in _TERMINAL:
+                continue
+            for u in urls[1:]:
+                code, st = _get(u, f"/check/{c['id']}")
+                if st.get("status") != c["final"].get("status") or \
+                        (st.get("result") or {}).get("valid") != \
+                        (c["final"].get("result") or {}).get("valid"):
+                    violate(f"terminal response for {c['id']} not "
+                            f"identical across replicas: "
+                            f"{st} vs {c['final']}")
+
+        # ---- gate 5: the victim's session is adopted by a survivor
+        # after lease expiry and closes with the facade verdict ----
+        if sess_id is not None:
+            surv = urls[1]
+            adopted = False
+            end = time.monotonic() + max(20.0, 6 * lease_ttl)
+            for seq in range(2, len(sess_blocks) + 1):
+                while True:
+                    code, r = _lg._post_json(
+                        surv, f"/session/{sess_id}/append",
+                        {"history": [op.to_dict()
+                                     for op in sess_blocks[seq - 1]],
+                         "seq": seq, "wait-s": 120})
+                    if code == 409 and r.get("cause") == \
+                            "session-pinned":
+                        # still leased to the dead victim — wait out
+                        # the TTL, the survivor will adopt
+                        if time.monotonic() > end:
+                            violate(f"session never adopted: {r}")
+                            break
+                        time.sleep(0.3)
+                        continue
+                    break
+                if code != 200 or r.get("valid-so-far") is not True:
+                    violate(f"post-kill session append {seq} bad: "
+                            f"{code} {r}")
+                    break
+                adopted = True
+            if adopted:
+                # adoption is counted on whichever path got there
+                # first: the append handler (serve.session.adopted)
+                # or the background fleet scan (serve.session.replayed
+                # after a lease steal) — either way the takeover must
+                # be in the ledger
+                scount = _stats(surv)
+                if scount.get("serve.session.adopted", 0) < 1 and \
+                        scount.get("serve.session.replayed", 0) < 1:
+                    violate("session continued on a survivor but no "
+                            "adoption/replay was ever counted")
+                code, r = _lg._post_json(
+                    surv, f"/session/{sess_id}/close", {})
+                sres = (r.get("result") or {}) if code == 200 else {}
+                report["session_close"] = {
+                    "valid": sres.get("valid"),
+                    "incremental": sres.get("incremental")}
+                stand = facade.auto_check_packed(
+                    models.cas_register(), h.pack(sess_hist), {})
+                if code != 200 or \
+                        sres.get("valid") is not stand["valid"]:
+                    violate(f"adopted session close diverges from "
+                            f"the standalone facade: {code} {r}")
+
+        # survivors end healthy, drained, and exit clean
+        for i, p in enumerate(survivors, start=1):
+            code, hz = _get(p.url, "/healthz")
+            if code != 200 or hz.get("ok") is not True:
+                violate(f"replica r{i} final /healthz not ok: "
+                        f"{code} {hz}")
+            if hz.get("degraded") is not False:
+                violate(f"replica r{i} degraded after failover")
+        pending_files = [f for f in os.listdir(jdir)
+                         if f.endswith(".req.json")
+                         and not os.path.exists(os.path.join(
+                             jdir, f[:-len(".req.json")]
+                             + ".done.json"))]
+        if pending_files:
+            violate(f"pending journal entries on disk: "
+                    f"{pending_files}")
+        for i, p in enumerate(survivors, start=1):
+            rc = p.sigterm()
+            if rc != 0:
+                violate(f"replica r{i} SIGTERM exit code {rc}")
+    except Exception as e:                              # noqa: BLE001
+        violate(f"fleet harness crashed: {type(e).__name__}: {e}")
+        for p in procs:
+            try:
+                if p.proc.poll() is None:
+                    p.sigkill()
+            except Exception:                           # noqa: BLE001
+                pass
+
+    report["ok"] = not report["violations"]
+    if not keep_store and report["ok"] and not opts.get("store_root"):
+        shutil.rmtree(root, ignore_errors=True)
+        report["store_root"] = None
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="self-nemesis chaos harness for the check-serve "
@@ -511,15 +808,23 @@ def main(argv=None) -> int:
                     help="CI smoke: one dispatch fault + one "
                          "SIGKILL/restart")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--fleet", action="store_true",
+                    help="N-replica fleet over one store root: "
+                         "SIGKILL one replica mid-load, gate on "
+                         "lease failover through the survivors")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="fleet size for --fleet (default 2)")
     ap.add_argument("--store-root", default=None,
                     help="use (and keep) this store root instead of "
                          "a temp dir")
     ap.add_argument("--keep-store", action="store_true",
                     help="keep the temp store root for inspection")
     args = ap.parse_args(argv)
-    report = run_chaos({"quick": args.quick, "seed": args.seed,
-                        "store_root": args.store_root,
-                        "keep_store": args.keep_store})
+    opts = {"quick": args.quick, "seed": args.seed,
+            "store_root": args.store_root,
+            "keep_store": args.keep_store,
+            "replicas": args.replicas}
+    report = run_fleet(opts) if args.fleet else run_chaos(opts)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report.get("ok") else 1
 

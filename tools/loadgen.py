@@ -301,8 +301,14 @@ def warmup(url: str, pool: List[Dict], *, burst: int = 8,
 def run_load(url: str, *, rate: float, duration: float,
              pool: List[Dict], poll_s: float = 0.01,
              poll_timeout: float = 120.0,
-             chaos_tolerant: bool = False) -> Dict[str, Any]:
+             chaos_tolerant: bool = False,
+             urls: Optional[List[str]] = None) -> Dict[str, Any]:
     """Drive the open-loop schedule; returns the report dict.
+
+    ``urls`` (fleet mode): submissions round-robin client-side over
+    the replica list (each request polls the replica it was admitted
+    by), and the report gains ``per_replica`` submitted/completed/
+    req_s splits beside the merged totals.
 
     ``chaos_tolerant`` (the chaos harness's mode): a connection
     refusal during a scripted daemon kill/restart is expected, not a
@@ -312,6 +318,7 @@ def run_load(url: str, *, rate: float, duration: float,
     gap, and the report carries ``recovery``: the time from the first
     refusal to the first verdict observed after it
     (recovery-time-to-first-verdict)."""
+    targets = list(urls) if urls else [url]
     records: List[Dict] = []
     rec_lock = threading.Lock()
     threads: List[threading.Thread] = []
@@ -333,10 +340,11 @@ def run_load(url: str, *, rate: float, duration: float,
                     and chaos["first_verdict_after"] is None:
                 chaos["first_verdict_after"] = time.monotonic()
 
-    def one(payload: Dict, t_sched: float) -> None:
+    def one(payload: Dict, t_sched: float, url: str) -> None:
         rec = {"tenant": payload["tenant"], "ops": payload["ops"],
                "expect": payload["expect"], "t_submit": t_sched,
-               "status": "lost", "latency_s": None, "match": None}
+               "status": "lost", "latency_s": None, "match": None,
+               "replica": url}
         t0 = time.monotonic()
         code, resp = _post(url, payload["body"])
         if chaos_tolerant and code == -1:
@@ -410,8 +418,10 @@ def run_load(url: str, *, rate: float, duration: float,
         if t_sched > now:
             time.sleep(t_sched - now)
         payload = pool[i % len(pool)]
-        th = threading.Thread(target=one, args=(payload, t_sched),
-                              daemon=True)
+        th = threading.Thread(
+            target=one,
+            args=(payload, t_sched, targets[i % len(targets)]),
+            daemon=True)
         th.start()
         threads.append(th)
         i += 1
@@ -463,6 +473,15 @@ def run_load(url: str, *, rate: float, duration: float,
                   if isinstance(r.get("service_s"),
                                 (int, float))]))},
     }
+    if len(targets) > 1:
+        report["per_replica"] = {
+            u: {"submitted": len(sub),
+                "completed": len(dn),
+                "req_s": round(len(dn) / wall, 2)}
+            for u in targets
+            for sub in [[r for r in records
+                         if r.get("replica") == u]]
+            for dn in [[r for r in sub if r["status"] == "done"]]}
     with chaos_lock:
         if chaos["refusals"]:
             rec_s = None
@@ -476,7 +495,7 @@ def run_load(url: str, *, rate: float, duration: float,
                     if r["status"] == "error-restart"),
                 "recovery_to_first_verdict_s": rec_s,
             }
-    code, stats = _get(url, "/stats")
+    code, stats = _get(targets[0], "/stats")
     if code == 200:
         report["stats"] = stats
         counters = stats.get("counters", {})
@@ -484,6 +503,12 @@ def run_load(url: str, *, rate: float, duration: float,
             k: v for k, v in counters.items()
             if k.startswith(("engine.fallback.",
                              "checker.swallowed."))}
+    if len(targets) > 1:
+        report["replica_stats"] = {}
+        for u in targets[1:]:
+            code, st = _get(u, "/stats")
+            if code == 200:
+                report["replica_stats"][u] = st
     return report
 
 
@@ -680,6 +705,12 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
                       model=opts.get("model", "cas-register"),
                       seed=int(opts.get("seed", 7)))
     url = opts.get("url")
+    replicas = [u for u in (opts.get("replicas") or []) if u]
+    if replicas:
+        # fleet mode: client-side round-robin over the replica list;
+        # the first replica doubles as the primary for warmup-era
+        # probes and the stats scrape
+        url = replicas[0]
     daemon = None
     if not url:
         from jepsen_tpu import serve
@@ -696,14 +727,22 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
         url = f"http://127.0.0.1:{daemon.port}"
     report: Dict[str, Any] = {}
     try:
-        if not wait_ready(url, timeout=float(
-                opts.get("ready_timeout", 60.0))):
-            report["error"] = f"daemon at {url} never became ready"
-            return report
+        for u in (replicas or [url]):
+            if not wait_ready(u, timeout=float(
+                    opts.get("ready_timeout", 60.0))):
+                report["error"] = f"daemon at {u} never became ready"
+                return report
         if opts.get("warmup", True):
-            report["warmup"] = warmup(
-                url, pool, burst=int(opts.get("warm_burst")
-                                     or (8 if quick else 16)))
+            burst = int(opts.get("warm_burst")
+                        or (8 if quick else 16))
+            if replicas:
+                # every replica compiles its own kernel geometries:
+                # an unwarmed sibling would bill its compile wall to
+                # the measured windows and sink the scaling number
+                report["warmup"] = {u: warmup(u, pool, burst=burst)
+                                    for u in replicas}
+            else:
+                report["warmup"] = warmup(url, pool, burst=burst)
         # scrape the e2e histogram around the measured run: the delta
         # is the measured window's distribution, warmup excluded
         hist_before = fetch_hist_buckets(url)
@@ -735,10 +774,31 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
             sess_thread.start()
         report.update(run_load(
             url, rate=rate, duration=duration, pool=pool,
-            chaos_tolerant=bool(opts.get("chaos_tolerant"))))
+            chaos_tolerant=bool(opts.get("chaos_tolerant")),
+            urls=replicas or None))
         if sess_thread is not None:
             sess_thread.join(600)
             report["sessions"] = sess_result
+        if replicas:
+            # fleet summary: merged throughput over N replicas, and
+            # the scaling efficiency against a caller-provided
+            # 1-replica baseline (req/s at N / (N * req/s at 1))
+            fleet: Dict[str, Any] = {
+                "replicas": len(replicas),
+                "per_replica": report.get("per_replica")}
+            base = opts.get("baseline_req_s")
+            if base:
+                fleet["baseline_req_s"] = float(base)
+                fleet["scaling_efficiency"] = round(
+                    report.get("sustained_req_s", 0.0)
+                    / (len(replicas) * float(base)), 3)
+            report["fleet"] = fleet
+            # the per-process daemon histograms cannot be compared
+            # against the MERGED client quantiles: skip the
+            # crosscheck in fleet mode (each replica's own histogram
+            # stays scrapeable via its /metrics)
+            report["url"] = url
+            return report
         hist_after = fetch_hist_buckets(url)
         # cross-check against the ADMISSION-anchored quantiles: the
         # daemon histogram measures admit->terminal, while the
@@ -793,6 +853,15 @@ def main(argv=None) -> int:
                     "check daemon")
     ap.add_argument("--url", default=None,
                     help="daemon base url; omitted = --self-host")
+    ap.add_argument("--replicas", default=None,
+                    help="fleet mode: comma-separated replica base "
+                         "urls; submissions round-robin client-side "
+                         "and the report carries per-replica req/s")
+    ap.add_argument("--baseline-req-s", type=float, default=None,
+                    help="1-replica sustained req/s baseline; with "
+                         "--replicas the report then carries "
+                         "scaling_efficiency = req_s_at_N / "
+                         "(N * baseline)")
     ap.add_argument("--self-host", action="store_true",
                     help="start an in-process daemon on an ephemeral "
                          "port")
@@ -830,8 +899,15 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.self_host and args.url:
         ap.error("--self-host and --url are mutually exclusive")
+    if args.replicas and (args.self_host or args.url):
+        ap.error("--replicas is mutually exclusive with "
+                 "--url/--self-host")
     report = run_loadgen({
-        "url": args.url, "rate": args.rate,
+        "url": args.url,
+        "replicas": ([u.strip() for u in args.replicas.split(",")
+                      if u.strip()] if args.replicas else None),
+        "baseline_req_s": args.baseline_req_s,
+        "rate": args.rate,
         "duration": args.duration, "tenants": args.tenants,
         "model": args.model, "violation_frac": args.violation_frac,
         "seed": args.seed, "store_root": args.store_root,
