@@ -57,7 +57,11 @@ warm caches), backpressure/timeout counts, and the daemon's final
 append-to-verdict latency of the device-resident carried-frontier
 engine vs the host ``OnlineLinearizable`` monitor at its production
 flush cadence, with the jax ``platform`` named so the device-vs-host
-comparison reads honestly on CPU-only runs.
+comparison reads honestly on CPU-only runs — and a ``"session_mux"``
+sub-object (ISSUE 16): L live same-geometry streams advanced through
+ONE vmapped mega-batch launch per wave vs L per-session launches, at
+several lane widths up to 5000 sessions, appends/s and p99 both ways
+with the measured crossover persisted to the autotune table.
 
 Usage: python bench.py [--ops N] [--repeat K]
        [--engine reach|chunked|batch|wgl-cpu|wgl-native]
@@ -568,6 +572,114 @@ def session_probe(n_ops: int = 100_000, seed: int = 42,
     return out
 
 
+def session_mux_probe(widths=(8, 64, 512, 5000), waves: int = 6,
+                      quick: bool = False) -> dict:
+    """The session-multiplexing rung (ISSUE 16): L live streams of
+    identical walk geometry advanced one wave at a time, first
+    member-by-member (L launches per wave — the pre-mux daemon) and
+    then through ``session.advance_group`` (ONE vmapped launch per
+    wave), at several lane widths. Streams use a closed two-value
+    alphabet so the geometry never regrows and every lane stays in
+    the group — the pure dispatch-amortization number, no coalescer
+    noise. Reports appends/s and p99 append-to-verdict both ways per
+    width (a batched append's latency is its wave's wall — the
+    append is not done until its launch lands), and persists the
+    measured crossover (the smallest width where the batch wins) in
+    the autotune table for ``session.mega_crossover``."""
+    import jax
+
+    from jepsen_tpu import models
+    from jepsen_tpu.checkers import autotune
+    from jepsen_tpu.op import invoke, ok
+    from jepsen_tpu.serve import session as sessmod
+    from jepsen_tpu.serve.session import Session
+
+    if quick:
+        widths = tuple(w for w in widths if w <= 64) or (8, 64)
+        waves = 3
+    b1 = [invoke(0, "write", 1), ok(0, "write", 1),
+          invoke(1, "read"), ok(1, "read", 1),
+          invoke(0, "write", 2), ok(0, "write", 2),
+          invoke(1, "read"), ok(1, "read", 2)]
+    bw = [invoke(1, "write", 1), ok(1, "write", 1),
+          invoke(0, "read"), ok(0, "read", 1),
+          invoke(0, "write", 2), ok(0, "write", 2),
+          invoke(1, "read"), ok(1, "read", 2)]
+    model = models.register()
+
+    def seed_sessions(prefix: str, n: int):
+        ss = [Session(f"{prefix}{i}", f"t{i % 8}", "register", model)
+              for i in range(n)]
+        for s in ss:                    # solo seed: signatures align
+            s.advance_block(b1, seq=1)
+        return ss
+
+    def drive(n: int, grouped: bool) -> dict:
+        ss = seed_sessions("mega" if grouped else "solo", n)
+        lats = []
+        t0 = time.monotonic()
+        valid = True
+        for w in range(waves):
+            entries = [(s, list(bw), w + 2) for s in ss]
+            # every lane's append "arrives" at the wave's cadence
+            # tick, so an append's latency runs from wave start to
+            # ITS verdict: the batched members all land with the
+            # launch; the per-session members queue behind their
+            # predecessors on the one dispatcher — the real shape
+            # mux replaces
+            t1 = time.monotonic()
+            if grouped:
+                # force: a previously persisted session-mega
+                # crossover must not silently re-route small widths
+                # to the per-session path mid-measurement
+                rs = sessmod.advance_group(entries, force=True)
+                lats.extend([time.monotonic() - t1] * n)
+            else:
+                for s, b, q in entries:
+                    r = s.advance_block(b, seq=q)
+                    lats.append(time.monotonic() - t1)
+                    valid = valid and r["valid-so-far"]
+                rs = []
+            valid = valid and all(r["valid-so-far"] for r in rs)
+        wall = time.monotonic() - t0
+        lats.sort()
+        return {"wall_s": round(wall, 3),
+                "appends_s": round(n * waves / wall),
+                "valid": valid,
+                "append_p99_s": round(
+                    lats[min(len(lats) - 1,
+                             int(len(lats) * 0.99))], 5)}
+
+    out: dict = {"platform": jax.default_backend(), "waves": waves,
+                 "block_ops": len(bw), "widths": {}}
+    crossover = None
+    for n in widths:
+        solo = drive(n, grouped=False)
+        mega_cold = drive(n, grouped=True)   # compile wall included
+        mega = drive(n, grouped=True)        # the daemon steady state
+        ratio = round(mega["appends_s"] / max(solo["appends_s"], 1),
+                      2)
+        out["widths"][str(n)] = {
+            "per_session": solo, "mega": mega,
+            "mega_cold_wall_s": mega_cold["wall_s"],
+            "speedup": ratio,
+            "p99_not_worse": (mega["append_p99_s"]
+                              <= solo["append_p99_s"]),
+        }
+        if not (solo["valid"] and mega["valid"]):
+            out["error"] = f"verdict drift at width {n}"
+        if crossover is None and ratio > 1.0:
+            crossover = n
+    out["headline"] = out["widths"][str(max(widths))]
+    if crossover is not None:
+        out["crossover"] = crossover
+        out["recorded"] = autotune.record(
+            "session-mega", "crossover", str(crossover),
+            metric=out["headline"]["speedup"],
+            detail={"widths": list(widths), "waves": waves})
+    return out
+
+
 def txn_probe(n_txns: int, seed: int) -> dict:
     """The transactional rung (ISSUE 9): a ``n_txns`` list-append
     history (key-rotated, the real Jepsen workload shape) with one
@@ -1068,6 +1180,15 @@ def main() -> int:
                     quick=args.quick)
         except Exception as e:                          # noqa: BLE001
             out["session"] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            # the multiplexing rung (ISSUE 16): L live streams, one
+            # vmapped launch per wave vs L per-session launches
+            with obs.span("bench.session_mux_probe"):
+                out["session_mux"] = session_mux_probe(
+                    quick=args.quick)
+        except Exception as e:                          # noqa: BLE001
+            out["session_mux"] = {"error":
+                                  f"{type(e).__name__}: {e}"}
     if args.txn:
         try:
             with obs.span("bench.txn_probe", txns=args.ops):

@@ -604,34 +604,44 @@ class NativeStreamEngine:
         types = np.empty(n, np.int32)
         procs = np.empty(n, np.int64)
         oids = np.full(n, -1, np.int32)
+        # locals for the per-op loop: this runs once per appended op
+        # on the session hot path, where bound-method and attribute
+        # re-lookup is a measurable fraction of the stage cost
+        tcode_get = _TCODE.get
+        oid = self._oid
+        pkey = self._pkey
+        live_inv = self._live_inv
+        live_pop = live_inv.pop
+        bind_ops = self._bind_ops
+        bind_val = self._bind_val
         m = 0
         for op in ops:
             p = op.process
             if p == "nemesis":
                 continue
-            t = _TCODE.get(op.type)
+            t = tcode_get(op.type)
             if t is None:
                 continue
             if t == 0:
                 # wildcard id: this op's crashed-at-invoke identity,
                 # used only by the unsettled-tail alarm
-                oids[m] = self._oid(op.f, op.value)
-                self._live_inv[p] = (len(self._bind_ops), op)
-                self._bind_ops.append(op)
+                oids[m] = oid(op.f, op.value)
+                live_inv[p] = (len(bind_ops), op)
+                bind_ops.append(op)
             else:
-                entry = self._live_inv.pop(p, None)
+                entry = live_pop(p, None)
                 if entry is None:
                     continue            # completion without invoke
                 bid, inv = entry
                 if t == 1:              # ok: completion value wins
                     val = op.value if op.value is not None else inv.value
-                    oids[m] = self._oid(inv.f, val)
-                    self._bind_val[bid] = val
+                    oids[m] = oid(inv.f, val)
+                    bind_val[bid] = val
                 elif t == 3:            # crashed: invoke value stands
-                    oids[m] = self._oid(inv.f, inv.value)
-                    self._bind_val[bid] = inv.value
+                    oids[m] = oid(inv.f, inv.value)
+                    bind_val[bid] = inv.value
             types[m] = t
-            procs[m] = self._pkey(p)
+            procs[m] = pkey(p)
             m += 1
         if m:
             self._feed_native(types[:m], procs[:m], oids[:m])

@@ -23,58 +23,60 @@ import numpy as np
 
 from jepsen_tpu.checkers._native_build import NativeLib
 
-_I32P = ctypes.POINTER(ctypes.c_int32)
-
-
-_I64P = ctypes.POINTER(ctypes.c_int64)
-_U8P = ctypes.POINTER(ctypes.c_uint8)
-_U64P = ctypes.POINTER(ctypes.c_uint64)
+# every array parameter is declared void* and receives a raw buffer
+# address (see _p): typed-POINTER marshaling builds a ctypes helper +
+# cast object per argument (~3us each), and the per-append monitor
+# path crosses this boundary enough times that typed pointers alone
+# cost more than the C call they wrap. dtype/layout discipline moves
+# to the call sites, which already allocate exact-dtype contiguous
+# arrays.
+_PTR = ctypes.c_void_p
 
 
 def _declare(lib: ctypes.CDLL) -> None:
     lib.jt_assign_slots.restype = ctypes.c_int64
     lib.jt_assign_slots.argtypes = [
-        ctypes.c_int64, _I32P, _I32P, ctypes.c_int64,
-        ctypes.c_int32, _I32P]
+        ctypes.c_int64, _PTR, _PTR, ctypes.c_int64,
+        ctypes.c_int32, _PTR]
     lib.jt_returns_view.restype = ctypes.c_int64
     lib.jt_returns_view.argtypes = [
-        ctypes.c_int64, _I32P, _I32P, _I32P, _I32P,
-        ctypes.c_int32, _I32P, _I32P, _I32P, _I32P]
+        ctypes.c_int64, _PTR, _PTR, _PTR, _PTR,
+        ctypes.c_int32, _PTR, _PTR, _PTR, _PTR]
     lib.jt_build_keyed.restype = ctypes.c_int64
     lib.jt_build_keyed.argtypes = [
-        ctypes.c_int64, _I64P, _I32P, _I32P, _I32P, _U8P, _U8P,
+        ctypes.c_int64, _PTR, _PTR, _PTR, _PTR, _PTR, _PTR,
         ctypes.c_int32, ctypes.c_int32,
-        _I32P, _I32P, _I32P, _I32P, _I32P, _I32P]
+        _PTR, _PTR, _PTR, _PTR, _PTR, _PTR]
     lib.jt_walk_dense.restype = ctypes.c_int64
     lib.jt_walk_dense.argtypes = [
-        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, _I32P,
-        ctypes.c_int32, _U64P, ctypes.c_int64, _I32P, _I32P]
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int64, _PTR,
+        ctypes.c_int32, _PTR, ctypes.c_int64, _PTR, _PTR]
     lib.jt_gen_history.restype = ctypes.c_int64
     lib.jt_gen_history.argtypes = [
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
-        ctypes.c_int32, _I32P, _I32P, _I32P, _I32P]
+        ctypes.c_int32, _PTR, _PTR, _PTR, _PTR]
     lib.jt_mon_new.restype = ctypes.c_void_p
     lib.jt_mon_new.argtypes = [ctypes.c_int32]
     lib.jt_mon_free.restype = None
     lib.jt_mon_free.argtypes = [ctypes.c_void_p]
     lib.jt_mon_feed.restype = ctypes.c_int64
     lib.jt_mon_feed.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, _I32P, _I64P, _I32P]
+        ctypes.c_void_p, ctypes.c_int64, _PTR, _PTR, _PTR]
     lib.jt_mon_advance.restype = ctypes.c_int64
     lib.jt_mon_advance.argtypes = [
-        ctypes.c_void_p, _I32P, ctypes.c_int32, ctypes.c_int32,
-        _U64P, ctypes.c_int64, _I32P]
+        ctypes.c_void_p, _PTR, ctypes.c_int32, ctypes.c_int32,
+        _PTR, ctypes.c_int64, _PTR]
     lib.jt_mon_tail.restype = ctypes.c_int64
     lib.jt_mon_tail.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, _I32P, _I32P, _I32P]
+        ctypes.c_void_p, ctypes.c_int64, _PTR, _PTR, _PTR]
     lib.jt_mon_drain.restype = ctypes.c_int64
     lib.jt_mon_drain.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, _I32P, _I32P, _I32P]
+        ctypes.c_void_p, ctypes.c_int64, _PTR, _PTR, _PTR]
     lib.jt_mon_stats.restype = ctypes.c_int64
-    lib.jt_mon_stats.argtypes = [ctypes.c_void_p, _I64P]
+    lib.jt_mon_stats.argtypes = [ctypes.c_void_p, _PTR]
     lib.jt_mon_live.restype = ctypes.c_int64
     lib.jt_mon_live.argtypes = [
-        ctypes.c_void_p, ctypes.c_int64, _I64P, _I32P]
+        ctypes.c_void_p, ctypes.c_int64, _PTR, _PTR]
 
 
 _NATIVE = NativeLib("preproc.cpp", "libjepsen_preproc.so", _declare)
@@ -85,8 +87,10 @@ def available() -> bool:
     return _NATIVE.available()
 
 
-def _p(a: np.ndarray) -> "ctypes.pointer":
-    return a.ctypes.data_as(_I32P)
+def _p(a: np.ndarray) -> int:
+    # raw buffer address for a void* parameter: ~3x cheaper than
+    # a.ctypes.data_as(POINTER(...)) on the per-append monitor path
+    return a.__array_interface__["data"][0]
 
 
 def assign_slots(kind: np.ndarray, entry: np.ndarray, n_entries: int,
@@ -158,9 +162,9 @@ def build_keyed(entry_off: np.ndarray, inv_rank: np.ndarray,
     key_R = np.empty(K, np.int32)
     ret_entry = np.empty(N, np.int32)
     R = int(lib.jt_build_keyed(
-        K, entry_off.ctypes.data_as(_I64P), _p(inv_rank), _p(ret_rank),
-        _p(opid), crashed.ctypes.data_as(_U8P),
-        noop_op.ctypes.data_as(_U8P), int(max_slots), int(max(w_cap, 1)),
+        K, _p(entry_off), _p(inv_rank), _p(ret_rank),
+        _p(opid), _p(crashed),
+        _p(noop_op), int(max_slots), int(max(w_cap, 1)),
         _p(ret_slot), _p(slot_ops), _p(pend), _p(key_W), _p(key_R),
         _p(ret_entry)))
     return (ret_slot[:R], slot_ops[:R], pend[:R], key_W, key_R,
@@ -199,6 +203,13 @@ class Monitor:
             raise RuntimeError("native lib unavailable")
         self._lib = lib
         self._h = ctypes.c_void_p(lib.jt_mon_new(int(max_slots)))
+        # stats() runs several times per session append; a reusable
+        # out-buffer with a pre-resolved address and a pre-bound C
+        # entry point halves its cost (safe: the owning engine is
+        # lock-serialized per session)
+        self._stats_fn = lib.jt_mon_stats
+        self._stats_out = np.zeros(5, np.int64)
+        self._stats_ptr = _p(self._stats_out)
 
     def __del__(self):
         h, self._h = getattr(self, "_h", None), None
@@ -214,7 +225,7 @@ class Monitor:
         oids = np.ascontiguousarray(oids, np.int32)
         return int(self._lib.jt_mon_feed(
             self._h, len(types), _p(types),
-            procs.ctypes.data_as(_I64P), _p(oids)))
+            _p(procs), _p(oids)))
 
     def advance(self, T: np.ndarray, R_words: np.ndarray
                 ) -> Tuple[int, int]:
@@ -227,7 +238,7 @@ class Monitor:
         dead = np.full(1, -1, np.int32)
         walked = int(self._lib.jt_mon_advance(
             self._h, _p(T), S, n_ops,
-            R_words.ctypes.data_as(_U64P), R_words.shape[1], _p(dead)))
+            _p(R_words), R_words.shape[1], _p(dead)))
         return walked, int(dead[0])
 
     def drain(self, cap: int, W: int):
@@ -257,8 +268,8 @@ class Monitor:
     def stats(self) -> Tuple[int, int, int, int, int]:
         """(settled_returns, queued_returns, live_invocations, W,
         front_settleable)."""
-        out = np.zeros(5, np.int64)
-        self._lib.jt_mon_stats(self._h, out.ctypes.data_as(_I64P))
+        out = self._stats_out
+        self._stats_fn(self._h, self._stats_ptr)
         return (int(out[0]), int(out[1]), int(out[2]), int(out[3]),
                 int(out[4]))
 
@@ -267,7 +278,7 @@ class Monitor:
         procs = np.empty(cap, np.int64)
         binds = np.empty(cap, np.int32)
         n = int(self._lib.jt_mon_live(
-            self._h, cap, procs.ctypes.data_as(_I64P), _p(binds)))
+            self._h, cap, _p(procs), _p(binds)))
         return procs[:n], binds[:n]
 
 
@@ -290,4 +301,4 @@ def walk_dense(T: np.ndarray, R_words: np.ndarray, W: int,
     assert R_words.dtype == np.uint64 and R_words.flags.c_contiguous
     return int(lib.jt_walk_dense(
         S, int(W), n_words, _p(T), n_ops,
-        R_words.ctypes.data_as(_U64P), L, _p(ret_slot), _p(rows)))
+        _p(R_words), L, _p(ret_slot), _p(rows)))

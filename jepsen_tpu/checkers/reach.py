@@ -494,14 +494,31 @@ class FrontierCarry:
             # word-packed body: the transition TABLE (with a -1
             # sentinel column for pad slots) is the only operand —
             # the O(O*S^2) dense P tensor is never materialized on
-            # this path (callers pass it lazily via p_build)
+            # this path (callers pass it lazily via p_build). The
+            # column axis pads to a power-of-two bucket: extra -1
+            # columns are never indexed by real ops (their ids stay
+            # below the true O) and pad slots hit the LAST column
+            # (also -1), so the walk is bit-identical — but session
+            # alphabets that grow at different rates land in the SAME
+            # walk geometry, which is what makes mega-batch grouping
+            # converge (and caps the daemon's compiled-walk count at
+            # log2-many table widths per S)
+            O1_pad = reach_word._pad_pow2(int(table.shape[1]) + 1, 8)
             Tpad = np.concatenate(
-                [table, -np.ones((S_t, 1), table.dtype)],
+                [table,
+                 -np.ones((S_t, O1_pad - int(table.shape[1])),
+                          table.dtype)],
                 axis=1).astype(np.int32)
             # plain device_put, NOT transfer.cached_put: the host
             # array is rebuilt per carry seed, so the identity-keyed
             # cache could never hit — it would only pin dead copies
             self._T = jax.device_put(Tpad)
+            # host mirror for the mega gather: the table never
+            # changes after seeding, so a mega-group can stack lane
+            # tables with one numpy concat + ONE device put instead
+            # of per-lane device stacking (reach_word
+            # .advance_frontiers_mega)
+            self._T_host = Tpad
             # the [S, M] bool seed packs to S word vectors — fewer
             # wire bytes than even the bit-packed dense seed
             if self._nw == 1:

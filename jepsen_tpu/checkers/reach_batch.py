@@ -571,9 +571,10 @@ def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
                 dense format host-side (f32 seed, signed narrow ops —
                 every built segment too, so the record covers the rest
                 of the walk), account the re-uploads, and re-walk
-                segments 0..i undonated from the seed."""
+                segments 0..i undonated from the seed. The record lands
+                only after the dense re-walk succeeds — a failure that
+                persists dense was never the packed wire's fault."""
                 nonlocal sextet
-                obs.engine_fallback("packed-xfer", type(exc).__name__)
                 extra = 0
                 if getattr(dsegs["dR0"], "dtype", None) == np.uint8:
                     dense = transfer.unpack_bool_host(
@@ -606,7 +607,9 @@ def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
                 R = dsegs["dR0"]
                 for k in range(i):
                     _c, R = run(*dsegs["segs"][k], dsegs["dP"], R)
-                return run(*dsegs["segs"][i], dsegs["dP"], R)
+                out = run(*dsegs["segs"][i], dsegs["dP"], R)
+                obs.engine_fallback("packed-xfer", type(exc).__name__)
+                return out
 
             if use_donate:
                 # exactly one `donate` record; the donated carry may

@@ -329,12 +329,15 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
             _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
                                    seed_a)
         except Exception as e:                          # noqa: BLE001
-            obs.engine_fallback("packed-xfer", type(e).__name__)
-            # the dense retry re-crosses the whole phase-A operand set
+            # the dense retry re-crosses the whole phase-A operand set;
+            # the ONE fallback record lands only if it succeeds — a
+            # failure that persists dense (e.g. Pallas unsupported on
+            # this backend) was not the packed wire's fault
             transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
                                + r0_a.nbytes, 0)
             _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
                                    jnp.asarray(r0_a))
+            obs.engine_fallback("packed-xfer", type(e).__name__)
     else:
         transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
                            + r0_a.nbytes, a_base)

@@ -433,14 +433,16 @@ def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
     except Exception as e:                              # noqa: BLE001
         if not (packed or key_dt != np.int32):
             raise
-        # same packed-wire contract as the pipe walk: ONE fallback
-        # record, retry the round-5 dense format, count the re-upload
-        obs.engine_fallback("packed-xfer", type(e).__name__)
+        # same packed-wire contract as the pipe walk: retry the round-5
+        # dense format, count the re-upload, and land the ONE fallback
+        # record only once the dense retry succeeds — a dense failure
+        # too means packedness was not the cause, propagate unrecorded
         host_args = (host_args[0], so_dense,
                      np.ascontiguousarray(key_id, np.int32),
                      host_args[3])
         transfer.count_put(sum(a.nbytes for a in host_args), 0)
         (dead,) = run(*jax.device_put(host_args))
+        obs.engine_fallback("packed-xfer", type(e).__name__)
     return np.asarray(dead)[:n_keys]
 
 
@@ -542,7 +544,6 @@ def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
             # record, dense retry, re-upload counted
             if getattr(R_cur, "dtype", None) != np.uint8:
                 raise
-            obs.engine_fallback("packed-xfer", type(e).__name__)
             dense = transfer.unpack_bool_host(np.asarray(R_cur), M * S)
             R_cur = jax.device_put(
                 dense.reshape(M, S).astype(np.float32))
@@ -550,6 +551,10 @@ def _walk_segmented(host_args, geom, n_pass: int, interpret: bool,
             ckpt, final = run(ret_slot[base:base + seg],
                               slot_ops_flat[base * W:(base + seg) * W],
                               dP, R_cur)
+            # dense retry succeeded → the packed seed was at fault:
+            # land the ONE fallback record (a dense failure propagates
+            # unrecorded — backend breakage, not the packed wire)
+            obs.engine_fallback("packed-xfer", type(e).__name__)
         final_np = np.asarray(final)
         if not final_np.any():
             # dead in this segment: locate the first empty checkpoint
@@ -737,9 +742,10 @@ def _pipe_walk(host_args, geom, n_pass: int, interpret: bool,
                 dense format host-side (f32 seed, signed narrow ops —
                 every built segment too, so the record covers the rest
                 of the walk), account the re-uploads, and re-walk
-                segments 0..i undonated from the seed."""
+                segments 0..i undonated from the seed. The record lands
+                only after the dense re-walk succeeds — a failure that
+                persists dense was never the packed wire's fault."""
                 nonlocal sextet
-                obs.engine_fallback("packed-xfer", type(exc).__name__)
                 extra = 0
                 if getattr(dsegs["dR0"], "dtype", None) == np.uint8:
                     dense = transfer.unpack_bool_host(
@@ -765,7 +771,9 @@ def _pipe_walk(host_args, geom, n_pass: int, interpret: bool,
                 R = dsegs["dR0"]
                 for k in range(i):
                     _c, R = run(*dsegs["segs"][k], dsegs["dP"], R)
-                return run(*dsegs["segs"][i], dsegs["dP"], R)
+                out = run(*dsegs["segs"][i], dsegs["dP"], R)
+                obs.engine_fallback("packed-xfer", type(exc).__name__)
+                return out
 
             if use_donate:
                 # exactly one `donate` record; the rest of the walk

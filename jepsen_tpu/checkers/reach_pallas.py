@@ -289,13 +289,16 @@ def walk_returns(P: np.ndarray, ret_slot: np.ndarray,
     except Exception as e:                              # noqa: BLE001
         if not packed:
             raise
-        # a packed-wire dispatch failed: ONE fallback record, retry the
-        # dense round-5 format (same contract as the other engines);
-        # the re-upload's bytes are counted — they really crossed
-        obs.engine_fallback("packed-xfer", type(e).__name__)
+        # a packed-wire dispatch failed: retry the dense round-5 format
+        # (same contract as the other engines); the re-upload's bytes
+        # are counted — they really crossed. The ONE fallback record
+        # lands only after the dense retry succeeds: a failure that
+        # persists dense (backend capability, geometry) was never the
+        # packed wire's fault and propagates unrecorded
         host_args = _dense_args()
         transfer.count_put(sum(a.nbytes for a in host_args), 0)
         R_out, dead = call(*jax.device_put(host_args))
+        obs.engine_fallback("packed-xfer", type(e).__name__)
     return int(dead[0]), (np.asarray(R_out, bool).T if fetch_R else None)
 
 
@@ -465,10 +468,12 @@ def walk_returns_keyed(P: np.ndarray, ret_slot: np.ndarray,
     except Exception as e:                              # noqa: BLE001
         if not packed:
             raise
-        # same packed-wire contract as walk_returns: one fallback
-        # record, dense retry, re-upload bytes counted
-        obs.engine_fallback("packed-xfer", type(e).__name__)
+        # same packed-wire contract as walk_returns: dense retry with
+        # re-upload bytes counted, ONE fallback record only once the
+        # dense retry succeeds (a dense failure too means the packed
+        # wire was not at fault — propagate unrecorded)
         host_args = _dense_args()
         transfer.count_put(sum(a.nbytes for a in host_args), 0)
         (dead,) = call(*jax.device_put(host_args))
+        obs.engine_fallback("packed-xfer", type(e).__name__)
     return np.asarray(dead)[:n_keys]

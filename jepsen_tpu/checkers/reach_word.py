@@ -219,6 +219,18 @@ def _jitted_walk_words_batch():
     return jax.jit(jax.vmap(_walk_words, in_axes=(None, 0, 0, 0)))
 
 
+@functools.cache
+def _jitted_walk_words_mega():
+    """vmap over the SESSION lane axis (mega-batch session dispatch):
+    unlike the lockstep batch seam, every lane carries its OWN
+    transition table — mega-group members share a walk geometry
+    (S, O, W, NW), not a model memo. Like the per-session walk jits,
+    deliberately NOT donated (the PR-10 aliased-buffer corruption
+    finding applies to any carried frontier)."""
+    import jax
+    return jax.jit(jax.vmap(_walk_words, in_axes=(0, 0, 0, 0)))
+
+
 def _pad_pow2(n: int, floor: int = 64) -> int:
     return max(floor, 1 << max(0, (n - 1)).bit_length())
 
@@ -262,3 +274,131 @@ def walk_returns_words(table: np.ndarray, ret_slot: np.ndarray,
     if not bool(any_dead):
         return -1, np.asarray(R)
     return min(int(first), max(n - 1, 0)), np.asarray(R)
+
+
+# -- mega-batch session advance ---------------------------------------------
+
+def mega_geometry(carry) -> Optional[tuple]:
+    """The walk-geometry signature a :class:`~.reach.FrontierCarry`
+    contributes to a mega-group, or ``None`` when the carry cannot
+    participate (dense body, or word walks opted out). Members of one
+    group must agree on every compiled dimension of the batched walk:
+    state count, padded table width, slot count, and words per
+    state — nothing else (tables and frontiers are per-lane
+    operands). Cached on the carry: a carry instance's geometry is
+    fixed at seed (growth replaces the instance), and this runs
+    several times per append on the mega hot path."""
+    g = getattr(carry, "_mega_geom", False)
+    if g is not False:
+        return g
+    if not getattr(carry, "words", False):
+        g = None
+    else:
+        O1 = int(carry._T.shape[1])          # includes the -1 sentinel
+        g = (int(carry.S), O1, int(carry.W), int(carry._nw))
+    carry._mega_geom = g
+    return g
+
+
+def advance_frontiers_mega(carries, blocks) -> list:
+    """ONE kernel launch advances every member of a same-geometry
+    mega-group: member frontiers and their per-lane transition
+    tables are stacked along a lane axis ON HOST (numpy) and cross
+    the wire as ONE put, walked through
+    :func:`_jitted_walk_words_mega`, and scattered back to their
+    owning carries from ONE bulk fetch. Host-side assembly is the
+    point, not a compromise: stacking thousands of tiny per-lane
+    device arrays (and lazily slicing the result back out) costs
+    ~1ms of dispatch overhead PER LANE on the host-bound path —
+    drowning the walk itself — while a numpy gather is ~1us per
+    lane and the whole group's operands are a few hundred KB.
+    Between mega waves a member's frontier lives as host word
+    vectors (its next solo advance re-puts ``[S, NW]`` words — a
+    few dozen bytes). Ragged member block lengths are handled the
+    way every walk body handles padding: each lane pads to the
+    common power-of-two length with identity steps
+    (``ret_slot = -1``), which cannot kill a live set, so each lane
+    is effectively masked dead-proof past its own length and death
+    indices stay exact per lane.
+
+    ``blocks`` is a list of ``(ret_slot, slot_ops)`` pairs aligned
+    with ``carries``. Returns the per-member list of exact first dead
+    return indices (-1 = survived), with each carry's frontier and
+    ``advanced_returns`` updated exactly as its own
+    :meth:`~.reach.FrontierCarry.advance` would have — the
+    differential suite pins the two bit-identical. Lane count pads to
+    a power of two (the PR-4 idiom: log2-many compiled lane
+    geometries) with all-zero lanes whose results are discarded."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers import transfer
+
+    L = len(carries)
+    if L == 0:
+        return []
+    sig = mega_geometry(carries[0])
+    assert sig is not None
+    for c in carries[1:]:
+        assert mega_geometry(c) == sig, "mega-group geometry mismatch"
+    W = int(carries[0].W)
+    nw = int(carries[0]._nw)
+    min_block = getattr(carries[0], "_MIN_BLOCK", 64)
+    n_pad = max(min_block,
+                max(_pad_pow2(max(len(rs), 1), min_block)
+                    for rs, _ in blocks))
+    L_pad = 1 << max(0, (L - 1)).bit_length()
+    rs = np.full((L_pad, n_pad), -1, np.int32)
+    so = np.full((L_pad, n_pad, W), -1, np.int32)
+    for i, (b_rs, b_so) in enumerate(blocks):
+        n = len(b_rs)
+        rs[i, :n] = b_rs
+        so[i, :n] = b_so
+
+    def _lane_T(c):
+        T_h = getattr(c, "_T_host", None)
+        return T_h if T_h is not None else np.asarray(c._T)
+
+    def _lane_R(c):
+        r = c._R
+        # first mega wave after a seed/solo advance still holds a
+        # device frontier; every later wave finds host words here
+        if not isinstance(r, np.ndarray):
+            r = np.asarray(r)
+        return r if nw > 1 else r[:, None]
+
+    # pad lanes are all-zero: their streams are pure identity steps
+    # (ret_slot = -1), their outputs are never read, and calloc'd
+    # rows are cheaper than stacking replicas of a real lane
+    real_T = np.stack([_lane_T(c) for c in carries])
+    real_R = np.stack([_lane_R(c) for c in carries])
+    if L_pad > L:
+        T_h = np.zeros((L_pad,) + real_T.shape[1:], real_T.dtype)
+        R0_h = np.zeros((L_pad,) + real_R.shape[1:], real_R.dtype)
+        T_h[:L] = real_T
+        R0_h[:L] = real_R
+    else:
+        T_h, R0_h = real_T, real_R
+    transfer.count_put(
+        int(rs.nbytes + so.nbytes + T_h.nbytes + R0_h.nbytes),
+        int((rs.size + so.size) * 4 + T_h.nbytes + R0_h.nbytes))
+    R, any_dead, first = _jitted_walk_words_mega()(
+        jnp.asarray(T_h), jnp.asarray(R0_h), jnp.asarray(rs),
+        jnp.asarray(so))
+    obs.count("reach.word_walk_mega")
+    any_np = np.asarray(any_dead)
+    first_np = np.asarray(first)
+    # ONE bulk fetch brings every real lane's frontier home; the
+    # scatter below is numpy views, not per-lane device slices
+    R_h = np.asarray(R[:L]) if L_pad > L else np.asarray(R)
+    deads = []
+    for i, c in enumerate(carries):
+        n = len(blocks[i][0])
+        c._R = R_h[i] if nw > 1 else R_h[i, :, 0]
+        if n == 0 or not bool(any_np[i]):
+            dead = -1
+            c.advanced_returns += n
+        else:
+            dead = min(int(first_np[i]), n - 1)
+            c.advanced_returns += dead + 1
+        deads.append(dead)
+    return deads

@@ -60,6 +60,12 @@ from jepsen_tpu.serve import request as rq
 # costs pack efficiency, never correctness.
 _W_HINT = 5
 
+# lane cap for one mega-batch session group: the batched walk pads
+# the lane axis to a power of two, so the cap bounds the largest
+# compiled lane geometry (and the per-launch stream buffer) without
+# limiting throughput — excess sessions simply ride the next group
+_MEGA_GROUP_CAP = 1024
+
 
 class Backpressure(RuntimeError):
     """The admission queue is at its bound; the client should retry
@@ -79,19 +85,56 @@ def plan_admission(requests: Sequence["rq.CheckRequest"], *,
     waited longest heads every group it appears in.
 
     Session blocks (append/close) are the exception to length
-    bucketing: a session's compatibility signature is its id, so a
-    call here only ever sees ONE session's blocks — they become a
+    bucketing. Blocks sharing one solo per-session signature become a
     single dispatch group in strict seq order (splitting them across
     length buckets could dispatch block 3 before block 2, and a
-    carried frontier cannot be advanced out of order)."""
+    carried frontier cannot be advanced out of order). Blocks sharing
+    a MEGA signature span many sessions: sessions are ranked
+    oldest-tenant-first (then oldest-session-first within a tenant —
+    the same fairness the one-shot path applies to requests), chunked
+    into groups of at most ``_MEGA_GROUP_CAP`` sessions, and each
+    session's blocks stay contiguous in seq order inside its group
+    (the dispatcher advances one wave of same-rank blocks per batched
+    launch)."""
     from jepsen_tpu.checkers import reach_batch
 
     if not requests:
         return []
     if requests[0].session is not None:
-        return [sorted(range(len(requests)),
+        by_sess: Dict[str, List[int]] = {}
+        for i, r in enumerate(requests):
+            by_sess.setdefault(r.session.id, []).append(i)
+        order = sorted(range(len(requests)),
                        key=lambda i: (requests[i].seq,
-                                      requests[i].t_submit, i))]
+                                      requests[i].t_submit, i))
+        if len(by_sess) == 1:
+            return [order]
+        oldest_of: Dict[str, float] = {}
+        sess_oldest: Dict[str, float] = {}
+        sess_tenant: Dict[str, str] = {}
+        for r in requests:
+            t = oldest_of.get(r.tenant)
+            if t is None or r.t_submit < t:
+                oldest_of[r.tenant] = r.t_submit
+            t = sess_oldest.get(r.session.id)
+            if t is None or r.t_submit < t:
+                sess_oldest[r.session.id] = r.t_submit
+            sess_tenant[r.session.id] = r.tenant
+        ranked = sorted(
+            by_sess,
+            key=lambda sid: (oldest_of[sess_tenant[sid]],
+                             sess_tenant[sid], sess_oldest[sid], sid))
+        out: List[List[int]] = []
+        for lo in range(0, len(ranked), _MEGA_GROUP_CAP):
+            chunk = ranked[lo:lo + _MEGA_GROUP_CAP]
+            g: List[int] = []
+            for sid in chunk:
+                g.extend(sorted(
+                    by_sess[sid],
+                    key=lambda i: (requests[i].seq,
+                                   requests[i].t_submit, i)))
+            out.append(g)
+        return out
     lens = [max(1, int(r.packed.n)) for r in requests]
     groups = reach_batch.plan_buckets(lens, w_hint, group=group)
     oldest_of: Dict[str, float] = {}
@@ -270,8 +313,12 @@ class AdmissionQueue:
             # engine stamps t_dispatch when the device call starts)
             r.t_coalesce = now
             r.status = rq.DISPATCHED
-        if batch[0].session is not None:
-            self._inflight_sessions.add(batch[0].session.id)
+        for r in batch:
+            # EVERY member session (a mega group spans many) is
+            # excluded from re-selection while the group is anywhere
+            # in flight — the seq-order guard
+            if r.session is not None:
+                self._inflight_sessions.add(r.session.id)
         obs.gauge("serve.queue_depth", len(self._queued))
         if len(batch) > 1:
             obs.count("serve.coalesced", len(batch))
@@ -316,9 +363,29 @@ class AdmissionQueue:
         if not eligible:
             return []
         # one model signature per dispatch group: the one whose oldest
-        # eligible request has waited longest
-        sig = eligible[0].model_sig
-        same = [r for r in eligible if r.model_sig == sig]
+        # eligible request has waited longest. Signatures are read
+        # ONCE per request through a per-SESSION snapshot: the mega
+        # signature is a lock-free cached read that a concurrent
+        # close/sweep may flip mid-pass, and two reads of one
+        # session's blocks straddling the flip could admit block k+1
+        # while excluding block k — a seq reorder. One read per
+        # session per pass makes that impossible (a stale snapshot
+        # only costs grouping efficiency; stage-time re-validation
+        # under the session lock owns correctness).
+        sess_sig: Dict[str, Optional[tuple]] = {}
+        sigs: Dict[int, tuple] = {}
+        for r in eligible:
+            if r.session is not None and r.kind == "session-append":
+                sid = r.session.id
+                if sid not in sess_sig:
+                    sess_sig[sid] = r.session.mega_sig()
+                g = sess_sig[sid]
+                sigs[id(r)] = (("session-mega",) + g if g is not None
+                               else ("session", sid))
+            else:
+                sigs[id(r)] = r.model_sig
+        sig = sigs[id(eligible[0])]
+        same = [r for r in eligible if sigs[id(r)] == sig]
         groups = plan_admission(same, group=self.group)
         # anti-starvation: dispatch the group holding the OLDEST
         # request (same[0]), not unconditionally the longest bucket —
@@ -343,8 +410,9 @@ class AdmissionQueue:
                     self._inflight[r.tenant] = n
                 else:
                     self._inflight.pop(r.tenant, None)
-            if batch and batch[0].session is not None:
-                self._inflight_sessions.discard(batch[0].session.id)
+            for r in batch:
+                if r.session is not None:
+                    self._inflight_sessions.discard(r.session.id)
             if lane is not None and batch:
                 self._lane_load[lane] = \
                     max(0, self._lane_load[lane] - 1)

@@ -637,6 +637,89 @@ class Dispatcher:
         obs.count("serve.session.advance_wall_s",
                   time.monotonic() - t0)
 
+    def _dispatch_session_group(self, batch: List["rq.CheckRequest"],
+                                lane: "_Lane") -> None:
+        """Mega-batch session group: append blocks of MANY sessions
+        sharing one walk geometry, advanced in waves — wave ``w`` is
+        every member session's ``w``-th queued block, and one wave is
+        ONE batched kernel launch (``session.advance_group``). Member
+        isolation is the group-advance contract: one member's device
+        death falls that session to its host monitor while the rest
+        of the wave completes on device. Like the solo session path:
+        no recovery ladder, no breaker, no device-time attribution."""
+        from jepsen_tpu.serve import session as sessmod
+        by_sess: Dict[str, List["rq.CheckRequest"]] = {}
+        for r in batch:
+            by_sess.setdefault(r.session.id, []).append(r)
+        sig = f"session-mega/L{len(by_sess)}/A{len(batch)}"
+        with self._counts_lock:
+            self.dispatch_counts[sig] = \
+                self.dispatch_counts.get(sig, 0) + 1
+        obs.count("serve.dispatched", len(batch))
+        obs.count(f"serve.lane.{lane.idx}.dispatched")
+        obs.gauge("serve.inflight", len(batch))
+        t0 = time.monotonic()
+        waves = max(len(rs) for rs in by_sess.values())
+        for w in range(waves):
+            wave = [rs[w] for rs in by_sess.values() if w < len(rs)]
+            tw = time.monotonic()
+            for r in wave:
+                r.t_dispatch = tw
+                obs.histogram(
+                    "serve.queue_wait_s",
+                    max(0.0, (r.t_coalesce or tw) - r.t_submit))
+                self.registry.ledger_record(
+                    r.tenant, "dispatched", id=r.id,
+                    group=len(batch), ops=int(r.n_ops),
+                    session=r.session.id, kind=r.kind,
+                    mega=len(wave))
+            with obs.capture() as cap:
+                try:
+                    results = sessmod.advance_group(
+                        [(r.session, list(r.history), r.seq)
+                         for r in wave],
+                        should_abort=self._session_abort(tw))
+                except Exception as e:                  # noqa: BLE001
+                    # the group advance's own ladders should have
+                    # contained this; a residual crash is recorded,
+                    # never fatal, and every member gets a verdict
+                    log.warning("mega session wave crashed: %r", e,
+                                exc_info=e)
+                    obs.engine_fallback("serve-dispatch",
+                                        type(e).__name__,
+                                        mega=len(wave))
+                    results = [{"valid": "unknown",
+                                "error": f"{type(e).__name__}: {e}"}
+                               for _ in wave]
+            now = time.monotonic()
+            recs = [rec for rec in cap.ledger
+                    if rec.get("event") in ("fallback", "route",
+                                            "selected")]
+            for r, res in zip(wave, results):
+                r.t_collect = now
+                # group-level records (no session tag — e.g. the ONE
+                # session-mega launch fallback) stitch to every
+                # member; session-tagged ones only to their owner
+                mine = [rec for rec in recs
+                        if rec.get("session") in (None, True,
+                                                  r.session.id)]
+                r.stitch([{"ts": round(time.time(), 6),
+                           "stage": "session-advance",
+                           "event": "advance", "session": r.session.id,
+                           "seq": r.seq, "mega": len(wave),
+                           "wall_s": round(now - tw, 6)}] + mine)
+                for rec in mine:
+                    if rec.get("event") == "fallback":
+                        self.registry.ledger_record(
+                            r.tenant, "engine-fallback", id=r.id,
+                            stage=rec.get("stage"),
+                            cause=rec.get("cause"))
+                obs.histogram("serve.session.append_s",
+                              now - r.t_submit)
+                self._finish(r, res, now - r.t_dispatch, now)
+        obs.count("serve.session.advance_wall_s",
+                  time.monotonic() - t0)
+
     def _dispatch(self, batch: List["rq.CheckRequest"],
                   lane: Optional["_Lane"] = None) -> None:
         # single-lane callers (tests drive _dispatch directly) default
@@ -647,7 +730,13 @@ class Dispatcher:
         # here); never raises for the shipped fault grammar
         faults.fire("tick")
         if batch[0].session is not None:
-            self._dispatch_session(batch, lane)
+            if len({r.session.id for r in batch}) > 1:
+                # multi-session group: the coalescer only builds one
+                # when every block is an append sharing a mega-batch
+                # walk-geometry signature
+                self._dispatch_session_group(batch, lane)
+            else:
+                self._dispatch_session(batch, lane)
             return
         req0 = batch[0]
         sig = f"{req0.model_name}/H{len(batch)}"

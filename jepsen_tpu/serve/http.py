@@ -158,6 +158,15 @@ def parse_check_body(body: bytes, content_type: str,
     return tenant, model_name, ops, options, timeout_s, idem
 
 
+class _Server(ThreadingHTTPServer):
+    """The stdlib threading server with a listen backlog sized for
+    burst arrivals: the default 5 drops (RST) concurrent connects the
+    accept loop has not reached yet, which a thousand-session open
+    wave hits immediately. The backlog is pending CONNECTS only —
+    admission backpressure still bounds accepted work."""
+    request_queue_size = 128
+
+
 class Daemon:
     """Everything the serving layer owns: registry, admission queue,
     dispatcher thread, HTTP server. ``start()`` returns after the
@@ -272,7 +281,7 @@ class Daemon:
         self._sweeper: Optional[threading.Thread] = None
         self._sweeper_stop = threading.Event()
         handler = type("Handler", (_Handler,), {"daemon_ref": self})
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd = _Server((host, port), handler)
         self._serve_thread: Optional[threading.Thread] = None
         self.accepting = True
 

@@ -113,12 +113,27 @@ class CheckRequest:
         union-alphabet stage A is built per model identity) AND same
         engine options (a group shares one walk, so differing caps
         cannot both be honored; clients who set none share freely).
-        Session blocks key on the SESSION id instead: a session's
+        Session blocks key on the SESSION instead: a session's
         appends must advance its carried frontier in order, so they
-        coalesce only with each other (queued appends of one session
-        batch into one ordered dispatch — the continuous-batching win
-        applied to a stream)."""
+        never coalesce with one-shot checks. Appends of sessions
+        whose carried frontiers compile to the SAME batched walk
+        share a mega-batch signature (``("session-mega",) + walk
+        geometry``): the coalescer may stack thousands of such
+        streams along a lane axis and advance them all in ONE kernel
+        launch. Sessions that cannot participate (txn engines, host
+        fallbacks, unseeded/dense carries, closes) keep the solo
+        per-session-id signature. The mega signature reads the
+        session's LOCK-FREE cached geometry — a stale value degrades
+        grouping, never correctness: membership is re-validated under
+        the session lock at stage time, and per-session seq order is
+        safe because a close always queues after its appends (later
+        t_submit) and the coalescer selects by oldest-request
+        signature."""
         if self.session is not None:
+            if self.kind == "session-append":
+                g = self.session.mega_sig()
+                if g is not None:
+                    return ("session-mega",) + g
             return ("session", self.session.id)
         return (type(self.model).__name__, repr(self.model),
                 tuple(sorted(self.opts.items())))

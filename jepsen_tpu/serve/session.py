@@ -57,13 +57,14 @@ from __future__ import annotations
 
 import logging
 import threading
+import contextlib
 import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence
 
 from jepsen_tpu import history as h
 from jepsen_tpu import obs
-from jepsen_tpu.checkers import online
+from jepsen_tpu.checkers import online, reach_word
 from jepsen_tpu.models import Model
 from jepsen_tpu.op import Op
 from jepsen_tpu.serve import faults
@@ -146,10 +147,17 @@ class DeviceFrontierEngine(online.NativeStreamEngine):
         return self._carry
 
     # -- the walks (device) ----------------------------------------------
-    def advance(self, run_over: bool = False
-                ) -> Optional[Dict[str, Any]]:
+    def stage_advance(self, run_over: bool = False):
+        """First half of :meth:`advance`: drain the monitor and
+        return the staged walk operands ``(carry, ret_slot, slot_ops,
+        binds)``, or None when there is nothing to walk. The split
+        exists for the mega-batch dispatcher: N same-geometry
+        sessions stage, ONE batched kernel advances every carry, each
+        session commits — and :meth:`advance` itself is the
+        degenerate one-member composition, so the solo and batched
+        paths cannot drift."""
         if self.violation is not None:
-            return self.violation
+            return None
         self._drain()
         if run_over:
             # base-class semantics: stragglers resolve as crashed,
@@ -163,13 +171,30 @@ class DeviceFrontierEngine(online.NativeStreamEngine):
         rows, slots, binds = self._mon.drain(queued, self.W)
         if len(slots) == 0:
             return None
-        dead = self._ensure_carry().advance(slots, rows)
+        return (self._ensure_carry(), slots, rows, binds)
+
+    def commit_advance(self, staged,
+                       dead: int) -> Optional[Dict[str, Any]]:
+        """Second half of :meth:`advance`: account the walked block
+        and resolve the exact death index into a violation."""
+        _carry, slots, _rows, binds = staged
         n = len(slots) if dead < 0 else dead + 1
         self.settled_returns += n
         self.walked_events += n
         if dead >= 0:
             self.violation = self._violation_at(int(binds[dead]))
         return self.violation
+
+    def advance(self, run_over: bool = False
+                ) -> Optional[Dict[str, Any]]:
+        if self.violation is not None:
+            return self.violation
+        staged = self.stage_advance(run_over)
+        if staged is None:
+            return None
+        carry, slots, rows, _binds = staged
+        dead = carry.advance(slots, rows)
+        return self.commit_advance(staged, dead)
 
     def tail_alarm(self) -> Optional[Dict[str, Any]]:
         if self.violation is not None or self.memo is None:
@@ -300,7 +325,8 @@ class Session:
                             "replayed", "fallbacks")}
     _LOCK_ASSUMED = ("_route", "_to_host_monitor", "_advance_engine",
                      "_append_verdict", "_close_incremental",
-                     "_exact_final")
+                     "_exact_final", "_update_mega_sig",
+                     "_stage_block", "_finish_block")
 
     def __init__(self, sid: str, tenant: str, model_name: str,
                  model: Model, opts: Optional[Dict[str, Any]] = None
@@ -332,6 +358,13 @@ class Session:
         self._host: Optional[online.OnlineLinearizable] = None
         self._eng: Any = None
         self.engine_name = "session-host"
+        # cached mega-batch walk-geometry signature (None = cannot
+        # participate). Written ONLY under the lock (at the end of
+        # every append/close/fallback transition); read lock-free by
+        # the coalescer's signature property — a stale read degrades
+        # grouping efficiency, never correctness, because group
+        # membership is re-validated at stage time under the lock.
+        self._mega_sig: Optional[tuple] = None
         self._route()
 
     # -- route selection -------------------------------------------------
@@ -393,6 +426,28 @@ class Session:
         self.engine_name = "session-host-monitor"
         if mon.violation is not None and self.violation is None:
             self.violation = dict(mon.violation)
+        self._update_mega_sig()
+
+    # -- mega-batch eligibility ------------------------------------------
+    def mega_sig(self) -> Optional[tuple]:
+        """The session's walk-geometry signature for mega-batch
+        grouping (same tuple for every session whose carried frontier
+        compiles to the same batched walk), or None when it cannot
+        participate: txn sessions, host-fallen sessions, closed/
+        closing/violated ones, dense carries, and sessions whose
+        carry has not been seeded yet (their first advance runs solo
+        and seeds it). Lock-free cached read — see ``_mega_sig``."""
+        return self._mega_sig
+
+    def _update_mega_sig(self) -> None:
+        sig = None
+        if not (self.closed or self.closing or self.is_txn
+                or self._host is not None
+                or self.violation is not None):
+            carry = getattr(self._eng, "_carry", None)
+            if carry is not None:
+                sig = reach_word.mega_geometry(carry)
+        self._mega_sig = sig
 
     # -- appends ---------------------------------------------------------
     def advance_block(self, ops: Sequence[Op],
@@ -460,6 +515,7 @@ class Session:
                 if v is not None and self.violation is None:
                     self.violation = dict(v)
                 tail_hit = bool((v or {}).get("tail-alarm"))
+            self._update_mega_sig()
             return self._append_verdict(len(ops), tail_hit, seq)
 
     def _advance_engine(self, ops: Sequence[Op],
@@ -516,6 +572,84 @@ class Session:
             out["violation"] = dict(self.violation)
         return out
 
+    # -- mega-batch member protocol --------------------------------------
+    def _stage_block(self, ops: Sequence[Op], seq: Optional[int],
+                     should_abort: Optional[Any], geom: tuple):
+        """First half of :meth:`advance_block` for one mega-group
+        member (lock held by :func:`advance_group`): feed the block
+        and stage the frontier-walk operands. Returns ``("staged",
+        st)`` when the member joined the batched launch, or
+        ``("done", verdict)`` when it completed on its own — device
+        engine ineligible, nothing to walk, capacity routed, geometry
+        regrown out of the group, or fallen to host. Every branch
+        reproduces the exact solo :meth:`advance_block` ladder."""
+        if self.closed:
+            raise SessionClosed(f"session {self.id} is closed")
+        if (self.violation is not None or self.is_txn
+                or self._host is not None):
+            # the sticky / txn / host paths never stage device walks:
+            # the ordinary append (re-entrant lock) is the semantics
+            return ("done", self.advance_block(ops, seq, should_abort))
+        self.last_active_mono = time.monotonic()
+        self.appends += 1
+        self.ops.extend(ops)
+        self.ops_total = len(self.ops)
+        obs.count("serve.session.appends")
+        obs.count("serve.session.append_ops", len(ops))
+        try:
+            faults.fire("session-advance", tenants=[self.tenant])
+            if should_abort is not None and should_abort():
+                raise AdvanceAborted(
+                    "session advance aborted past the dispatch "
+                    "deadline")
+            self._eng.feed_many(list(ops))
+            st = self._eng.stage_advance()
+            v = None
+            if st is not None:
+                if reach_word.mega_geometry(st[0]) != geom:
+                    # the feed regrew the walk geometry (memo rebuild
+                    # on a fresh alphabet entry / slot growth): this
+                    # member advances solo on its already-staged
+                    # operands; the rest of the group stays batched
+                    obs.decision("session-mega", "regrow",
+                                 session=self.id)
+                    dead = st[0].advance(st[1], st[2])
+                    v = self._eng.commit_advance(st, dead)
+                    st = None
+            if st is None:
+                return ("done", self._finish_block(len(ops), seq, v))
+            return ("staged", st)
+        except online._Overflow as e:
+            obs.decision("session-advance", "route",
+                         cause=f"overflow:{type(e).__name__}",
+                         session=self.id)
+            self._to_host_monitor(record_fallback=False)
+            return ("done", self._finish_block(len(ops), seq,
+                                               self.violation))
+        except Exception as e:                          # noqa: BLE001
+            self._to_host_monitor(record_fallback=True, exc=e)
+            return ("done", self._finish_block(len(ops), seq,
+                                               self.violation))
+
+    def _finish_block(self, block_ops: int, seq: Optional[int],
+                      v: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        """Second half of :meth:`advance_block` for a mega-group
+        member: the walk verdict is in — run the tail alarm (device
+        members only) and produce the append verdict, with the same
+        fallback ladder a solo tail probe has."""
+        try:
+            if (v is None and self._host is None and not self.is_txn
+                    and self._eng is not None):
+                v = self._eng.tail_alarm()
+        except Exception as e:                          # noqa: BLE001
+            self._to_host_monitor(record_fallback=True, exc=e)
+            v = self.violation
+        if v is not None and self.violation is None:
+            self.violation = dict(v)
+        tail_hit = bool((v or {}).get("tail-alarm"))
+        self._update_mega_sig()
+        return self._append_verdict(block_ops, tail_hit, seq)
+
     # -- close -----------------------------------------------------------
     def close(self) -> Dict[str, Any]:
         """Resolve the unsettled tail (the incremental verdict becomes
@@ -559,6 +693,7 @@ class Session:
             self.ops = []
             self._eng = None
             self._host = None
+            self._update_mega_sig()
             obs.count("serve.session.closed")
             return dict(final)
 
@@ -644,6 +779,125 @@ class Session:
 
 
 # -- the registry ---------------------------------------------------------
+
+# -- mega-batch group advance ----------------------------------------------
+
+# lane count below which the per-session dispatch path wins: one
+# staged member gains nothing from a batched launch, and the gather/
+# scatter overhead is pure loss at width 1
+_MEGA_CROSSOVER_DEFAULT = 2
+
+
+def mega_crossover() -> int:
+    """The measured ``session-mega`` crossover width from the
+    persisted autotune table (``bench.py``'s session_mux probe
+    records it), else the heuristic default. Groups narrower than
+    this advance per-session."""
+    from jepsen_tpu.checkers import autotune
+    w = autotune.winner("session-mega", "crossover")
+    if w is not None:
+        try:
+            return max(1, int(w))
+        # jtlint: ok fallback — a malformed table entry reads as the heuristic default
+        except ValueError:
+            pass
+    return _MEGA_CROSSOVER_DEFAULT
+
+
+def advance_group(entries: Sequence[tuple],
+                  should_abort: Optional[Any] = None,
+                  force: bool = False) -> List[Dict[str, Any]]:
+    """Advance one append block on EACH member session of a
+    same-geometry mega-group through ONE batched frontier walk.
+
+    ``entries`` is a list of ``(session, ops, seq)`` — at most one
+    block per session per call (the dispatcher waves sessions with
+    several queued blocks). Returns the per-member append verdicts,
+    aligned with ``entries``; a member that raced a close completes
+    with the dispatcher's ``closed`` verdict shape instead of
+    raising, so one straggler cannot abort the group.
+
+    Member isolation: a member whose device path dies falls THAT
+    session to its permanent host monitor (the ordinary
+    exactly-one-``session-advance``-fallback ladder) while the rest
+    of the group completes. A failure of the batched launch itself
+    records ONE ``session-mega`` obs fallback and re-advances every
+    staged member solo on its already-staged operands (the monitor
+    drains are consumed — re-walking the same operands is the only
+    sound retry).
+
+    Lock order: member locks are acquired in list order and held
+    across stage -> launch -> commit. The coalescer keeps a session
+    in at most one in-flight group and no other code path acquires
+    two session locks, so the ordering cannot deadlock.
+
+    ``force=True`` bypasses the persisted crossover width and always
+    takes the batched path (the bench probe measures mega-vs-solo at
+    every width; honoring a previously recorded crossover there would
+    silently re-measure solo-vs-solo)."""
+    results: List[Optional[Dict[str, Any]]] = [None] * len(entries)
+    if not entries:
+        return []
+    geom = entries[0][0].mega_sig()
+    if geom is None or (not force
+                        and len(entries) < mega_crossover()):
+        # below the measured crossover (or a signature that went
+        # stale between selection and dispatch): per-session wins
+        return [s.advance_block(o, seq=q, should_abort=should_abort)
+                for s, o, q in entries]
+    with contextlib.ExitStack() as stack:
+        for s, _o, _q in entries:
+            stack.enter_context(s.lock)
+        staged: List[tuple] = []                # (idx, sess, st)
+        for k, (sess, ops, seq) in enumerate(entries):
+            try:
+                if sess.mega_sig() != geom:
+                    # cached-signature drift since selection (close /
+                    # fallback / regrowth raced the queue): solo path
+                    results[k] = sess.advance_block(
+                        ops, seq=seq, should_abort=should_abort)
+                    continue
+                kind, payload = sess._stage_block(ops, seq,
+                                                  should_abort, geom)
+            # jtlint: ok fallback — append/close member race: the member gets a 'closed' verdict
+            except SessionClosed as e:
+                results[k] = {"valid": "unknown", "cause": "closed",
+                              "error": str(e)}
+                continue
+            if kind == "done":
+                results[k] = payload
+            else:
+                staged.append((k, sess, payload))
+        if staged:
+            t0 = time.monotonic()
+            obs.count("serve.session.mega.groups")
+            obs.count("serve.session.mega.lanes", len(staged))
+            deads = None
+            try:
+                deads = reach_word.advance_frontiers_mega(
+                    [st[0] for _k, _s, st in staged],
+                    [(st[1], st[2]) for _k, _s, st in staged])
+            except Exception as e:                      # noqa: BLE001
+                # the batched launch died as a whole: ONE session-mega
+                # record; every staged member re-advances solo below
+                obs.engine_fallback("session-mega", type(e).__name__,
+                                    lanes=len(staged))
+            for j, (k, sess, st) in enumerate(staged):
+                ops_k, seq_k = entries[k][1], entries[k][2]
+                try:
+                    dead = deads[j] if deads is not None \
+                        else st[0].advance(st[1], st[2])
+                    v = sess._eng.commit_advance(st, dead)
+                    results[k] = sess._finish_block(len(ops_k), seq_k,
+                                                    v)
+                except Exception as e:                  # noqa: BLE001
+                    sess._to_host_monitor(record_fallback=True, exc=e)
+                    results[k] = sess._finish_block(len(ops_k), seq_k,
+                                                    sess.violation)
+            obs.count("serve.session.mega.scatter_s",
+                      time.monotonic() - t0)
+    return results
+
 
 class SessionRegistry:
     """id -> session lookup + the open-session census ``/stats`` and

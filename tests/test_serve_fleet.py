@@ -444,6 +444,11 @@ def test_session_adoption_verdict_identity(tmp_path):
         assert code == 200 and st["status"] == "open"
         assert st["seq"] == 2 and st["replayed-appends"] == 2
         assert db.journal.lease_live(sid) == "b"
+        sa = db.sessions.get(sid)
+        # adoption re-derives the carried frontier: the session is
+        # mega-batch-eligible again unless the replayed stream
+        # already proved its violation
+        assert sa.violation is not None or sa.mega_sig() is not None
         code, r = _http(ub, "POST", f"/session/{sid}/append",
                         {"history": [op.to_dict()
                                      for op in blocks[2]],

@@ -1,0 +1,572 @@
+"""Mega-batched session multiplexing (ISSUE 16): one vmapped
+word-walk launch advancing a whole group of same-geometry streaming
+sessions, differentially held to the per-session advance path —
+verdicts, frontiers, violation positions, and close results must be
+bit-identical whichever way the lanes were batched — plus the
+member-isolation ladder (stage death, commit death, batched-launch
+death, geometry regrowth) and the coalescer's cross-session planning.
+
+Host-only: everything runs under JAX_PLATFORMS=cpu (the batched walk
+is the same XLA program vmapped; the differential pins it to the solo
+walk either way)."""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import fixtures, models
+from jepsen_tpu import history as h
+from jepsen_tpu import obs
+from jepsen_tpu.checkers import facade, preproc_native
+from jepsen_tpu.serve import coalesce, faults
+from jepsen_tpu.serve import session as sessmod
+from jepsen_tpu.serve.request import CheckRequest
+from jepsen_tpu.serve.session import Session
+
+needs_native = pytest.mark.skipif(
+    not preproc_native.available(),
+    reason="native monitor core unavailable")
+
+
+def _ragged_blocks(hist, seed: int, n_cuts: int = 4):
+    rng = np.random.RandomState(seed)
+    cuts = sorted(rng.choice(len(hist), size=n_cuts, replace=False))
+    blocks, prev = [], 0
+    for c in list(cuts) + [len(hist)]:
+        if c > prev:
+            blocks.append(hist[prev:c])
+            prev = c
+    return blocks
+
+
+def _http(url, method, path, payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url + path, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _sessions(prefix, n, model_name="cas-register"):
+    mk = models.cas_register if model_name == "cas-register" \
+        else models.register
+    return [Session(f"{prefix}{i}", f"t{i % 2}", model_name, mk())
+            for i in range(n)]
+
+
+def _run_waves(sessions, blocks_per, grouped: bool):
+    """Advance every session through its blocks, one wave (each
+    member's w-th block) at a time — grouped through advance_group or
+    member-by-member — returning per-session verdict lists."""
+    results = [[] for _ in sessions]
+    waves = max(len(b) for b in blocks_per)
+    for w in range(waves):
+        entries = [(s, blocks_per[i][w], w + 1)
+                   for i, s in enumerate(sessions)
+                   if w < len(blocks_per[i])]
+        if grouped:
+            out = sessmod.advance_group(entries)
+        else:
+            out = [s.advance_block(o, seq=q) for s, o, q in entries]
+        for (s, _o, _q), r in zip(entries, out):
+            results[sessions.index(s)].append(r)
+    return results
+
+
+def _strip(verdict):
+    v = dict(verdict)
+    v.pop("session", None)
+    return v
+
+
+def _closed_register_blocks(waves: int):
+    """Hand-built register streams over a CLOSED two-value alphabet:
+    every (op, value) pair the stream will ever use appears in block
+    1, so later blocks never regrow the walk geometry — the
+    deterministic same-signature shape the batched launch needs. (A
+    generated history keeps minting fresh table columns for several
+    blocks; those waves legitimately regrow out of the group.)"""
+    from jepsen_tpu.op import invoke, ok
+    b1 = [invoke(0, "write", 1), ok(0, "write", 1),
+          invoke(1, "read"), ok(1, "read", 1),
+          invoke(0, "write", 2), ok(0, "write", 2),
+          invoke(1, "read"), ok(1, "read", 2)]
+    bw = [invoke(1, "write", 1), ok(1, "write", 1),
+          invoke(0, "read"), ok(0, "read", 1),
+          invoke(0, "write", 2), ok(0, "write", 2),
+          invoke(1, "read"), ok(1, "read", 2)]
+    return [b1] + [list(bw) for _ in range(waves - 1)]
+
+
+# -- the grouped-vs-solo differential --------------------------------------
+
+@needs_native
+def test_group_vs_solo_bit_identical_ragged():
+    """The tentpole bar: N sessions with ragged block mixes (one of
+    them violating mid-stream) advanced through mega groups produce
+    the EXACT per-append verdicts, frontier words, and close results
+    the per-session path produces — and at least one batched launch
+    actually fired (the differential is not vacuous)."""
+    hists = []
+    for seed in range(5):
+        hist = fixtures.gen_history("cas", n_ops=120, processes=3,
+                                    seed=seed)
+        if seed == 2:
+            hist = fixtures.corrupt(hist, seed=seed)
+        hists.append(hist)
+    blocks = [_ragged_blocks(hh, seed=i + 1, n_cuts=2 + i % 3)
+              for i, hh in enumerate(hists)]
+    solo = _sessions("solo", 5)
+    mega = _sessions("mega", 5)
+    rs = _run_waves(solo, blocks, grouped=False)
+    with obs.capture() as cap:
+        rm = _run_waves(mega, blocks, grouped=True)
+    assert cap.counters.get("serve.session.mega.groups", 0) >= 1
+    assert cap.counters.get("serve.session.mega.lanes", 0) >= 2
+    for i in range(5):
+        assert [_strip(v) for v in rs[i]] == [_strip(v) for v in rm[i]]
+        cs = getattr(solo[i]._eng, "_carry", None)
+        cm = getattr(mega[i]._eng, "_carry", None)
+        assert (cs is None) == (cm is None)
+        if cs is not None:
+            assert np.array_equal(np.asarray(cs._R),
+                                  np.asarray(cm._R))
+    for i in range(5):
+        fs, fm = solo[i].close(), mega[i].close()
+        assert fs["valid"] is fm["valid"]
+        assert fs.get("op") == fm.get("op")
+        ref = facade.auto_check_packed(models.cas_register(),
+                                       h.pack(hists[i]), {})
+        assert fm["valid"] is ref["valid"]
+
+
+@needs_native
+def test_group_mid_stream_violation_isolates():
+    """A violation in ONE lane of a batched launch fails exactly that
+    session at exactly the wave the solo path fails it; the neighbor
+    lanes stay valid through close."""
+    good = [fixtures.gen_history("cas", n_ops=90, processes=3,
+                                 seed=s) for s in (10, 11, 12)]
+    bad = fixtures.corrupt(
+        fixtures.gen_history("cas", n_ops=90, processes=3, seed=13),
+        seed=3)
+    hists = good[:1] + [bad] + good[1:]
+    blocks = [[hh[j:j + 30] for j in range(0, len(hh), 30)]
+              for hh in hists]
+    solo = _sessions("vs", 4)
+    mega = _sessions("vm", 4)
+    rs = _run_waves(solo, blocks, grouped=False)
+    rm = _run_waves(mega, blocks, grouped=True)
+    flip_solo = [v["valid-so-far"] for v in rs[1]]
+    flip_mega = [v["valid-so-far"] for v in rm[1]]
+    assert flip_solo == flip_mega and False in flip_mega
+    for i in (0, 2, 3):
+        assert all(v["valid-so-far"] for v in rm[i])
+        assert mega[i].close()["valid"] is True
+    res = mega[1].close()
+    ref = facade.auto_check_packed(models.cas_register(),
+                                   h.pack(bad), {})
+    assert res["valid"] is False and ref["valid"] is False
+    assert res.get("op") == ref.get("op")
+
+
+# -- member isolation -------------------------------------------------------
+
+@needs_native
+def test_group_geometry_regrowth_falls_out_solo():
+    """A member whose feed regrows the walk geometry mid-group (a
+    burst of fresh alphabet values past the table's pow2 bucket) is
+    recorded as a session-mega regrow decision and advanced solo; the
+    rest of the group stays batched and every verdict matches the
+    per-session path."""
+    from jepsen_tpu.op import invoke, ok
+    blk1 = [invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "read"), ok(1, "read", 1)]
+    calm = [invoke(1, "write", 1), ok(1, "write", 1),
+            invoke(0, "read"), ok(0, "read", 1)]
+    burst = []
+    for val in range(10, 50):           # 40 fresh values: O regrows
+        burst += [invoke(0, "write", val), ok(0, "write", val)]
+    blocks = [[blk1, calm], [blk1, burst]]
+    solo = _sessions("rs", 2, model_name="register")
+    mega = _sessions("rm", 2, model_name="register")
+    rs = _run_waves(solo, blocks, grouped=False)
+    with obs.capture() as cap:
+        rm = _run_waves(mega, blocks, grouped=True)
+    regrows = [r for r in cap.ledger
+               if r.get("stage") == "session-mega"
+               and r.get("event") == "regrow"]
+    assert [r.get("session") for r in regrows] == ["rm1"]
+    assert mega[0].mega_sig() != mega[1].mega_sig()
+    for i in range(2):
+        assert [_strip(v) for v in rs[i]] == [_strip(v) for v in rm[i]]
+        assert mega[i].close()["valid"] is True
+
+
+@needs_native
+def test_group_regrowth_with_violation_flags_immediately():
+    """The violating op lands in the very block that regrows the
+    member's walk geometry out of the mega-group: the regrow member's
+    solo walk verdict must flow back into the session, so THAT append
+    reports valid-so-far False at the same wave the per-session path
+    does (not a silent valid that only close would catch), later
+    appends stay flagged, and the neighbor lane is untouched."""
+    from jepsen_tpu.op import invoke, ok
+    blk1 = [invoke(0, "write", 1), ok(0, "write", 1),
+            invoke(1, "read"), ok(1, "read", 1)]
+    calm = [invoke(1, "write", 1), ok(1, "write", 1),
+            invoke(0, "read"), ok(0, "read", 1)]
+    burst_bad = []
+    for val in range(10, 50):           # 40 fresh values: O regrows
+        burst_bad += [invoke(0, "write", val), ok(0, "write", val)]
+    # the violation rides IN the regrow block: 999 was never written
+    burst_bad += [invoke(1, "read"), ok(1, "read", 999)]
+    blocks = [[blk1, calm, calm], [blk1, burst_bad, calm]]
+    solo = _sessions("rvs", 2, model_name="register")
+    mega = _sessions("rvm", 2, model_name="register")
+    rs = _run_waves(solo, blocks, grouped=False)
+    with obs.capture() as cap:
+        rm = _run_waves(mega, blocks, grouped=True)
+    regrows = [r for r in cap.ledger
+               if r.get("stage") == "session-mega"
+               and r.get("event") == "regrow"]
+    assert [r.get("session") for r in regrows] == ["rvm1"]
+    # the regrow wave's own verdict carries the violation
+    assert rm[1][1]["valid-so-far"] is False
+    assert "violation" in rm[1][1]
+    # and the flag is sticky on the following wave
+    assert rm[1][2]["valid-so-far"] is False
+    for i in range(2):
+        assert [_strip(v) for v in rs[i]] == [_strip(v) for v in rm[i]]
+    assert all(v["valid-so-far"] for v in rm[0])
+    assert mega[0].close()["valid"] is True
+    res = mega[1].close()
+    ref = facade.auto_check_packed(
+        models.register(), h.pack(blk1 + burst_bad + calm), {})
+    assert res["valid"] is False and ref["valid"] is False
+    assert res.get("op") == ref.get("op")
+
+
+@needs_native
+def test_group_one_member_stage_death_exactly_one_fallback():
+    """An injected device death during ONE member's staging: exactly
+    one session-advance fallback, THAT session continues host-side,
+    the other lanes still ride the batched launch, and every close
+    equals the facade."""
+    faults.reset()
+    blocks = _closed_register_blocks(2)
+    sessions = _sessions("fd", 3, model_name="register")
+    for s in sessions:                      # solo seed (nothing armed)
+        s.advance_block(blocks[0], seq=1)
+    # invocations only count while something is armed: wave 2 stages
+    # fire 1, 2, 3 in member order — at=2 kills member index 1
+    faults.arm("session-advance", at=2)
+    try:
+        with obs.capture() as cap:
+            out = sessmod.advance_group(
+                [(s, blocks[1], 2) for s in sessions])
+        falls = [f for f in cap.fallbacks()
+                 if f["stage"] == "session-advance"]
+        assert len(falls) == 1
+        assert cap.counters.get("serve.session.mega.groups", 0) == 1
+        assert cap.counters.get("serve.session.mega.lanes", 0) == 2
+        assert sessions[1].fallbacks == 1
+        assert sessions[1].engine_name == "session-host-monitor"
+        for i in (0, 2):
+            assert sessions[i].engine_name == "session-frontier-device"
+        assert all(r["valid-so-far"] for r in out)
+        ref = facade.auto_check_packed(models.register(),
+                                       h.pack(blocks[0] + blocks[1]),
+                                       {})
+        for s in sessions:
+            assert s.close()["valid"] is ref["valid"]
+    finally:
+        faults.reset()
+
+
+@needs_native
+def test_group_one_member_commit_death_isolated():
+    """A member whose post-launch commit dies falls THAT session to
+    the host monitor (the ordinary exactly-one session-advance
+    contract); its lane-mates' results are already scattered and
+    commit normally from the same launch."""
+    blocks = _closed_register_blocks(2)
+    sessions = _sessions("cd", 3, model_name="register")
+    for s in sessions:
+        s.advance_block(blocks[0], seq=1)
+
+    def _boom(st, dead):
+        raise RuntimeError("injected commit death")
+
+    sessions[1]._eng.commit_advance = _boom
+    with obs.capture() as cap:
+        out = sessmod.advance_group(
+            [(s, blocks[1], 2) for s in sessions])
+    falls = [f for f in cap.fallbacks()
+             if f["stage"] == "session-advance"]
+    assert len(falls) == 1 and falls[0]["session"] == "cd1"
+    assert cap.counters.get("serve.session.mega.lanes", 0) == 3
+    assert sessions[1].engine_name == "session-host-monitor"
+    assert all(r["valid-so-far"] for r in out)
+    ref = facade.auto_check_packed(models.register(),
+                                   h.pack(blocks[0] + blocks[1]), {})
+    for i, s in enumerate(sessions):
+        assert i == 1 or s.engine_name == "session-frontier-device"
+        assert s.close()["valid"] is ref["valid"]
+
+
+@needs_native
+def test_group_batched_launch_death_degrades_not_members(monkeypatch):
+    """A failed BATCHED launch records exactly one session-mega
+    fallback (lane count included) and every staged member re-advances
+    solo on its staged operands — the batch degrades, no member's
+    device path or verdict does."""
+    from jepsen_tpu.checkers import reach_word
+    blocks = _closed_register_blocks(2)
+    solo = _sessions("ls", 3, model_name="register")
+    mega = _sessions("lm", 3, model_name="register")
+    rs = _run_waves(solo, [blocks] * 3, grouped=False)
+    for s in mega:
+        s.advance_block(blocks[0], seq=1)
+
+    def _boom(carries, blks):
+        raise RuntimeError("injected launch death")
+
+    monkeypatch.setattr(reach_word, "advance_frontiers_mega", _boom)
+    with obs.capture() as cap:
+        out = sessmod.advance_group(
+            [(s, blocks[1], 2) for s in mega])
+    falls = [f for f in cap.fallbacks()
+             if f["stage"] == "session-mega"]
+    assert len(falls) == 1 and falls[0]["lanes"] == 3
+    assert not [f for f in cap.fallbacks()
+                if f["stage"] == "session-advance"]
+    for i, s in enumerate(mega):
+        assert s.engine_name == "session-frontier-device"
+        assert _strip(out[i]) == _strip(rs[i][1])
+        assert s.close()["valid"] is solo[i].close()["valid"]
+
+
+# -- replay / adoption re-entry --------------------------------------------
+
+@needs_native
+def test_replayed_sessions_reenter_mega(tmp_path):
+    """Journal replay (the same re-derivation path fleet adoption
+    runs) re-seeds the carried frontier, so a restarted daemon's
+    sessions are mega-eligible again: equal signatures, and the next
+    wave batches them into one launch."""
+    from jepsen_tpu import serve
+    root = str(tmp_path / "store")
+    d1 = serve.Daemon(port=0, store_root=root).start()
+    url = f"http://127.0.0.1:{d1.port}"
+    blocks = _closed_register_blocks(2)
+    sids = []
+    for _ in range(2):
+        code, r = _http(url, "POST", "/session",
+                        {"model": "register", "tenant": "tt"})
+        assert code == 201
+        sids.append(r["session"])
+        code, r = _http(url, "POST",
+                        f"/session/{r['session']}/append",
+                        {"history": [op.to_dict()
+                                     for op in blocks[0]], "seq": 1})
+        assert code == 200, r
+    # out-of-band "crash": abandon d1 without drain/shutdown
+    d1.httpd.server_close()
+    d1.dispatcher.stop()
+    d2 = serve.Daemon(port=0, store_root=root).start()
+    try:
+        ss = [d2.sessions.get(sid) for sid in sids]
+        sigs = {s.mega_sig() for s in ss}
+        assert len(sigs) == 1 and None not in sigs
+        with obs.capture() as cap:
+            out = sessmod.advance_group(
+                [(s, blocks[1], 2) for s in ss])
+        assert cap.counters.get("serve.session.mega.groups") == 1
+        assert cap.counters.get("serve.session.mega.lanes") == 2
+        assert all(r["valid-so-far"] for r in out)
+        ref = facade.auto_check_packed(
+            models.register(), h.pack(blocks[0] + blocks[1]), {})
+        for s in ss:
+            assert s.close()["valid"] is ref["valid"]
+    finally:
+        d2.shutdown()
+
+
+# -- coalescer: cross-session planning -------------------------------------
+
+class _StubSess:
+    def __init__(self, sid, sig=(4, 8, 3, 1)):
+        self.id = sid
+        self._sig = sig
+
+    def mega_sig(self):
+        return self._sig
+
+
+def _append_req(sess, tenant, seq, t_submit, n=8):
+    ops = fixtures.gen_history("cas", n_ops=n, processes=2, seed=seq)
+    r = CheckRequest(
+        id=f"{sess.id}-{seq}", tenant=tenant,
+        model_name="cas-register", model=models.cas_register(),
+        packed=None, history=ops, n_ops=len(ops),
+        kind="session-append", session=sess, seq=seq)
+    r.t_submit = t_submit
+    return r
+
+
+def test_plan_admission_mega_cross_session_fair_and_ordered():
+    """The mega branch of plan_admission: sessions rank
+    oldest-tenant-first (then oldest-session within a tenant), and
+    each session's blocks stay contiguous in seq order inside the
+    group."""
+    t0 = time.monotonic()
+    sa, sb, sc = _StubSess("sa"), _StubSess("sb"), _StubSess("sc")
+    reqs = [
+        _append_req(sa, "young", 2, t0 + 5.0),
+        _append_req(sb, "old", 1, t0 + 0.0),
+        _append_req(sa, "young", 1, t0 + 2.0),
+        _append_req(sc, "old", 1, t0 + 1.0),
+        _append_req(sb, "old", 2, t0 + 6.0),
+    ]
+    groups = coalesce.plan_admission(reqs, group=2)
+    assert len(groups) == 1
+    order = [(reqs[i].session.id, reqs[i].seq) for i in groups[0]]
+    assert order == [("sb", 1), ("sb", 2), ("sc", 1),
+                     ("sa", 1), ("sa", 2)]
+
+
+def test_plan_admission_mega_group_cap_chunks(monkeypatch):
+    """Past the lane cap the ranked sessions chunk into successive
+    groups — excess sessions ride the next group, blocks never
+    split across groups within one session."""
+    monkeypatch.setattr(coalesce, "_MEGA_GROUP_CAP", 2)
+    t0 = time.monotonic()
+    sess = [_StubSess(f"s{i}") for i in range(3)]
+    reqs = []
+    for i, s in enumerate(sess):
+        for seq in (1, 2):
+            reqs.append(_append_req(s, "t", seq,
+                                    t0 + i + seq / 10.0))
+    groups = coalesce.plan_admission(reqs, group=8)
+    assert len(groups) == 2
+    assert [reqs[i].session.id for i in groups[0]] == \
+        ["s0", "s0", "s1", "s1"]
+    assert [reqs[i].session.id for i in groups[1]] == ["s2", "s2"]
+
+
+def test_queue_mega_selection_marks_all_member_sessions():
+    """One selection pass coalesces same-signature blocks across
+    sessions, and EVERY member session is seq-order-guarded while the
+    group is in flight: its remaining blocks are unselectable until
+    mark_done releases them."""
+    t0 = time.monotonic()
+    sa, sb = _StubSess("qa"), _StubSess("qb")
+    q = coalesce.AdmissionQueue(max_depth=16, group=8)
+    a1 = _append_req(sa, "ta", 1, t0)
+    b1 = _append_req(sb, "tb", 1, t0 + 0.01)
+    a2 = _append_req(sa, "ta", 2, t0 + 0.02)
+    for r in (a1, b1, a2):
+        q.submit(r)
+    batch = q.next_batch(timeout=1.0)
+    # one wave per seq rank: both sessions' seq-1 blocks, a's seq-2
+    # rides the SAME group (contiguous per session)
+    assert {r.id for r in batch} == {a1.id, b1.id, a2.id}
+    # both sessions excluded while anywhere in flight
+    a3 = _append_req(sa, "ta", 3, t0 + 0.03)
+    q.submit(a3)
+    assert q.next_batch(timeout=0.05) == []
+    q.mark_done(batch)
+    batch2 = q.next_batch(timeout=1.0)
+    assert [r.id for r in batch2] == [a3.id]
+    q.mark_done(batch2)
+
+
+def test_queue_mega_signature_separates_geometries():
+    """Sessions with DIFFERENT walk geometries never share a launch:
+    the selection admits one signature per group, oldest first."""
+    t0 = time.monotonic()
+    sa = _StubSess("ga", sig=(4, 8, 3, 1))
+    sb = _StubSess("gb", sig=(4, 16, 3, 1))
+    q = coalesce.AdmissionQueue(max_depth=16, group=8)
+    ra = _append_req(sa, "ta", 1, t0)
+    rb = _append_req(sb, "tb", 1, t0 + 0.01)
+    q.submit(ra)
+    q.submit(rb)
+    b1 = q.next_batch(timeout=1.0)
+    assert [r.id for r in b1] == [ra.id]
+    q.mark_done(b1)
+    b2 = q.next_batch(timeout=1.0)
+    assert [r.id for r in b2] == [rb.id]
+    q.mark_done(b2)
+
+
+# -- the dispatcher end-to-end ---------------------------------------------
+
+@needs_native
+def test_dispatcher_mega_group_end_to_end(tmp_path):
+    """Queued appends from three sessions ride ONE mega dispatch
+    through the real daemon: seeded sessions share a signature, the
+    coalescer forms the cross-session group, the engine advances it
+    in waves, and every member's verdict lands with the mega counters
+    bumped."""
+    from jepsen_tpu import serve
+    d = serve.Daemon(port=0,
+                     store_root=str(tmp_path)).start(dispatch=False)
+    url = f"http://127.0.0.1:{d.port}"
+    blocks = _closed_register_blocks(2)
+    try:
+        sids = []
+        for i in range(3):
+            code, r = _http(url, "POST", "/session",
+                            {"model": "register",
+                             "tenant": f"t{i % 2}"})
+            assert code == 201
+            sids.append(r["session"])
+        for sid in sids:                # seed solo: signatures align
+            s = d.sessions.get(sid)
+            s.advance_block(blocks[0], seq=1)
+            s.seq = 1                   # mirror the HTTP bookkeeping
+        assert len({d.sessions.get(sid).mega_sig()
+                    for sid in sids}) == 1
+
+        def _groups_counter():
+            with urllib.request.urlopen(url + "/stats",
+                                        timeout=30) as resp:
+                stats = json.loads(resp.read())
+            return stats["counters"].get("serve.session.mega.groups",
+                                         0)
+
+        before = _groups_counter()
+        rids = []
+        for sid in sids:                # queue the wave, then dispatch
+            code, r = _http(url, "POST", f"/session/{sid}/append",
+                            {"history": [op.to_dict()
+                                         for op in blocks[1]],
+                             "seq": 2, "wait-s": 0})
+            assert code == 202, r
+            rids.append(r["id"])
+        d.dispatcher.start()
+        deadline = time.monotonic() + 60
+        for rid in rids:
+            while True:
+                code, r = _http(url, "GET", f"/check/{rid}")
+                if code == 200 and r.get("status") == "done":
+                    assert r["result"]["valid-so-far"] is True
+                    break
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+        assert _groups_counter() >= before + 1
+        for sid in sids:
+            code, r = _http(url, "POST", f"/session/{sid}/close", {})
+            assert code == 200 and r["result"]["valid"] is True
+    finally:
+        d.shutdown()

@@ -452,6 +452,14 @@ def run_load(url: str, *, rate: float, duration: float,
         "p99_admit_s": _percentile(
             [r.get("latency_admit_s") for r in done
              if r.get("latency_admit_s") is not None], 0.99),
+        # raw admit-anchored samples, kept so run_loadgen can MERGE
+        # them with the session-stream samples before the histogram
+        # crosscheck (the daemon's e2e histogram covers every
+        # completed request — mixed-traffic runs must compare like
+        # against like); stripped from the report before return
+        "_admit_lats": sorted(
+            r["latency_admit_s"] for r in done
+            if r.get("latency_admit_s") is not None),
         "windows": _window_report(records, t_start, t_mid,
                                   time.monotonic()),
         # queue-wait vs service-time split from the daemon's stage
@@ -514,12 +522,17 @@ def run_load(url: str, *, rate: float, duration: float,
 
 def build_session_plans(*, n_sessions: int, ops_per_session: int,
                         appends: int, violation_frac: float,
-                        seed: int = 7) -> List[Dict]:
+                        seed: int = 7,
+                        tenants: Optional[int] = None) -> List[Dict]:
     """Session traffic plans: each a known-ground-truth history split
     into append blocks (violating sessions get a corrupted stream, so
-    the incremental verdict has something to catch)."""
+    the incremental verdict has something to catch). ``tenants``
+    spreads sessions over that many tenant names (default 2 — the
+    historical mixed-traffic shape; thousand-session mux runs need a
+    spread wide enough to clear the per-tenant open-session cap)."""
     from jepsen_tpu import fixtures
 
+    n_tenants = max(1, int(tenants or 2))
     plans = []
     for i in range(n_sessions):
         hist = fixtures.gen_history("cas", n_ops=ops_per_session,
@@ -531,90 +544,244 @@ def build_session_plans(*, n_sessions: int, ops_per_session: int,
         step = max(1, len(hist) // appends)
         blocks = [hist[j:j + step]
                   for j in range(0, len(hist), step)]
-        plans.append({"tenant": f"sess-tenant-{i % 2}",
+        plans.append({"tenant": f"sess-tenant-{i % n_tenants}",
                       "expect": expect,
                       "blocks": [[op.to_dict() for op in b]
                                  for b in blocks]})
     return plans
 
 
+def fetch_counter(url: str, name: str) -> Optional[float]:
+    """One counter's current value off /metrics (raw jepsen name,
+    e.g. ``serve.session.appends``); None when the endpoint or the
+    series is missing."""
+    from jepsen_tpu import obs
+
+    code, text = _get_text(url, "/metrics")
+    if code != 200 or not text:
+        return None
+    sane = "jepsen_" + "".join(
+        c if (c.isalnum() or c == "_") else "_" for c in name)
+    rows = obs.parse_prometheus(text).get(sane)
+    return rows[0][1] if rows else None
+
+
+_MUX_COUNTERS = ("serve.session.appends",
+                 "serve.session.mega.groups",
+                 "serve.session.mega.lanes")
+
+
+def _mux_efficiency(before: Dict[str, Optional[float]],
+                    after: Dict[str, Optional[float]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Appends-per-dispatch over the measured window: a mega wave of
+    L lanes replaces L solo dispatches with ONE kernel launch, so
+    dispatches = appends - lanes + groups. 1.0 = no multiplexing."""
+    deltas = {}
+    for k in _MUX_COUNTERS:
+        b, a = before.get(k), after.get(k)
+        deltas[k] = (a or 0.0) - (b or 0.0) if a is not None else 0.0
+    appends = deltas["serve.session.appends"]
+    if appends <= 0:
+        return None
+    dispatches = max(
+        1.0, appends - deltas["serve.session.mega.lanes"]
+        + deltas["serve.session.mega.groups"])
+    return {"appends": int(appends),
+            "dispatches": int(dispatches),
+            "mega_groups": int(deltas["serve.session.mega.groups"]),
+            "mega_lanes": int(deltas["serve.session.mega.lanes"]),
+            "mux_efficiency": round(appends / dispatches, 2)}
+
+
 def run_session_traffic(url: str, plans: List[Dict], *,
                         cadence_s: float = 0.15,
-                        wait_s: float = 60.0) -> Dict[str, Any]:
-    """Drive long-lived sessions (one thread each, appends at the
-    configured cadence) and gate their verdicts against ground truth:
-    a valid stream must never be flagged, a violating stream must be
-    flagged by close at the latest (earlier = streaming win, counted).
-    Reports the per-append-latency distribution — the
-    append-to-verdict number the session protocol exists for."""
+                        wait_s: float = 60.0,
+                        workers: Optional[int] = None,
+                        poll_s: float = 0.05) -> Dict[str, Any]:
+    """Drive long-lived sessions and gate their verdicts against
+    ground truth: a valid stream must never be flagged, a violating
+    stream must be flagged by close at the latest (earlier =
+    streaming win, counted). Reports the per-append-latency
+    distribution — the append-to-verdict number the session protocol
+    exists for — plus the window's ``mux`` sub-object
+    (appends-per-dispatch off the daemon's mega counters).
+
+    The driver is a WORKER POOL over an event heap, not a thread per
+    session: each session is a tiny state machine (open -> append ->
+    poll verdict -> ... -> close) scheduled by due time, so five
+    thousand live streams ride a few dozen threads. Small runs
+    (sessions <= workers) post appends synchronously; large runs
+    post with ``wait-s: 0`` and poll the verdict out — the async
+    shape that lets thousands of appends sit queued at once, which
+    is exactly what the daemon's mega-batch dispatch multiplexes
+    into single kernel launches."""
+    import heapq
+
+    nworkers = int(workers or min(64, max(4, len(plans))))
+    sync_wait = wait_s if len(plans) <= nworkers else 0.0
     results: List[Dict] = []
     lock = threading.Lock()
+    cond = threading.Condition(lock)
+    heap: List[Any] = []        # (due, tiebreak, idx)
+    tick = [0]
+    done = [0]
 
-    def one(plan: Dict) -> None:
-        rec: Dict[str, Any] = {"expect": plan["expect"],
-                               "appends": 0, "latencies": [],
-                               "flagged_at": None, "final": None,
-                               "errors": 0}
-        code, resp = _post_json(url, "/session",
-                                {"model": "cas-register",
-                                 "tenant": plan["tenant"]})
-        if code != 201:
-            rec["errors"] += 1
-            rec["final"] = f"open-error-{code}"
-            with lock:
-                results.append(rec)
+    class _S:                   # per-session driver state
+        __slots__ = ("plan", "rec", "sid", "seq", "retried",
+                     "pending", "t0", "deadline")
+
+        def __init__(self, plan: Dict) -> None:
+            self.plan = plan
+            self.rec: Dict[str, Any] = {
+                "expect": plan["expect"], "appends": 0,
+                "latencies": [], "flagged_at": None, "final": None,
+                "errors": 0}
+            self.sid: Optional[str] = None
+            self.seq = 0                # last submitted append seq
+            self.retried = False
+            self.pending: Optional[str] = None   # polled request id
+            self.t0 = 0.0
+            self.deadline = 0.0
+
+    states = [_S(p) for p in plans]
+
+    def _push(idx: int, due: float) -> None:
+        with cond:
+            tick[0] += 1
+            heapq.heappush(heap, (due, tick[0], idx))
+            cond.notify()
+
+    def _settle(s: _S, idx: int, r: Dict) -> None:
+        """One append verdict is in: record it and schedule the next
+        block (or the close) a cadence later."""
+        s.rec["appends"] += 1
+        s.rec["latencies"].append(time.monotonic() - s.t0)
+        if s.rec["flagged_at"] is None \
+                and r.get("valid-so-far") is False:
+            s.rec["flagged_at"] = s.seq
+        s.pending = None
+        s.retried = False
+        _push(idx, time.monotonic() + cadence_s)
+
+    def _fail_block(s: _S, idx: int) -> None:
+        """An append gave out (transport / timeout / backpressure
+        past the retry): count it and close the session out — its
+        later blocks would only cascade seq-gap 409s."""
+        s.rec["errors"] += 1
+        s.seq = len(s.plan["blocks"])       # jump to the close step
+        s.pending = None
+        _push(idx, time.monotonic())
+
+    def _step(idx: int) -> None:
+        s = states[idx]
+        if s.sid is None:
+            code, resp = _post_json(url, "/session",
+                                    {"model": "cas-register",
+                                     "tenant": s.plan["tenant"]})
+            if code != 201:
+                s.rec["errors"] += 1
+                s.rec["final"] = f"open-error-{code}"
+                with cond:
+                    results.append(s.rec)
+                    done[0] += 1
+                    cond.notify_all()
+                return
+            s.sid = resp["session"]
+            s.rec["session"] = s.sid
+            _push(idx, time.monotonic())
             return
-        sid = resp["session"]
-        rec["session"] = sid
-        for seq, block in enumerate(plan["blocks"], start=1):
-            t0 = time.monotonic()
-            code, r = _post_json(
-                url, f"/session/{sid}/append",
-                {"history": block, "seq": seq, "wait-s": wait_s})
-            if code == 429:
-                # backpressure: retry once after the advised delay
-                time.sleep(float(r.get("retry-after-s", 1.0)))
-                code, r = _post_json(
-                    url, f"/session/{sid}/append",
-                    {"history": block, "seq": seq, "wait-s": wait_s})
-            if code == 202 and r.get("id"):
-                # slow dispatch: protocol-legal — the verdict arrives
-                # via GET /check/<id>; poll it out rather than
-                # miscounting a healthy daemon as an error
-                end = time.monotonic() + wait_s
-                while time.monotonic() < end:
-                    code2, st = _get(url, f"/check/{r['id']}")
-                    if code2 == 200 and st.get("status") == "done" \
-                            and st.get("result"):
-                        code, r = 200, st["result"]
-                        break
-                    time.sleep(0.1)
-            if code != 200:
-                rec["errors"] += 1
-                continue
-            rec["appends"] += 1
-            rec["latencies"].append(time.monotonic() - t0)
-            if rec["flagged_at"] is None \
-                    and r.get("valid-so-far") is False:
-                rec["flagged_at"] = seq
-            time.sleep(cadence_s)
-        code, r = _post_json(url, f"/session/{sid}/close", {})
-        if code == 200:
-            rec["final"] = (r.get("result") or {}).get("valid")
-        else:
-            rec["errors"] += 1
-            rec["final"] = f"close-error-{code}"
-        with lock:
-            results.append(rec)
+        if s.pending is not None:
+            # poll a 202'd append's verdict out of GET /check/<id>
+            code, st = _get(url, f"/check/{s.pending}")
+            if code == 200 and st.get("status") == "done" \
+                    and st.get("result"):
+                _settle(s, idx, st["result"])
+            elif time.monotonic() > s.deadline:
+                _fail_block(s, idx)
+            else:
+                _push(idx, time.monotonic() + poll_s)
+            return
+        if s.seq >= len(s.plan["blocks"]):
+            t0c = time.monotonic()
+            code, r = _post_json(url, f"/session/{s.sid}/close", {})
+            if code == 200:
+                s.rec["final"] = (r.get("result") or {}).get("valid")
+                # the close dispatches the final check through the
+                # same queue as everything else, so it lands in the
+                # daemon's e2e histogram — time it client-side so the
+                # merged crosscheck sample covers the same population
+                s.rec["close_latency"] = time.monotonic() - t0c
+            else:
+                s.rec["errors"] += 1
+                s.rec["final"] = f"close-error-{code}"
+            with cond:
+                results.append(s.rec)
+                done[0] += 1
+                cond.notify_all()
+            return
+        block = s.plan["blocks"][s.seq]
+        if not s.retried:
+            s.t0 = time.monotonic()
+        s.seq += 1
+        code, r = _post_json(
+            url, f"/session/{s.sid}/append",
+            {"history": block, "seq": s.seq, "wait-s": sync_wait})
+        if code == 429 and not s.retried:
+            # backpressure: retry once after the advised delay
+            s.retried = True
+            s.seq -= 1
+            _push(idx, time.monotonic()
+                  + float(r.get("retry-after-s", 1.0)))
+            return
+        if code == 202 and r.get("id"):
+            # slow (or async wait-s: 0) dispatch: protocol-legal —
+            # the verdict arrives via GET /check/<id>
+            s.pending = r["id"]
+            s.deadline = time.monotonic() + wait_s
+            _push(idx, time.monotonic() + poll_s)
+            return
+        if code != 200:
+            _fail_block(s, idx)
+            return
+        _settle(s, idx, r)
 
-    threads = [threading.Thread(target=one, args=(p,), daemon=True)
-               for p in plans]
+    def worker() -> None:
+        while True:
+            with cond:
+                while True:
+                    if done[0] >= len(plans):
+                        return
+                    now = time.monotonic()
+                    if heap and heap[0][0] <= now:
+                        _due, _t, idx = heapq.heappop(heap)
+                        break
+                    cond.wait(max(0.005,
+                                  (heap[0][0] - now) if heap
+                                  else 0.1))
+            try:
+                _step(idx)
+            except Exception:                           # noqa: BLE001
+                s = states[idx]
+                s.rec["errors"] += 1
+                s.rec["final"] = "driver-error"
+                with cond:
+                    results.append(s.rec)
+                    done[0] += 1
+                    cond.notify_all()
+
+    mux_before = {k: fetch_counter(url, k) for k in _MUX_COUNTERS}
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(nworkers)]
     t0 = time.monotonic()
+    for i in range(len(plans)):
+        _push(i, t0)
     for t in threads:
         t.start()
     for t in threads:
-        t.join(300)
+        t.join(600)
     wall = max(1e-9, time.monotonic() - t0)
+    mux_after = {k: fetch_counter(url, k) for k in _MUX_COUNTERS}
     cap_probe = probe_tenant_cap(url)
     lats = sorted(x for r in results for x in r["latencies"])
     mismatches = [r for r in results
@@ -624,17 +791,28 @@ def run_session_traffic(url: str, plans: List[Dict], *,
     false_alarms = [r for r in results
                     if r["expect"] and r["flagged_at"] is not None]
     total_ops = sum(len(b) for p in plans for b in p["blocks"])
+    n_appends = sum(r["appends"] for r in results)
     return {
         "sessions": len(plans),
-        "appends": sum(r["appends"] for r in results),
+        "appends": n_appends,
         "append_ops": total_ops,
         "errors": sum(r["errors"] for r in results),
         "wall_s": round(wall, 3),
         "sustained_append_ops_s": round(total_ops / wall, 1),
+        "sustained_appends_s": round(n_appends / wall, 1),
+        "mux": _mux_efficiency(mux_before, mux_after),
         "append_p50_s": (round(_percentile(lats, 0.50), 4)
                          if lats else None),
         "append_p99_s": (round(_percentile(lats, 0.99), 4)
                          if lats else None),
+        # raw client samples for the merged histogram crosscheck
+        # (appends AND closes ride the shared dispatch queue, so both
+        # populations appear in the daemon's e2e histogram);
+        # run_loadgen strips these before the report prints
+        "_append_lats": lats,
+        "_close_lats": sorted(
+            r["close_latency"] for r in results
+            if isinstance(r.get("close_latency"), (int, float))),
         "verdict_mismatches": len(mismatches),
         "false_alarms": len(false_alarms),
         "violating_sessions": sum(1 for r in results
@@ -711,13 +889,22 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
         # the first replica doubles as the primary for warmup-era
         # probes and the stats scrape
         url = replicas[0]
+    n_sessions = int(opts.get("n_sessions")
+                     or (2 if quick else 4)) \
+        if opts.get("sessions") else 0
     daemon = None
     if not url:
         from jepsen_tpu import serve
+        # thousand-session mux runs need queue room for every live
+        # stream's one in-flight append (that backlog IS the lane
+        # supply the mega dispatch multiplexes) — scaled only above
+        # the default so small runs keep the historical bound
+        qd = max(256, 2 * n_sessions)
         daemon = serve.Daemon(port=int(opts.get("port") or 0),
                               host="127.0.0.1",
                               group=int(opts.get("group")
                                         or (8 if quick else 32)),
+                              queue_depth=qd,
                               store_root=opts.get("store_root"),
                               persist=bool(opts.get("store_root")),
                               # small cap so probe_tenant_cap can
@@ -753,22 +940,29 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
             # cadence WHILE the one-shot open-loop load runs — the
             # coalescer interleaves append groups with check groups,
             # which is the serving regime sessions actually face
+            # tenant spread: the per-tenant open-session cap (8 on
+            # the self-hosted daemon) must clear, and the per-tenant
+            # in-flight allowance must not throttle the mux lanes
+            sess_tenants = (opts.get("session_tenants")
+                            or (2 if n_sessions <= 16
+                                else max(2, -(-n_sessions // 6))))
             plans = build_session_plans(
-                n_sessions=int(opts.get("n_sessions")
-                               or (2 if quick else 4)),
+                n_sessions=n_sessions,
                 ops_per_session=int(opts.get("session_ops")
                                     or (240 if quick else 2000)),
                 appends=int(opts.get("session_appends")
                             or (6 if quick else 12)),
                 violation_frac=float(
                     opts.get("violation_frac", 0.25)),
-                seed=int(opts.get("seed", 7)))
+                seed=int(opts.get("seed", 7)),
+                tenants=int(sess_tenants))
 
             def _run_sessions() -> None:
                 sess_result.update(run_session_traffic(
                     url, plans,
                     cadence_s=float(opts.get("session_cadence")
-                                    or 0.1)))
+                                    or 0.1),
+                    workers=opts.get("session_workers")))
             sess_thread = threading.Thread(target=_run_sessions,
                                            daemon=True)
             sess_thread.start()
@@ -779,6 +973,17 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
         if sess_thread is not None:
             sess_thread.join(600)
             report["sessions"] = sess_result
+        # the raw client samples exist only to feed the merged
+        # crosscheck below — pull them out of the report (they'd
+        # bloat every printed run, and thousand-session runs carry
+        # tens of thousands of floats)
+        one_shot_lats = report.pop("_admit_lats", None) or []
+        sess_lats = []
+        if isinstance(report.get("sessions"), dict):
+            sess_lats = list(report["sessions"]
+                             .pop("_append_lats", None) or [])
+            sess_lats += list(report["sessions"]
+                              .pop("_close_lats", None) or [])
         if replicas:
             # fleet summary: merged throughput over N replicas, and
             # the scaling efficiency against a caller-provided
@@ -806,12 +1011,23 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
         # blocking under a saturated queue (the BENCH_r06 failure:
         # loadgen 39.2 s vs histogram 12.4 s was ~27 s of pre-admit
         # wait the daemon never saw) — see SERVING.md
-        xc = crosscheck_quantiles(
-            {"p50": report.get("p50_admit_s"),
-             "p99": report.get("p99_admit_s")},
-            hist_before, hist_after)
+        # mixed-traffic runs (--sessions): the shared e2e histogram
+        # records one-shots AND session appends AND closes, so the
+        # one-shot quantiles alone compare a sub-population against
+        # the whole (at mux scale the appends dominate and the
+        # crosscheck fails spuriously) — merge the client-side
+        # samples so both sides cover the same requests
+        if sess_lats:
+            merged = sorted(one_shot_lats + sess_lats)
+            lg_q = {"p50": _percentile(merged, 0.50),
+                    "p99": _percentile(merged, 0.99)}
+        else:
+            lg_q = {"p50": report.get("p50_admit_s"),
+                    "p99": report.get("p99_admit_s")}
+        xc = crosscheck_quantiles(lg_q, hist_before, hist_after)
         if xc is not None:
-            xc["anchor"] = "admission"
+            xc["anchor"] = ("admission+session-stream" if sess_lats
+                            else "admission")
             # queue-overloaded regime (sustained throughput well
             # below the offered rate, or admissions refused): the
             # tail is backlog — the client's p99 additionally carries
@@ -896,6 +1112,19 @@ def main(argv=None) -> int:
                          "per-append latency distribution")
     ap.add_argument("--session-cadence", type=float, default=0.1,
                     help="seconds between one session's appends")
+    ap.add_argument("--n-sessions", type=int, default=None,
+                    help="how many live sessions to drive (the "
+                         "worker-pool driver scales to 5000+; "
+                         "default 2 with --quick, else 4)")
+    ap.add_argument("--session-ops", type=int, default=None,
+                    help="ops per session stream (default 240 with "
+                         "--quick, else 2000)")
+    ap.add_argument("--session-appends", type=int, default=None,
+                    help="append blocks per session (default 6 with "
+                         "--quick, else 12)")
+    ap.add_argument("--session-workers", type=int, default=None,
+                    help="driver worker threads for session traffic "
+                         "(default: min(64, n_sessions))")
     args = ap.parse_args(argv)
     if args.self_host and args.url:
         ap.error("--self-host and --url are mutually exclusive")
@@ -915,6 +1144,10 @@ def main(argv=None) -> int:
         "chaos_tolerant": args.chaos_tolerant,
         "sessions": args.sessions,
         "session_cadence": args.session_cadence,
+        "n_sessions": args.n_sessions,
+        "session_ops": args.session_ops,
+        "session_appends": args.session_appends,
+        "session_workers": args.session_workers,
     })
     print(json.dumps(report, default=str))
     if report.get("error"):
