@@ -735,6 +735,18 @@ def txn_probe(n_txns: int, seed: int) -> dict:
         os.environ.pop("JEPSEN_TPU_NO_AUTOTUNE", None)
     host, host_s = best_of(
         lambda: txn.check_history(h, force_host=True))
+    # the lattice rung (ISSUE 17): every consistency level decided in
+    # ONE dispatch — the K=4 ladder vs the host chain-node lattice
+    # reference. (Not apples-to-apples with the serializable arm:
+    # the lattice route never rides the Kahn trim, so it walks the
+    # full graph where dev walks the trimmed core.)
+    from jepsen_tpu.txn import lattice as txn_lattice
+    all_levels = list(txn_lattice.LEVELS)
+    lat, lat_s = best_of(
+        lambda: txn.check_history(h, consistency=all_levels))
+    lat_host, lat_host_s = best_of(
+        lambda: txn.check_history(h, consistency=all_levels,
+                                  force_host=True))
     out = {
         "txns": int(graph.n), "edges": int(graph.e),
         "edge_counts": graph.edge_counts(),
@@ -754,6 +766,16 @@ def txn_probe(n_txns: int, seed: int) -> dict:
                  "engine": host.get("engine"),
                  "anomalies": host.get("anomalies")},
         "speedup_vs_host": round(host_s / max(dev_s, 1e-9), 2),
+        "lattice": {
+            "check_s": round(lat_s, 3),
+            "txns_s": round(graph.n / max(lat_s, 1e-9)),
+            "engine": lat.get("engine"),
+            "weakest_violated": lat.get("weakest-violated"),
+            "host_check_s": round(lat_host_s, 3),
+            "speedup_vs_host": round(lat_host_s / max(lat_s, 1e-9),
+                                     2),
+            "cost_vs_serializable": round(lat_s / max(dev_s, 1e-9),
+                                          2)},
         # the closure KERNEL in isolation: the e2e rung above trims
         # to a tiny core (inference dominates), so the body win is
         # measured on a closure-bound synthetic cyclic graph too,
@@ -768,6 +790,10 @@ def txn_probe(n_txns: int, seed: int) -> dict:
                         f"{dev.get('anomalies')} vs f32 "
                         f"{f32.get('anomalies')} vs host "
                         f"{host.get('anomalies')}")
+    elif lat.get("holds") != lat_host.get("holds"):
+        out["error"] = (f"lattice drift: device holds "
+                        f"{lat.get('holds')} vs host "
+                        f"{lat_host.get('holds')}")
     return out
 
 

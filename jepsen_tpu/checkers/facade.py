@@ -460,7 +460,7 @@ def auto_check_txn(history: Sequence[Op],
 # keyword subsets understood by each engine; user opts are filtered so one
 # checker config can carry opts for every algorithm it may route to.
 _REACH_KW = ("max_states", "max_slots", "max_dense", "should_abort")
-_TXN_KW = ("devices", "max_dense_txns", "force_host")
+_TXN_KW = ("devices", "max_dense_txns", "force_host", "consistency")
 # check_many additionally shards the key axis over a mesh and admits
 # a dispatch-group width override (the serving layer's admission
 # coalescer planned the batch at its own --group width; the engine-side

@@ -328,22 +328,41 @@ def gen_txn_history(n_txns: int = 50, keys: int = 3, processes: int = 5,
 #: read observations, which is all the inference consults)
 TXN_ANOMALY_KINDS = ("G0", "G1c", "G-single", "G2")
 
+#: lattice-level fixtures (ISSUE 17): each is invalid at a KNOWN
+#: weakest level and valid at everything below it, with every txn
+#: sequential (non-overlapping) so the commit-order lane is total —
+#: the ground truths the lattice differential tests assert:
+#:
+#:   write-skew   -> weakest violated: si    (G-SIb; causal/pl-2 hold)
+#:   lost-update  -> invalid at EVERY level  (G0 + G-SIa: the
+#:                                            blind overwrite also
+#:                                            reverses a write order)
+#:   long-fork    -> weakest violated: si    (G-SIb + G2; the
+#:                                            canonical SI anomaly)
+#:   session-mr   -> weakest violated: pl-2  (monotonic-reads;
+#:                                            causal holds)
+TXN_LATTICE_KINDS = ("write-skew", "lost-update", "long-fork",
+                     "session-mr")
+
 
 def txn_anomaly_block(kind: str, key_prefix: str = "z",
                       process0: int = 100) -> List[Op]:
     """A self-contained txn block whose inferred graph contains
     exactly one cycle of class ``kind`` (sequential ops, fresh keys —
-    append it to any history without disturbing it)."""
+    append it to any history without disturbing it). The
+    :data:`TXN_LATTICE_KINDS` kinds additionally pin the WEAKEST
+    violated consistency level (see the table above)."""
     ka, kb = f"{key_prefix}a", f"{key_prefix}b"
     p = process0
 
-    def seq(*txns):
+    def seq(*txns, procs=None):
         out = []
         for i, t in enumerate(txns):
-            out.append(invoke(p + i, "txn",
+            pi = p + (i if procs is None else procs[i])
+            out.append(invoke(pi, "txn",
                               [[k, kk, None if k == "r" else v]
                                for k, kk, v in t]))
-            out.append(ok(p + i, "txn", [list(x) for x in t]))
+            out.append(ok(pi, "txn", [list(x) for x in t]))
         return out
 
     if kind == "G0":
@@ -366,6 +385,43 @@ def txn_anomaly_block(kind: str, key_prefix: str = "z",
         return seq([("r", ka, []), ("append", kb, 1)],
                    [("r", kb, []), ("append", ka, 1)],
                    [("r", ka, [1]), ("r", kb, [1])])
+    if kind == "write-skew":
+        # the classic skew, SEQUENTIALLY: T2 starts after T1
+        # committed yet still reads ka=[] — fine under causal (no
+        # ww/wr cycle), a G-SIb write skew under strong-session SI
+        # (rw T2->T1 closed by the commit-order edge T1->T2)
+        return seq([("r", ka, []), ("r", kb, []), ("append", ka, 1)],
+                   [("r", ka, []), ("r", kb, []), ("append", kb, 1)],
+                   [("r", ka, [1]), ("r", kb, [1])])
+    if kind == "lost-update":
+        # T2 read-modify-writes over T1 without seeing T1's committed
+        # append, and the recovered kb order runs BACKWARD through
+        # commit order: a G0 write cycle (fails read-committed, hence
+        # every level) plus the time-travel G-SIa edge ww T2->T1 with
+        # T1 committed before T2 even started
+        return seq([("r", ka, []), ("append", ka, 1),
+                    ("append", kb, 1)],
+                   [("r", ka, []), ("append", ka, 2),
+                    ("append", kb, 2)],
+                   [("r", ka, [1, 2]), ("r", kb, [2, 1])])
+    if kind == "long-fork":
+        # two readers observe the two independent writes in OPPOSITE
+        # orders — the canonical SI anomaly. No ww/wr cycle (causal
+        # holds); both rw edges close through commit order (G-SIb),
+        # and the four-txn rw/wr cycle is a G2 under serializability.
+        return seq([("append", ka, 1)],
+                   [("append", kb, 1)],
+                   [("r", ka, [1]), ("r", kb, [])],
+                   [("r", ka, []), ("r", kb, [1])])
+    if kind == "session-mr":
+        # one process's reads SHRINK: txn2 sees [1,2], txn3 (same
+        # process) sees [1] — a monotonic-reads session violation
+        # (weakest violated: pl-2; causal still holds, there is no
+        # ww/wr cycle)
+        return seq([("append", ka, 1), ("append", ka, 2)],
+                   [("r", ka, [1, 2])],
+                   [("r", ka, [1])],
+                   procs=[0, 1, 1])
     raise ValueError(f"unknown txn anomaly kind {kind!r}")
 
 

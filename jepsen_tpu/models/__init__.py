@@ -251,3 +251,20 @@ def bounded_set(universe: int = 12) -> "Model":
     it exists for."""
     from jepsen_tpu.models.memo import BoundedSetModel
     return BoundedSetModel(0, universe)
+
+
+def bounded_queue(universe: int = 6) -> "Model":
+    """Int-coded bounded FIFO queue (state = one base-(universe+1)
+    int; the arrangements of distinct pending values — 1957 states at
+    the default) — the memo-friendly :class:`FIFOQueue` that lets
+    queue workloads reach the dense-walk device engines."""
+    from jepsen_tpu.models.memo import BoundedQueueModel
+    return BoundedQueueModel(0, universe)
+
+
+def bounded_map(keys: int = 4, vals: int = 4) -> "Model":
+    """Int-coded bounded register map (state = one base-(vals+1) int,
+    <= (vals+1)**keys reachable states) — the memo-friendly
+    :class:`MultiRegister`."""
+    from jepsen_tpu.models.memo import BoundedMapModel
+    return BoundedMapModel(0, keys, vals)

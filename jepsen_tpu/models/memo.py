@@ -69,6 +69,114 @@ class BoundedSetModel(Model):
         return inconsistent(f"bounded-set cannot {op.f}")
 
 
+@dataclass(frozen=True, slots=True)
+class BoundedQueueModel(Model):
+    """Int-coded FIFO queue over a bounded unique-value universe
+    ``{0..universe-1}`` (the :class:`BoundedSetModel` trick applied to
+    :class:`~jepsen_tpu.models.FIFOQueue`): the pending items are one
+    base-``(universe+1)`` int (little-endian, head at the lowest
+    digit, digit ``v+1`` = value ``v``), so the reachable space is
+    the arrangements of distinct values — 1957 states at the default
+    ``universe=6`` — and queue workloads reach the memoized dense
+    ``reach`` engine instead of host-only checking.
+
+    Enqueueing a value that is already PENDING is inconsistent (the
+    unique-value workloads never produce one; this is what keeps the
+    state space to arrangements). Dequeue matches
+    :class:`~jepsen_tpu.models.FIFOQueue` exactly: empty-queue
+    dequeue is inconsistent, a ``None`` value pops unchecked.
+    Differentially equivalent to ``FIFOQueue`` on in-universe
+    unique-enqueue histories (tests/test_models.py)."""
+    code: int = 0
+    universe: int = 6
+
+    def _items(self) -> List[int]:
+        base, c, out = self.universe + 1, self.code, []
+        while c:
+            out.append(c % base - 1)
+            c //= base
+        return out                              # head first
+
+    def step(self, op: Op) -> StepResult:
+        base = self.universe + 1
+        if op.f == "enqueue":
+            v = op.value
+            if not isinstance(v, int) or not 0 <= v < self.universe:
+                return inconsistent(
+                    f"enqueue {v!r} outside universe "
+                    f"0..{self.universe - 1}")
+            items = self._items()
+            if v in items:
+                return inconsistent(f"enqueue of pending value {v!r}")
+            return BoundedQueueModel(
+                self.code + (v + 1) * base ** len(items),
+                self.universe)
+        if op.f == "dequeue":
+            if not self.code:
+                return inconsistent("dequeue from empty queue")
+            head = self.code % base - 1
+            if op.value is not None and head != op.value:
+                return inconsistent(
+                    f"dequeued {op.value!r}, expected {head!r}")
+            return BoundedQueueModel(self.code // base, self.universe)
+        return inconsistent(f"bounded-queue cannot {op.f}")
+
+
+@dataclass(frozen=True, slots=True)
+class BoundedMapModel(Model):
+    """Int-coded register map over bounded key/value universes: keys
+    ``{0..keys-1}``, values ``{0..vals-1}``, state one base-
+    ``(vals+1)`` int (digit ``k`` is ``v+1``, 0 = unset) — at most
+    ``(vals+1)**keys`` reachable states (625 at the defaults), the
+    memo-friendly :class:`~jepsen_tpu.models.MultiRegister`. Op
+    values follow multi-register: ``{key: v}`` maps or ``[[k v]...]``
+    pairs; ``read`` skips ``None``-valued keys and asserts the rest
+    (an unset key reads as ``None``)."""
+    code: int = 0
+    keys: int = 4
+    vals: int = 4
+
+    def _pairs(self, op: Op):
+        kvs = op.value
+        if isinstance(kvs, dict):
+            return list(kvs.items())
+        if isinstance(kvs, (list, tuple)):
+            return [tuple(p) for p in kvs]
+        return None
+
+    def step(self, op: Op) -> StepResult:
+        items = self._pairs(op)
+        if items is None:
+            return inconsistent(f"bad bounded-map value {op.value!r}")
+        base = self.vals + 1
+        if op.f == "write":
+            code = self.code
+            for k, v in items:
+                if not isinstance(k, int) or not 0 <= k < self.keys:
+                    return inconsistent(
+                        f"write key {k!r} outside 0..{self.keys - 1}")
+                if not isinstance(v, int) or not 0 <= v < self.vals:
+                    return inconsistent(
+                        f"write {v!r} outside 0..{self.vals - 1}")
+                digit = code // base ** k % base
+                code += (v + 1 - digit) * base ** k
+            return BoundedMapModel(code, self.keys, self.vals)
+        if op.f == "read":
+            for k, v in items:
+                if v is None:
+                    continue
+                if not isinstance(k, int) or not 0 <= k < self.keys:
+                    return inconsistent(
+                        f"read key {k!r} outside 0..{self.keys - 1}")
+                digit = self.code // base ** k % base
+                here = digit - 1 if digit else None
+                if v != here:
+                    return inconsistent(
+                        f"read {v!r} at {k!r}, expected {here!r}")
+            return self
+        return inconsistent(f"bounded-map cannot {op.f}")
+
+
 @dataclass(frozen=True)
 class Memo:
     table: np.ndarray            # i32[n_states, n_ops]; -1 = inconsistent

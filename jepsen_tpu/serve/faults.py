@@ -16,6 +16,16 @@ serving hot path (and one engine-side hook), each a no-op until armed:
                     env-gated so the engine never imports this module
                     on a clean run).
 - ``persist``     — entry of the store persistence write.
+- ``journal-write`` — inside ``Journal._write``: instead of raising,
+                    the armed write lands a syntactically-valid but
+                    garbage-shaped entry and reports success (the
+                    bad-payload corruption adversary; replay must
+                    quarantine it).
+- ``lease-write`` — inside ``Journal.claim``: the armed claim writes
+                    a bad-payload lease (junk expiry) the claimer
+                    believes it holds; every reader must detect it,
+                    quarantine the file, and treat the entry as
+                    unclaimed.
 - ``clock-jump``  — not a call site: an armed clock jump fires at its
                     scheduled ``tick`` and skews the deadline clock
                     (:func:`clock_skew`, consulted by

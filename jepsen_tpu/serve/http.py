@@ -91,7 +91,28 @@ log = logging.getLogger("jepsen.serve")
 # engine options a client may set per request — bounded to the knobs
 # that cannot destabilize co-tenants (no devices=, no interpret=)
 _CLIENT_OPTS = ("max_states", "max_slots", "max_dense", "time_limit",
-                "max_dense_txns")
+                "max_dense_txns", "consistency")
+
+
+def _filter_opts(raw: Any, strict: bool = True) -> Dict[str, Any]:
+    """Allow-list client options and canonicalize ``"consistency"``
+    to the sorted tuple-of-levels form (so every spelling of the same
+    level set coalesces into the same signature). ``strict`` raises
+    on an unknown level (the admission path's 400); the replay paths
+    pass False — an invalid value cannot have been admitted, so it is
+    dropped rather than wedging the replay loop."""
+    opts = {k: v for k, v in (raw or {}).items() if k in _CLIENT_OPTS}
+    if "consistency" in opts:
+        from jepsen_tpu.txn import lattice
+        try:
+            opts["consistency"] = list(
+                lattice.canon_levels(opts["consistency"]))
+        except ValueError:
+            if strict:
+                raise
+            obs.decision("serve-opts", "drop", cause="bad-consistency")
+            del opts["consistency"]
+    return opts
 
 _MODEL_NAMES = ("register", "cas-register", "mutex", "multi-register",
                 "set-model", "fifo-queue", "unordered-queue",
@@ -143,8 +164,7 @@ def parse_check_body(body: bytes, content_type: str,
     # tenant names are client-controlled and key bounded per-tenant
     # state: cap the length here, cardinality in the registry
     tenant = str(data.get("tenant") or default_tenant)[:64]
-    options = {k: v for k, v in (data.get("options") or {}).items()
-               if k in _CLIENT_OPTS}
+    options = _filter_opts(data.get("options"))
     timeout_s = data.get("timeout-s", data.get("timeout_s"))
     if timeout_s is not None:
         timeout_s = float(timeout_s)
@@ -395,9 +415,7 @@ class Daemon:
                     entry.get("submitted-at") or time.time())
                 deadline = time.monotonic() \
                     + max(0.0, float(timeout_s) - elapsed)
-            opts = {k: v
-                    for k, v in (entry.get("options") or {}).items()
-                    if k in _CLIENT_OPTS}
+            opts = _filter_opts(entry.get("options"), strict=False)
             req = rq.CheckRequest(
                 id=rid, tenant=str(entry.get("tenant") or "anonymous"),
                 model_name=str(entry["model"]), model=model,
@@ -459,9 +477,7 @@ class Daemon:
                 raise ValueError("unreadable session entry")
             model_name = str(meta["model"])
             model = resolve_model(model_name)
-            opts = {k: v
-                    for k, v in (meta.get("options") or {}).items()
-                    if k in _CLIENT_OPTS}
+            opts = _filter_opts(meta.get("options"), strict=False)
         except Exception as e:                          # noqa: BLE001
             log.warning("session %s unreplayable: %s", sid, e)
             obs.engine_fallback("serve-journal",
@@ -619,9 +635,7 @@ class Daemon:
             model = resolve_model(model_name)
             tenant = str(data.get("tenant") or header_tenant
                          or "anonymous")[:64]
-            options = {k: v
-                       for k, v in (data.get("options") or {}).items()
-                       if k in _CLIENT_OPTS}
+            options = _filter_opts(data.get("options"))
         except Exception as e:                          # noqa: BLE001
             return 400, {"error": f"{type(e).__name__}: {e}"}
         sid = sn.new_session_id()

@@ -69,6 +69,7 @@ except ImportError:                     # pragma: no cover - non-POSIX
 from jepsen_tpu import edn
 from jepsen_tpu import obs
 from jepsen_tpu.op import Op
+from jepsen_tpu.serve import faults
 
 log = logging.getLogger("jepsen.serve.journal")
 
@@ -119,6 +120,19 @@ class Journal:
 
     # -- low-level -------------------------------------------------------
     def _write(self, path: str, payload: Dict[str, Any]) -> None:
+        # the self-nemesis corruption point: an armed "journal-write"
+        # replaces this entry with a syntactically-VALID but
+        # garbage-shaped payload, and the writer believes it
+        # succeeded — the adversary the replay quarantine exists for
+        # (a merely torn write is already an absent entry by the
+        # tmp+rename discipline below)
+        try:
+            faults.fire("journal-write")
+        # jtlint: ok fallback — fire() recorded serve-fault/injected; the corrupt write IS the injected behavior
+        except faults.InjectedFault:
+            with open(path, "w") as f:
+                json.dump({"corrupted": True}, f)
+            return
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f, default=str)
@@ -274,10 +288,32 @@ class Journal:
     def _read_lease(path: str) -> Optional[Dict[str, Any]]:
         try:
             with open(path) as f:
-                return json.load(f)
+                holder = json.load(f)
         # jtlint: ok fallback — absent and torn both READ as "no live holder" by design: a torn lease is stealable (its writer died mid-write or loses the fleet-locked steal race), and the steal itself records
         except (OSError, ValueError):
             return None
+        # bad-PAYLOAD (parseable but garbage-shaped) is a different
+        # adversary than torn: without the schema check a junk
+        # expires-at would crash every scanner that floats it. A
+        # corrupt lease is quarantined aside (so it cannot wedge the
+        # entry) and reads as "no live holder" — detected, recorded,
+        # never trusted
+        bad = not isinstance(holder, dict)
+        if not bad:
+            try:
+                float(holder.get("expires-at") or 0.0)
+            # jtlint: ok fallback — recorded just below: every bad path counts serve.lease.corrupt and quarantines with a serve-lease decision
+            except (TypeError, ValueError):
+                bad = True
+        if bad:
+            obs.count("serve.lease.corrupt")
+            obs.decision("serve-lease", "quarantine",
+                         cause="bad-payload",
+                         path=os.path.basename(path))
+            with contextlib.suppress(OSError):
+                os.replace(path, path + ".corrupt")
+            return None
+        return holder
 
     def claim(self, entry_id: str, *, replica: str,
               ttl_s: float) -> bool:
@@ -293,6 +329,15 @@ class Journal:
         payload = {"id": entry_id, "replica": replica,
                    "expires-at": round(time.time() + float(ttl_s), 6),
                    "claimed-at": round(time.time(), 6)}
+        # the lease-file corruption point: an armed "lease-write"
+        # claim lands as a bad-payload (junk expires-at) lease the
+        # claimer BELIEVES it holds — siblings must detect it,
+        # quarantine it, and steal the entry rather than trust it
+        try:
+            faults.fire("lease-write")
+        # jtlint: ok fallback — fire() recorded serve-fault/injected; the bad-payload lease IS the injected behavior
+        except faults.InjectedFault:
+            payload = dict(payload, **{"expires-at": "garbage"})
         # fast path: write the FULL payload to a private tmp, then
         # hard-link it into place — the lease appears atomically with
         # its content (an O_EXCL create + write would expose an empty

@@ -135,8 +135,14 @@ class CheckRequest:
                 if g is not None:
                     return ("session-mega",) + g
             return ("session", self.session.id)
+        # list-valued options (the canonical "consistency" level set)
+        # are tupled so the signature stays hashable: requests asking
+        # for the same level set coalesce, mixed-level tenants split
+        # into per-level-set groups but each group still batches
         return (type(self.model).__name__, repr(self.model),
-                tuple(sorted(self.opts.items())))
+                tuple(sorted((k, tuple(v) if isinstance(v, list)
+                              else v)
+                             for k, v in self.opts.items())))
 
     def expired(self, now: Optional[float] = None) -> bool:
         if self.deadline is None:

@@ -222,12 +222,21 @@ class TxnSessionEngine:
     G1a) fail the session the moment they are proven, exactly like a
     frontier death fails a register session."""
 
-    def __init__(self, *, max_dense_txns: Optional[int] = None) -> None:
-        from jepsen_tpu.txn import cycles
+    def __init__(self, *, max_dense_txns: Optional[int] = None,
+                 consistency: Optional[Any] = None) -> None:
+        from jepsen_tpu.txn import cycles, lattice
         from jepsen_tpu.txn.infer import IncrementalInfer
+        # lattice mode: the session was opened with a "consistency"
+        # option — the incremental closure carries the K=4 lane stack
+        # and every advance reports per-level holds; validity gates on
+        # the REQUESTED levels, like the post-hoc check
+        self.levels: Optional[List[str]] = (
+            None if consistency is None
+            else lattice.canon_levels(consistency))
         self.infer = IncrementalInfer()
         self.closure = cycles.IncrementalClosure(
-            max_dense_txns=max_dense_txns)
+            max_dense_txns=max_dense_txns,
+            lattice=self.levels is not None)
         # the self-nemesis hook, fired right before the device
         # closure — AFTER inference consumed the block, so the
         # session's fallback can resume with an empty re-feed (the
@@ -235,12 +244,48 @@ class TxnSessionEngine:
         self.fire_hook = lambda: None
         self.host_mode = False              # permanent after decline
         self.violation: Optional[Dict[str, Any]] = None
-        self.booleans: Dict[str, bool] = {
-            "cyc_ww": False, "cyc_wwwr": False,
-            "cyc_full": False, "gsingle": False}
+        if self.levels is not None:
+            self.booleans = {k: False for k in cycles.LATTICE_KEYS}
+            self.holds: Optional[Dict[str, bool]] = \
+                lattice.holds_from(self.booleans)
+        else:
+            self.booleans = {
+                "cyc_ww": False, "cyc_wwwr": False,
+                "cyc_full": False, "gsingle": False}
+            self.holds = None
 
     def _classify(self) -> Optional[Dict[str, Any]]:
-        from jepsen_tpu.txn import host_ref
+        from jepsen_tpu.txn import host_ref, lattice
+        if self.levels is not None:
+            # per-process session-guarantee prefix scans are host
+            # work either way; holds are monotone under extension
+            # (cumulative booleans + monotone scans), so the sticky
+            # first violation is sound
+            scans = lattice.session_scans(self.infer.txns)
+            self.holds = lattice.holds_from(
+                self.booleans, session_violated=bool(scans))
+            if all(self.holds[lvl] for lvl in self.levels):
+                return None
+            graph = self.infer.graph()
+            starts, ends = self.infer.intervals()
+            gsia = host_ref.gsia_scan(graph, starts, ends) is not None
+            present = lattice._class_presence(self.booleans, scans,
+                                              gsia)
+            anomalies = [c for lvl in lattice.LEVELS
+                         for c in lattice.LEVEL_ANOMALIES[lvl]
+                         if present.get(c)]
+            out = {"valid": False, "engine": "session-txn",
+                   "consistency": list(self.levels),
+                   "holds": dict(self.holds),
+                   "weakest-violated":
+                       lattice.weakest_violated(self.holds),
+                   "anomalies": anomalies,
+                   "booleans": dict(self.booleans)}
+            if anomalies:
+                out["anomaly"] = anomalies[0]
+            if scans:
+                out["session-violations"] = scans[:8]
+            return out
         anomalies = host_ref.derive_anomalies(self.booleans)
         if anomalies:
             return {"valid": False, "engine": "session-txn",
@@ -264,9 +309,23 @@ class TxnSessionEngine:
                 "direct": [dict(d) for d in self.infer.direct[:32]]}
             return self.violation
         src, dst, et = self.infer.drain_new_edges()
+        if self.levels is not None:
+            # the commit-order lane rides the same dirty-block feed:
+            # completion-ordered arrival means cm edges only ever
+            # point INTO the new txns, so the drain is a delta too
+            csrc, cdst = self.infer.drain_new_cm()
+            if csrc.size:
+                import numpy as _np
+                from jepsen_tpu.txn.infer import CM
+                src = _np.concatenate([_np.asarray(src, _np.int64),
+                                       _np.asarray(csrc, _np.int64)])
+                dst = _np.concatenate([_np.asarray(dst, _np.int64),
+                                       _np.asarray(cdst, _np.int64)])
+                et = _np.concatenate([
+                    _np.asarray(et, _np.int64),
+                    _np.full(csrc.size, CM, _np.int64)])
         if self.host_mode:
-            self.booleans = host_ref.classify_booleans(
-                self.infer.graph())
+            self.booleans = self._host_booleans()
         else:
             try:
                 self.fire_hook()
@@ -278,10 +337,19 @@ class TxnSessionEngine:
                 obs.decision("session-advance", "route",
                              cause=f"txn-overflow:{e}")
                 self.host_mode = True
-                self.booleans = host_ref.classify_booleans(
-                    self.infer.graph())
+                self.booleans = self._host_booleans()
         self.violation = self._classify()
         return self.violation
+
+    def _host_booleans(self) -> Dict[str, bool]:
+        from jepsen_tpu.txn import host_ref
+        g = self.infer.graph()
+        booleans = dict(host_ref.classify_booleans(g))
+        if self.levels is not None:
+            starts, ends = self.infer.intervals()
+            booleans.update(host_ref.lattice_classify_booleans(
+                g, starts, ends))
+        return booleans
 
     def to_host(self) -> None:
         """Device closure died: continue host-side permanently (the
@@ -299,9 +367,13 @@ class TxnSessionEngine:
             self.advance_block([])
         if self.violation is not None:
             return dict(self.violation)
-        return {"valid": True, "engine": "session-txn",
-                "txns": self.infer.n,
-                "booleans": dict(self.booleans)}
+        out = {"valid": True, "engine": "session-txn",
+               "txns": self.infer.n,
+               "booleans": dict(self.booleans)}
+        if self.levels is not None:
+            out["consistency"] = list(self.levels)
+            out["holds"] = dict(self.holds)
+        return out
 
     def in_flight(self) -> int:
         return len(self.infer._live) + self.infer.pending_reads()
@@ -375,7 +447,8 @@ class Session:
         import os
         if self.is_txn:
             self._eng = TxnSessionEngine(
-                max_dense_txns=self.opts.get("max_dense_txns"))
+                max_dense_txns=self.opts.get("max_dense_txns"),
+                consistency=self.opts.get("consistency"))
             self._eng.fire_hook = (
                 lambda: faults.fire("session-advance",
                                     tenants=[self.tenant]))
@@ -565,6 +638,10 @@ class Session:
         elif self.is_txn:
             out["txns"] = self._eng.infer.n
             out["in-flight"] = self._eng.in_flight()
+            if self._eng.holds is not None:
+                # lattice mode: every append reports the per-level
+                # verdict frontier (monotone — levels only degrade)
+                out["holds"] = dict(self._eng.holds)
         else:
             out["settled-returns"] = self._eng.settled_returns
             out["in-flight"] = self._eng.in_flight()
@@ -673,6 +750,17 @@ class Session:
                           "%r vs %r", self.id, inc_valid,
                           final.get("valid"))
                 final["incremental-divergence"] = True
+            # lattice sessions promise MORE than the boolean verdict:
+            # the incremental per-level holds must equal the exact
+            # post-hoc ones level-for-level
+            if isinstance(inc.get("holds"), dict) \
+                    and isinstance(final.get("holds"), dict) \
+                    and inc["holds"] != final["holds"]:
+                obs.count("serve.session.divergence")
+                log.error("session %s lattice holds divergence: "
+                          "%r vs %r", self.id, inc["holds"],
+                          final["holds"])
+                final["incremental-divergence"] = True
             final["session"] = self.id
             final["appends"] = self.appends
             final["session-ops"] = len(self.ops)
@@ -680,7 +768,7 @@ class Session:
             final["incremental"] = {
                 k: inc.get(k) for k in
                 ("valid", "engine", "settled-returns", "ops-checked",
-                 "txns", "anomalies")
+                 "txns", "anomalies", "holds", "weakest-violated")
                 if inc.get(k) is not None}
             self.closed = True
             self.result = final

@@ -47,11 +47,17 @@ class QueueClient(cl.Client):
 
 
 def workload(seed: Optional[int] = None,
-             enqueue_weight: int = 1) -> g.Generator:
+             enqueue_weight: int = 1,
+             universe: Optional[int] = None) -> g.Generator:
     """Enqueue (unique ints) / dequeue mix; ``enqueue_weight`` > 1 biases
     toward enqueues so the queue keeps a backlog (useful for tests that
-    need messages pending when a fault lands)."""
-    enq = g.unique_values("enqueue")
+    need messages pending when a fault lands). ``universe`` caps the
+    number of enqueues — unique_values counts 0,1,2,..., so capping the
+    COUNT also caps every value inside the bounded-queue model's
+    universe (the set suite's trick)."""
+    enq: g.GenLike = g.unique_values("enqueue")
+    if universe is not None:
+        enq = g.Limit(universe, enq)
     deq = g.Fn(lambda: {"f": "dequeue", "value": None})
     return g.mix(*([enq] * max(1, enqueue_weight) + [deq]), seed=seed)
 
@@ -68,13 +74,25 @@ def queue_test(mode: str = "safe", *, time_limit: float = 5.0,
                concurrency: int = 5, seed: Optional[int] = None,
                with_nemesis: bool = True, store: bool = False,
                nemesis_interval: float = 1.0,
-               enqueue_weight: int = 1, nodes: Any = 5) -> Dict[str, Any]:
+               enqueue_weight: int = 1, nodes: Any = 5,
+               universe: Optional[int] = None) -> Dict[str, Any]:
+    """``universe`` bounds the enqueue workload to that many unique
+    values and composes a ``linear`` checker over the int-coded
+    :func:`jepsen_tpu.models.bounded_queue` model — a memo-enumerable
+    state space (the arrangements of distinct pending values), so the
+    queue suite's history reaches the dense-walk device engines
+    instead of only the host queue invariants (ROADMAP item 3(a), the
+    bounded-model remainder). Opt-in (default ``None``, the unbounded
+    workload with host-only checking): capping the enqueue COUNT
+    changes backlog dynamics, so faults that need a deep backlog —
+    the lossy-autoheal scenario — keep the unbounded mix."""
+    from jepsen_tpu import models
+
     node_names = util.node_names(nodes)
     broker = FakeBroker(node_names, mode=mode, seed=seed)
-    main = g.TimeLimit(
-        time_limit,
-        g.Stagger(0.001, workload(seed=seed, enqueue_weight=enqueue_weight),
-                  seed=seed))
+    wl = workload(seed=seed, enqueue_weight=enqueue_weight,
+                  universe=universe)
+    main = g.TimeLimit(time_limit, g.Stagger(0.001, wl, seed=seed))
     # each role runs its own phase sequence: clients mix, then drain; the
     # nemesis cycles faults for the mix window, then heals once and
     # exhausts. The barrier makes every worker finish its in-flight
@@ -103,6 +121,9 @@ def queue_test(mode: str = "safe", *, time_limit: float = 5.0,
         "checker": facade.compose({
             "queue": facade.queue(),
             "total-queue": facade.total_queue(),
+            **({"linear": facade.linearizable(
+                    models.bounded_queue(universe))}
+               if universe is not None else {}),
             "timeline": timeline.html(),
             "latency": perf.latency_graph(),
             "rate": perf.rate_graph(),

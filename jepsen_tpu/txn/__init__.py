@@ -33,7 +33,8 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence
 
 from jepsen_tpu import obs, util
 from jepsen_tpu.op import Op
-from jepsen_tpu.txn import cycles, host_ref, infer as infer_mod, ops
+from jepsen_tpu.txn import cycles, host_ref, infer as infer_mod, \
+    lattice, ops
 from jepsen_tpu.txn.infer import DepGraph
 from jepsen_tpu.txn.ops import ListAppend, list_append_model
 
@@ -41,7 +42,7 @@ log = logging.getLogger("jepsen.txn")
 
 __all__ = ["check_history", "check_graph", "TxnChecker", "txn_checker",
            "ListAppend", "list_append_model", "ops", "cycles",
-           "host_ref", "DepGraph"]
+           "host_ref", "lattice", "DepGraph"]
 
 
 def _witness_detail(graph: DepGraph,
@@ -125,12 +126,23 @@ def check_graph(graph: DepGraph, *,
 def check_history(history: Sequence[Op], *,
                   devices: Optional[Sequence] = None,
                   max_dense_txns: Optional[int] = None,
-                  force_host: bool = False) -> Dict[str, Any]:
+                  force_host: bool = False,
+                  consistency: Optional[Any] = None) -> Dict[str, Any]:
     """The full transactional check: collect → infer → cycle-search.
     Inference-time (direct) anomalies — non-prefix reads, duplicate
     appends, G1a aborted reads — fail the history outright and skip
-    the cycle stage (a poisoned order could fabricate cycles)."""
+    the cycle stage (a poisoned order could fabricate cycles).
+
+    With ``consistency`` (a lattice level name, a list of them, or
+    ``"all"``) the check routes through the consistency lattice
+    (:mod:`jepsen_tpu.txn.lattice`): the result carries per-level
+    ``holds``/``levels``/``weakest-violated``, and ``valid`` gates on
+    the REQUESTED level(s) — every level is evaluated either way,
+    because one closure covers them all. ``consistency=None`` keeps
+    the legacy serializable-only verdict bit-for-bit."""
     t0 = _time.monotonic()
+    levels_req = (None if consistency is None
+                  else lattice.canon_levels(consistency))
     # collect/infer allocate millions of long-lived micro-op tuples:
     # every gen0/1 collection re-scans the growing survivor set, so
     # GC is paused across the whole check (util.gc_paused — bounded,
@@ -150,6 +162,41 @@ def check_history(history: Sequence[Op], *,
                    "anomalies": kinds, "anomaly": kinds[0],
                    "direct": [dict(d) for d in graph.direct[:32]],
                    "direct-count": len(graph.direct)}
+            if levels_req is not None:
+                # direct anomalies poison EVERY lattice level
+                res["consistency"] = list(levels_req)
+                res["holds"] = lattice.all_false_holds()
+                res["weakest-violated"] = lattice.LEVELS[0]
+                res["levels"] = {
+                    lvl: {"holds": False, "anomalies": kinds}
+                    for lvl in lattice.LEVELS}
+        elif levels_req is not None:
+            with obs.span("txn.lattice", txns=graph.n, edges=graph.e):
+                lat = lattice.check_levels(
+                    graph, devices=devices,
+                    max_dense_txns=max_dense_txns,
+                    force_host=force_host)
+            anomalies = [c for lvl in lattice.LEVELS
+                         for c in lat["levels"][lvl]["anomalies"]]
+            res = {"txns": graph.n, "edges": graph.e,
+                   "edge-counts": graph.edge_counts(),
+                   "valid": all(lat["holds"][lvl]
+                                for lvl in levels_req),
+                   "consistency": list(levels_req),
+                   "holds": lat["holds"], "levels": lat["levels"],
+                   "weakest-violated": lat["weakest-violated"],
+                   "booleans": lat["booleans"],
+                   "engine": lat["engine"],
+                   "anomalies": anomalies}
+            if lat["session-violations"]:
+                res["session-violations"] = lat["session-violations"]
+            if anomalies:
+                res["anomaly"] = anomalies[0]
+                wv = lat["weakest-violated"]
+                w = lat["levels"][wv].get("witness") if wv else None
+                if w is not None:
+                    res["witness"] = (_witness_detail(graph, w)
+                                      if "cycle" in w else w)
         else:
             with obs.span("txn.cycles", txns=graph.n, edges=graph.e):
                 res = check_graph(graph, devices=devices,
@@ -166,7 +213,7 @@ def check_history(history: Sequence[Op], *,
 
 
 # keyword subset the facade filters per-request options down to
-_TXN_KW = ("devices", "max_dense_txns", "force_host")
+_TXN_KW = ("devices", "max_dense_txns", "force_host", "consistency")
 
 
 @dataclass

@@ -39,7 +39,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from jepsen_tpu import obs
-from jepsen_tpu.txn.infer import RW, WR, WW, DepGraph
+from jepsen_tpu.txn.infer import CM, RW, WR, WW, DepGraph
 
 # dense closure envelope: Np*Np f32 intermediates, 4 lanes — 8192 is
 # ~1 GiB of HBM transients on one chip. Overridable for tests/bench.
@@ -113,23 +113,29 @@ def _masks(graph: DepGraph, Np: int
 
 
 @lru_cache(maxsize=32)
-def _closure_call(Np: int, packed_wire: bool):
-    """One compiled closure program per (padded geometry, wire
-    format): unpack-on-device, the batched squaring ladder, diagonal
-    reduction, and the G-single contraction — verdict is 4 bools."""
+def _lattice_call(Np: int, K: int, contracts: Tuple[int, ...],
+                  packed_wire: bool):
+    """One compiled closure program per (padded geometry, lane count,
+    contraction set, wire format): unpack-on-device, the batched
+    squaring ladder over ``K`` stacked lane masks, diagonal reduction,
+    and one rw contraction per lane in ``contracts`` — verdict is
+    ``K + len(contracts)`` bools. The legacy serializable closure is
+    the ``K=3, contracts=(1,)`` instance; the consistency lattice adds
+    the ``ww ∪ wr ∪ cm`` lane and its G-SIb contraction — same ladder,
+    one more batch row."""
     import jax
     import jax.numpy as jnp
 
     n_iter = max(1, math.ceil(math.log2(Np)))
 
-    def fn(wire3, wire_rw):
+    def fn(wireK, wire_rw):
         if packed_wire:
-            A = jnp.unpackbits(wire3, count=3 * Np * Np) \
-                   .reshape(3, Np, Np).astype(jnp.float32)
+            A = jnp.unpackbits(wireK, count=K * Np * Np) \
+                   .reshape(K, Np, Np).astype(jnp.float32)
             Arw = jnp.unpackbits(wire_rw, count=Np * Np) \
                      .reshape(Np, Np).astype(jnp.float32)
         else:
-            A = wire3.astype(jnp.float32)
+            A = wireK.astype(jnp.float32)
             Arw = wire_rw.astype(jnp.float32)
         C = A
         for _ in range(n_iter):
@@ -138,12 +144,21 @@ def _closure_call(Np: int, packed_wire: bool):
             prod = jnp.einsum("bij,bjk->bik", C, C,
                               preferred_element_type=jnp.float32)
             C = jnp.where(prod > 0, 1.0, C)
-        cyc = jnp.einsum("bii->b", C) > 0                    # [3]
-        refl = jnp.maximum(C[1], jnp.eye(Np, dtype=jnp.float32))
-        gs = jnp.einsum("ij,ji->", Arw, refl) > 0
-        return jnp.concatenate([cyc, gs[None]])
+        cyc = jnp.einsum("bii->b", C) > 0                    # [K]
+        eye = jnp.eye(Np, dtype=jnp.float32)
+        gs = [jnp.einsum("ij,ji->", Arw,
+                         jnp.maximum(C[L], eye))[None] > 0
+              for L in contracts]
+        return jnp.concatenate([cyc] + gs)
 
     return jax.jit(fn)
+
+
+def _closure_call(Np: int, packed_wire: bool):
+    """The legacy 4-boolean serializable closure program — the
+    ``K=3, contracts=(1,)`` lattice instance (bit-identical outputs:
+    ``[cyc_ww, cyc_wwwr, cyc_full, gsingle]``)."""
+    return _lattice_call(Np, 3, (1,), packed_wire)
 
 
 # -- word-packed closure body (the bit-parallel default) -----------------
@@ -180,11 +195,13 @@ def _pack_rows(a: np.ndarray) -> np.ndarray:
 
 
 @lru_cache(maxsize=32)
-def _closure_word_call(Np: int):
-    """One compiled word-packed closure program per padded geometry:
-    operands are the row-packed and transpose-packed adjacency words
-    (host-packed — 32x fewer wire bytes than even uint8) and the
-    row-packed rw mask; verdict is the same 4 bools."""
+def _lattice_word_call(Np: int, K: int, contracts: Tuple[int, ...]):
+    """One compiled word-packed closure program per (padded geometry,
+    lane count, contraction set): operands are the row-packed and
+    transpose-packed adjacency words (host-packed — 32x fewer wire
+    bytes than even uint8) and the row-packed rw mask; verdict is
+    ``K + len(contracts)`` bools. The legacy serializable closure is
+    the ``K=3, contracts=(1,)`` instance."""
     import jax
     import jax.numpy as jnp
 
@@ -210,30 +227,41 @@ def _closure_word_call(Np: int):
             Cw = Cw | pack_last(prod)
             CwT = CwT | pack_last(jnp.swapaxes(prod, 1, 2))
         i = jnp.arange(Np)
-        dwords = Cw[:, i, i >> 5]                        # [3, Np]
+        dwords = Cw[:, i, i >> 5]                        # [K, Np]
         cyc = (((dwords >> (i & 31).astype(jnp.uint32)) & 1) > 0) \
             .any(axis=1)
         eye_w = ((jnp.arange(NW)[None, :] == (i >> 5)[:, None])
                  .astype(jnp.uint32)
                  * (jnp.uint32(1) << (i & 31).astype(jnp.uint32)
                     )[:, None])                          # [Np, NW]
-        reflT_w = CwT[1] | eye_w
-        gs = jnp.any((Arw_w & reflT_w) != 0)
-        return jnp.concatenate([cyc, gs[None]])
+        gs = [jnp.any((Arw_w & (CwT[L] | eye_w)) != 0)[None]
+              for L in contracts]
+        return jnp.concatenate([cyc] + gs)
 
     return jax.jit(fn)
 
 
+def _closure_word_call(Np: int):
+    """The legacy 4-boolean word-packed closure program — the
+    ``K=3, contracts=(1,)`` lattice instance."""
+    return _lattice_word_call(Np, 3, (1,))
+
+
 def _word_closure_booleans(masks: np.ndarray, rw: np.ndarray,
-                           Np: int) -> np.ndarray:
+                           Np: int,
+                           contracts: Tuple[int, ...] = (1,)
+                           ) -> np.ndarray:
     """Run the word-packed one-shot closure. ``masks``/``rw`` are the
-    dense [3, Np, Np]/[Np, Np] bool masks; re-pads to the word floor
-    (words pack 32 columns) before packing."""
+    dense [K, Np, Np]/[Np, Np] bool masks; re-pads to the word floor
+    (words pack 32 columns) before packing. Callers bump their own
+    body counter (``txn.closure.word`` / ``txn.lattice.word``) so the
+    literals stay visible to the counter-drift lint."""
     from jepsen_tpu.checkers import transfer
 
+    K = masks.shape[0]
     Npw = _pad_n_words(Np)
     if Npw != masks.shape[1]:
-        grown = np.zeros((3, Npw, Npw), bool)
+        grown = np.zeros((K, Npw, Npw), bool)
         grown[:, :masks.shape[1], :masks.shape[2]] = masks
         masks = grown
         grown_rw = np.zeros((Npw, Npw), bool)
@@ -245,9 +273,8 @@ def _word_closure_booleans(masks: np.ndarray, rw: np.ndarray,
     transfer.count_put(
         int(Cw.nbytes + CwT.nbytes + Arw_w.nbytes),
         int((masks.size + rw.size) * 4))
-    out = np.asarray(_closure_word_call(Npw)(Cw, CwT, Arw_w))
-    obs.count("txn.closure.word")
-    return out
+    return np.asarray(_lattice_word_call(Npw, K, contracts)(
+        Cw, CwT, Arw_w))
 
 
 def _put_wire(masks: np.ndarray, rw: np.ndarray
@@ -282,6 +309,7 @@ def closure_booleans(graph: DepGraph,
     elif _closure_body(Np) == "word":
         try:
             out = _word_closure_booleans(masks, rw, Np)
+            obs.count("txn.closure.word")
         except Exception as e:                          # noqa: BLE001
             # the f32 einsum body is the RECORDED fallback of the
             # word-packed default: exactly one obs record, then the
@@ -298,6 +326,72 @@ def closure_booleans(graph: DepGraph,
         obs.count("txn.closure.device")
     return {"cyc_ww": bool(out[0]), "cyc_wwwr": bool(out[1]),
             "cyc_full": bool(out[2]), "gsingle": bool(out[3])}
+
+
+# -- consistency-lattice closure (ISSUE 17) ------------------------------
+
+# lattice lane stack: 0 = ww, 1 = ww∪wr, 2 = ww∪wr∪rw (full),
+# 3 = ww∪wr∪cm (the SI start/commit lane); contractions on lane 1
+# (G-single) and lane 3 (G-SIb: an rw edge closing a commit-order
+# cycle — write skew between non-overlapping txns)
+LATTICE_K = 4
+LATTICE_CONTRACTS = (1, 3)
+LATTICE_KEYS = ("cyc_ww", "cyc_wwwr", "cyc_full", "cyc_si",
+                "gsingle", "gsib")
+
+
+def _lattice_masks(graph: DepGraph, Np: int, cm: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """COO + commit mask -> stacked dense lane masks [4, Np, Np]
+    (ww / ww∪wr / full / ww∪wr∪cm) and the rw mask [Np, Np]."""
+    masks3, rw = _masks(graph, Np)
+    masks = np.zeros((LATTICE_K, Np, Np), bool)
+    masks[:3] = masks3
+    masks[3] = masks3[1]
+    masks[3, :cm.shape[0], :cm.shape[1]] |= cm
+    return masks, rw
+
+
+def lattice_booleans(graph: DepGraph, cm: np.ndarray,
+                     devices: Optional[Sequence] = None
+                     ) -> Dict[str, bool]:
+    """The six lattice cycle predicates from ONE device closure — the
+    ``[K, Np, NW]`` generalization of :func:`closure_booleans`:
+    checking every consistency level costs one squaring ladder, not
+    five. Raises on any device failure — the caller owns the
+    exactly-one-obs-fallback contract to the host lattice reference.
+
+    The lattice ladder is single-chip only (the row-block mesh tiling
+    stays a serializable-closure specialization): a multi-device
+    request runs the same single-chip program, recorded as a route
+    decision, never silently."""
+    Np = _pad_n(graph.n)
+    masks, rw = _lattice_masks(graph, Np, cm)
+    if devices is not None and len(devices) > 1:
+        obs.decision("txn-lattice", "route", cause="single-chip",
+                     devices=len(devices), txns=graph.n)
+        obs.count("txn.lattice.single_chip_route")
+    if _closure_body(Np) == "word":
+        try:
+            out = _word_closure_booleans(
+                masks, rw, Np, contracts=LATTICE_CONTRACTS)
+            obs.count("txn.lattice.word")
+        except Exception as e:                          # noqa: BLE001
+            # the f32 einsum body is the RECORDED fallback of the
+            # word-packed default, exactly as on the serializable path
+            obs.engine_fallback("word-closure", type(e).__name__,
+                                txns=graph.n, edges=graph.e)
+            w3, wrw, packed_wire = _put_wire(masks, rw)
+            out = np.asarray(_lattice_call(
+                Np, LATTICE_K, LATTICE_CONTRACTS, packed_wire)(
+                w3, wrw))
+            obs.count("txn.lattice.device")
+    else:
+        w3, wrw, packed_wire = _put_wire(masks, rw)
+        out = np.asarray(_lattice_call(
+            Np, LATTICE_K, LATTICE_CONTRACTS, packed_wire)(w3, wrw))
+        obs.count("txn.lattice.device")
+    return {k: bool(out[i]) for i, k in enumerate(LATTICE_KEYS)}
 
 
 # -- incremental closure (streaming check sessions) ----------------------
@@ -328,10 +422,14 @@ class ClosureOverflow(RuntimeError):
 
 
 @lru_cache(maxsize=32)
-def _inc_call(Np: int, d_pad: int, e_pad: int):
+def _inc_call(Np: int, d_pad: int, e_pad: int, K: int = 3,
+              contracts: Tuple[int, ...] = (1,)):
     """One compiled dirty-block update per (geometry, dirty width,
-    edge width): scatter → dirty-block ladder → skinny closure join →
-    verdict. The carried masks are donated (in-place advance)."""
+    edge width, lane stack): scatter → dirty-block ladder → skinny
+    closure join → verdict. The carried masks are donated (in-place
+    advance). ``K=3, contracts=(1,)`` is the legacy serializable
+    session; the lattice session carries the fourth (``ww∪wr∪cm``)
+    lane and its G-SIb contraction through the same decomposition."""
     import jax
     import jax.numpy as jnp
 
@@ -340,9 +438,9 @@ def _inc_call(Np: int, d_pad: int, e_pad: int):
     def fn(C, Arw, esrc, edst, elane, erw, dsel):
         s = jnp.where(esrc < 0, 0, esrc)
         d = jnp.where(edst < 0, 0, edst)
-        # scatter the batch's edges into the three lane masks + rw
+        # scatter the batch's edges into the K lane masks + rw
         # (pad entries carry zero weight: .max(0) is the identity)
-        for lane in range(3):
+        for lane in range(K):
             C = C.at[lane, s, d].max(elane[lane])
         Arw = Arw.at[s, d].max(erw)
         dd = jnp.where(dsel < 0, 0, dsel)
@@ -367,9 +465,11 @@ def _inc_call(Np: int, d_pad: int, e_pad: int):
                          preferred_element_type=jnp.float32)
         C = jnp.where(add > 0, 1.0, C)
         cyc = jnp.einsum("bii->b", C) > 0
-        refl = jnp.maximum(C[1], jnp.eye(Np, dtype=jnp.float32))
-        gs = jnp.einsum("ij,ji->", Arw, refl) > 0
-        return C, Arw, jnp.concatenate([cyc, gs[None]])
+        eye = jnp.eye(Np, dtype=jnp.float32)
+        gs = [jnp.einsum("ij,ji->", Arw,
+                         jnp.maximum(C[L], eye))[None] > 0
+              for L in contracts]
+        return C, Arw, jnp.concatenate([cyc] + gs)
 
     return jax.jit(fn, donate_argnums=(0, 1))
 
@@ -379,7 +479,8 @@ def _pow2_at_least(n: int, floor: int = 8) -> int:
 
 
 @lru_cache(maxsize=32)
-def _inc_word_call(Np: int, d_pad: int, e_pad: int):
+def _inc_word_call(Np: int, d_pad: int, e_pad: int, K: int = 3,
+                   contracts: Tuple[int, ...] = (1,)):
     """Word-packed dirty-block update: the carried closure lives as
     row-packed ``Cw`` + transpose-packed ``CwT`` [3, Np, NW] uint32
     (+ ``Arw_w`` [Np, NW]) — 32x denser device residency than the f32
@@ -486,8 +587,9 @@ def _inc_word_call(Np: int, d_pad: int, e_pad: int):
                  .astype(jnp.uint32)
                  * (jnp.uint32(1) << (i & 31).astype(jnp.uint32)
                     )[:, None])
-        gs = jnp.any((Arw_w & (CwT[1] | eye_w)) != 0)
-        return Cw, CwT, Arw_w, jnp.concatenate([cyc, gs[None]])
+        gs = [jnp.any((Arw_w & (CwT[L] | eye_w)) != 0)[None]
+              for L in contracts]
+        return Cw, CwT, Arw_w, jnp.concatenate([cyc] + gs)
 
     return jax.jit(fn, donate_argnums=(0, 1, 2))
 
@@ -496,22 +598,29 @@ class IncrementalClosure:
     """Device-resident incremental transitive closure for one txn
     session. ``add_block(n_txns, src, dst, et)`` folds an append
     batch's new edges in and returns the four cycle booleans (the
-    same :func:`closure_booleans` keys). Raises
+    same :func:`closure_booleans` keys) — six with ``lattice=True``,
+    where the carried stack grows the ``ww∪wr∪cm`` lane, ``et`` may
+    carry :data:`~jepsen_tpu.txn.infer.CM` rows, and the verdict adds
+    ``cyc_si``/``gsib`` (:data:`LATTICE_KEYS`). Raises
     :class:`ClosureOverflow` when the graph outgrows the dense
     envelope and any device failure to the caller, which owns the
     exactly-one-obs-fallback contract."""
 
-    def __init__(self, *, max_dense_txns: Optional[int] = None) -> None:
+    def __init__(self, *, max_dense_txns: Optional[int] = None,
+                 lattice: bool = False) -> None:
         self._cap = (max_dense_txns if max_dense_txns is not None
                      else max_dense())
         self.Np = 0
+        self.lattice = lattice
+        self.K = LATTICE_K if lattice else 3
+        self._contracts = LATTICE_CONTRACTS if lattice else (1,)
         # body pinned at construction (a session must not flip bodies
         # mid-stream — the carried state formats differ)
         self.packed = _closure_body(_WORD_NP_FLOOR) == "word"
-        self._C = None                      # f32 [3, Np, Np] on device
+        self._C = None                      # f32 [K, Np, Np] on device
         self._Arw = None                    # f32 [Np, Np] on device
-        self._Cw = None                     # u32 [3, Np, NW] (packed)
-        self._CwT = None                    # u32 [3, Np, NW] (packed)
+        self._Cw = None                     # u32 [K, Np, NW] (packed)
+        self._CwT = None                    # u32 [K, Np, NW] (packed)
         self._Arw_w = None                  # u32 [Np, NW]    (packed)
         self.updates = 0
 
@@ -522,13 +631,14 @@ class IncrementalClosure:
         if self.packed:
             NW = Np // 32
             self._Cw = jax.device_put(
-                jnp.zeros((3, Np, NW), jnp.uint32))
+                jnp.zeros((self.K, Np, NW), jnp.uint32))
             self._CwT = jax.device_put(
-                jnp.zeros((3, Np, NW), jnp.uint32))
+                jnp.zeros((self.K, Np, NW), jnp.uint32))
             self._Arw_w = jax.device_put(
                 jnp.zeros((Np, NW), jnp.uint32))
             return
-        self._C = jax.device_put(jnp.zeros((3, Np, Np), jnp.float32))
+        self._C = jax.device_put(
+            jnp.zeros((self.K, Np, Np), jnp.float32))
         self._Arw = jax.device_put(jnp.zeros((Np, Np), jnp.float32))
 
     def _regrow(self, n: int) -> None:
@@ -551,15 +661,15 @@ class IncrementalClosure:
             CwT = np.asarray(self._CwT)
             Aw = np.asarray(self._Arw_w)
             NW = Cw.shape[2]
-            Cw2 = np.zeros((3, Np2, NW2), np.uint32)
-            CwT2 = np.zeros((3, Np2, NW2), np.uint32)
+            Cw2 = np.zeros((self.K, Np2, NW2), np.uint32)
+            CwT2 = np.zeros((self.K, Np2, NW2), np.uint32)
             Aw2 = np.zeros((Np2, NW2), np.uint32)
             Cw2[:, :self.Np, :NW] = Cw
             CwT2[:, :self.Np, :NW] = CwT
             Aw2[:self.Np, :NW] = Aw
             transfer.count_put(
                 int(Cw2.nbytes + CwT2.nbytes + Aw2.nbytes),
-                int((2 * 3 + 1) * Np2 * Np2 * 4))
+                int((2 * self.K + 1) * Np2 * Np2 * 4))
             self.Np = Np2
             self._Cw = jax.device_put(Cw2)
             self._CwT = jax.device_put(CwT2)
@@ -568,7 +678,7 @@ class IncrementalClosure:
             return
         C = np.asarray(self._C)
         Arw = np.asarray(self._Arw)
-        C2 = np.zeros((3, Np2, Np2), np.float32)
+        C2 = np.zeros((self.K, Np2, Np2), np.float32)
         Arw2 = np.zeros((Np2, Np2), np.float32)
         C2[:, :self.Np, :self.Np] = C
         Arw2[:self.Np, :self.Np] = Arw
@@ -605,12 +715,18 @@ class IncrementalClosure:
         edst = np.full(e_pad, -1, np.int32)
         esrc[:e] = src
         edst[:e] = dst
-        elane = np.zeros((3, e_pad), np.float32)
+        elane = np.zeros((self.K, e_pad), np.float32)
         erw = np.zeros(e_pad, np.float32)
-        from jepsen_tpu.txn.infer import RW, WR, WW
+        from jepsen_tpu.txn.infer import CM, RW, WR, WW
         elane[0, :e] = (et == WW)
         elane[1, :e] = (et == WW) | (et == WR)
-        elane[2, :e] = 1.0
+        if self.lattice:
+            # lane 2 (full) excludes the commit-order rows; lane 3 is
+            # the SI lane: ww ∪ wr ∪ cm
+            elane[2, :e] = (et != CM)
+            elane[3, :e] = (et == WW) | (et == WR) | (et == CM)
+        else:
+            elane[2, :e] = 1.0
         erw[:e] = (et == RW)
         dsel = np.full(d_pad, -1, np.int32)
         dsel[:len(d_ids)] = d_ids
@@ -620,22 +736,26 @@ class IncrementalClosure:
         transfer.count_put(wire, wire)
         if self.packed:
             self._Cw, self._CwT, self._Arw_w, out = _inc_word_call(
-                self.Np, d_pad, e_pad)(
+                self.Np, d_pad, e_pad, self.K, self._contracts)(
                 self._Cw, self._CwT, self._Arw_w, jnp.asarray(esrc),
                 jnp.asarray(edst), jnp.asarray(elane),
                 jnp.asarray(erw), jnp.asarray(dsel))
             self.updates += 1
             obs.count("txn.closure.incremental")
             obs.count("txn.closure.incremental_word")
-            o = np.asarray(out)
-            return {"cyc_ww": bool(o[0]), "cyc_wwwr": bool(o[1]),
-                    "cyc_full": bool(o[2]), "gsingle": bool(o[3])}
-        self._C, self._Arw, out = _inc_call(self.Np, d_pad, e_pad)(
+            return self._bools(np.asarray(out))
+        self._C, self._Arw, out = _inc_call(
+            self.Np, d_pad, e_pad, self.K, self._contracts)(
             self._C, self._Arw, jnp.asarray(esrc), jnp.asarray(edst),
             jnp.asarray(elane), jnp.asarray(erw), jnp.asarray(dsel))
         self.updates += 1
         obs.count("txn.closure.incremental")
-        o = np.asarray(out)
+        return self._bools(np.asarray(out))
+
+    def _bools(self, o: np.ndarray) -> Dict[str, bool]:
+        if self.lattice:
+            obs.count("txn.lattice.incremental")
+            return {k: bool(o[i]) for i, k in enumerate(LATTICE_KEYS)}
         return {"cyc_ww": bool(o[0]), "cyc_wwwr": bool(o[1]),
                 "cyc_full": bool(o[2]), "gsingle": bool(o[3])}
 

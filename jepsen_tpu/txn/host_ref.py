@@ -28,7 +28,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from jepsen_tpu.txn.infer import RW, WR, WW, DepGraph
+from jepsen_tpu.txn.infer import CM, RW, WR, WW, DepGraph
 
 # class name -> edge types allowed in its witness cycle
 _CLASS_EDGES = {"G0": (WW,), "G1c": (WW, WR),
@@ -238,6 +238,185 @@ def find_witness(graph: DepGraph, cls: str) -> Optional[Dict[str, Any]]:
                          for i in range(len(cycle))]
                 return {"cycle": cycle,
                         "edges": [EDGE_NAMES[t] for t in edges]}
+    return None
+
+
+# -- consistency-lattice host reference (ISSUE 17) -----------------------
+#
+# The snapshot-isolation lane (ww ∪ wr ∪ cm) needs commit-order
+# reachability WITHOUT materializing the dense [n, n] cm mask (the
+# host reference must run on graphs far past the dense envelope). The
+# chain-node trick realizes the interval order in O(n) extra nodes and
+# edges: one chain node per txn in start order, forward chain edges,
+# an entry edge into each txn from its start position, and one exit
+# edge from each committed txn to the first chain position whose start
+# follows its commit. Then u ⇒cm⇒ v iff a chain path u → … → v exists,
+# and cm composed with dependency edges is plain reachability on the
+# extended graph. Chain edges are labeled :data:`CM` so witness walks
+# contract chain runs back into one reported ``cm`` hop.
+
+_LANE_NAMES = ("ww", "wr", "rw", "cm")
+
+
+def _chain_adj(graph: DepGraph, starts: np.ndarray, ends: np.ndarray,
+               types: Sequence[int] = (WW, WR)
+               ) -> List[List[Tuple[int, int]]]:
+    """Extended adjacency (2n nodes: txns 0..n-1, chain n..2n-1 in
+    start order) over ``types`` dependency edges plus the commit-order
+    chain. Sorted per node for deterministic walks."""
+    n = graph.n
+    adj: List[List[Tuple[int, int]]] = [[] for _ in range(2 * n)]
+    order = np.argsort(starts, kind="stable")
+    sorted_starts = starts[order]
+    for p in range(n):
+        if p + 1 < n:
+            adj[n + p].append((n + p + 1, CM))
+        adj[n + p].append((int(order[p]), CM))
+    exits = np.searchsorted(sorted_starts, ends, side="right")
+    for u in range(n):
+        if ends[u] >= 0 and exits[u] < n:
+            adj[u].append((n + int(exits[u]), CM))
+    tset = set(types)
+    for u, v, t in zip(graph.src.tolist(), graph.dst.tolist(),
+                       graph.et.tolist()):
+        if t in tset:
+            adj[int(u)].append((int(v), int(t)))
+    for lst in adj:
+        lst.sort()
+    return adj
+
+
+def _contract_chain(path: List[int], n: int,
+                    adj: List[List[Tuple[int, int]]]
+                    ) -> Tuple[List[int], List[str]]:
+    """Collapse chain-node runs of an extended-graph walk into single
+    ``cm`` hops between real txns. Returns (real nodes in walk order,
+    labels between consecutive reals — direct dependency edges keep
+    their type name, chain detours report as ``cm``)."""
+    reals: List[int] = []
+    labels: List[str] = []
+    prev: Optional[int] = None
+    pend_cm = False
+    for v in path:
+        if v >= n:
+            pend_cm = True
+            continue
+        if prev is not None:
+            labels.append("cm" if pend_cm
+                          else _LANE_NAMES[_edge_type(adj, prev, v)])
+        reals.append(v)
+        prev = v
+        pend_cm = False
+    return reals, labels
+
+
+def lattice_classify_booleans(graph: DepGraph, starts: np.ndarray,
+                              ends: np.ndarray) -> Dict[str, bool]:
+    """The two SI-lane predicates on the host — the reference the
+    ``[K, Np, NW]`` lattice closure is differentially held to:
+    ``cyc_si`` (a cycle in ``ww ∪ wr ∪ cm``) and ``gsib`` (an rw edge
+    closing such a cycle — exactly one anti-dependency)."""
+    n = graph.n
+    adj_ext = _chain_adj(graph, starts, ends, (WW, WR))
+    cyc_si = False
+    for comp in scc(2 * n, adj_ext):
+        if sum(1 for v in comp if v < n) >= 2:
+            cyc_si = True
+            break
+    gsib = False
+    adj_full_ext = _chain_adj(graph, starts, ends, (WW, WR, RW))
+    comp_of: Dict[int, int] = {}
+    for ci, comp in enumerate(scc(2 * n, adj_full_ext)):
+        if len(comp) > 1:
+            for v in comp:
+                comp_of[v] = ci
+    for u, v, t in zip(graph.src.tolist(), graph.dst.tolist(),
+                       graph.et.tolist()):
+        if t != RW:
+            continue
+        u, v = int(u), int(v)
+        if comp_of.get(u) is None or comp_of.get(u) != comp_of.get(v):
+            continue
+        if _bfs_path(adj_ext, v, u) is not None:
+            gsib = True
+            break
+    return {"cyc_si": cyc_si, "gsib": gsib}
+
+
+def gsia_scan(graph: DepGraph, starts: np.ndarray,
+              ends: np.ndarray) -> Optional[Dict[str, Any]]:
+    """Adya's G-SIa interference witness, restricted to what intervals
+    can PROVE: a ww/wr dependency ``u → v`` where ``v`` committed
+    before ``u`` even began — ``v`` observed (or was overwritten by) a
+    transaction from its future. Deliberately NOT the classic
+    "no commit-before-start" form, which brands every overlapping-but-
+    correct history invalid; this form never fires on a real system.
+    Returns the first witness in sorted edge order, or None."""
+    best: Optional[Tuple[int, int, int]] = None
+    for u, v, t in zip(graph.src.tolist(), graph.dst.tolist(),
+                       graph.et.tolist()):
+        if t == RW:
+            continue
+        u, v = int(u), int(v)
+        if ends[v] >= 0 and ends[v] < starts[u]:
+            cand = (u, v, int(t))
+            if best is None or cand < best:
+                best = cand
+    if best is None:
+        return None
+    u, v, t = best
+    return {"cycle": [u, v], "edges": [_LANE_NAMES[t], "cm"]}
+
+
+def find_lattice_witness(graph: DepGraph, cls: str,
+                         starts: np.ndarray, ends: np.ndarray
+                         ) -> Optional[Dict[str, Any]]:
+    """One concrete SI-lane witness, deterministically — the lattice
+    analogue of :func:`find_witness` for the classes the commit-order
+    lane adds: ``G-SIa`` (a dependency edge contradicting commit
+    order), ``G-SIb`` (one rw edge closing a ``ww ∪ wr ∪ cm`` cycle),
+    ``G-SI`` (any other cycle in that lane). Chain-node runs report
+    as single ``cm`` hops."""
+    n = graph.n
+    if cls == "G-SIa":
+        return gsia_scan(graph, starts, ends)
+    adj_ext = _chain_adj(graph, starts, ends, (WW, WR))
+    if cls == "G-SIb":
+        adj_full_ext = _chain_adj(graph, starts, ends, (WW, WR, RW))
+        comp_of: Dict[int, int] = {}
+        for ci, comp in enumerate(scc(2 * n, adj_full_ext)):
+            if len(comp) > 1:
+                for v in comp:
+                    comp_of[v] = ci
+        rw_edges = sorted(
+            (int(u), int(v))
+            for u, v, t in zip(graph.src.tolist(), graph.dst.tolist(),
+                               graph.et.tolist())
+            if t == RW and comp_of.get(int(u)) is not None
+            and comp_of.get(int(u)) == comp_of.get(int(v)))
+        for u, v in rw_edges:
+            path = _bfs_path(adj_ext, v, u)
+            if path is not None:
+                reals, labels = _contract_chain(path, n, adj_ext)
+                return {"cycle": [u] + reals[:-1],
+                        "edges": ["rw"] + labels}
+        return None
+    if cls == "G-SI":
+        for comp in scc(2 * n, adj_ext):
+            reals = [v for v in comp if v < n]
+            if len(reals) < 2:
+                continue
+            start = reals[0]
+            comp_set = set(comp)
+            sub = [[(v, t) for v, t in adj_ext[u] if v in comp_set]
+                   for u in range(2 * n)]
+            for succ, _t in sub[start]:
+                path = _bfs_path(sub, succ, start)
+                if path is not None:
+                    reals_c, labels = _contract_chain(
+                        [start] + path, n, sub)
+                    return {"cycle": reals_c[:-1], "edges": labels}
+        return None
     return None
 
 

@@ -42,6 +42,30 @@ from jepsen_tpu.util import hashable, hashable_seq
 WW, WR, RW = 0, 1, 2
 EDGE_NAMES = ("ww", "wr", "rw")
 
+# commit-order pseudo-edge code — NOT a DepGraph edge type (post-hoc
+# inference never stores it; it is derived from txn intervals), but the
+# lattice closure's fourth lane speaks it on the incremental wire
+CM = 3
+
+
+def commit_mask(txns: Sequence[txn_ops.Txn]) -> np.ndarray:
+    """Dense commit-order mask for the snapshot-isolation lattice
+    level: ``cm[i, j]`` is True when txn ``i`` committed strictly
+    before txn ``j`` began (``end_i < start_j`` over history op
+    indices). Crashed txns have no commit point (``end == -1``) and
+    emit no cm out-edges — a txn that never committed cannot be
+    "first committer" against anyone. cm is transitive by
+    construction (every txn's start precedes its own commit), so the
+    closure lane that mixes it with ww/wr needs no extra pass."""
+    n = len(txns)
+    if n == 0:
+        return np.zeros((0, 0), bool)
+    start = np.asarray([t.index for t in txns], np.int64)
+    end = np.asarray([t.end for t in txns], np.int64)
+    cm = (end >= 0)[:, None] & (end[:, None] < start[None, :])
+    np.fill_diagonal(cm, False)
+    return cm
+
 
 @dataclass(frozen=True)
 class DepGraph:
@@ -284,6 +308,14 @@ class IncrementalInfer:
         self.counters: Dict[str, int] = {}
         self._edges: Set[Tuple[int, int, int]] = set()
         self._fresh: List[Tuple[int, int, int]] = []
+        # stream positions drive the SI commit-order mask: every fed op
+        # advances ``_pos`` (txn or not), so relative start/commit order
+        # matches what post-hoc ``h.index`` would assign the same stream
+        self._pos = 0
+        self._live_start: Dict[Any, int] = {}   # proc -> invoke pos
+        self.starts: List[int] = []             # per tid
+        self.ends: List[int] = []               # per tid; -1 = crashed
+        self._cm_fresh: List[Tuple[int, int]] = []
 
     # -- ingestion -------------------------------------------------------
     def feed_block(self, ops: Sequence[Any]) -> None:
@@ -291,14 +323,18 @@ class IncrementalInfer:
         and run settled inference over the completions."""
         txn_ops = self._ops_mod
         for op in ops:
+            pos = self._pos
+            self._pos += 1
             if op.process == "nemesis" or op.f != "txn":
                 continue
             if op.type == "invoke":
                 self._live[op.process] = op
+                self._live_start[op.process] = pos
                 continue
             inv = self._live.pop(op.process, None)
             if inv is None:
                 continue                    # completion without invoke
+            start = self._live_start.pop(op.process, pos)
             if op.type == "fail":
                 self.fails.append(txn_ops.FailedTxn(
                     op=inv, micros=tuple(txn_ops.micro_ops(inv.value))))
@@ -307,12 +343,12 @@ class IncrementalInfer:
                 value = op.value if op.value is not None else inv.value
                 self._add_txn(inv.with_(value=value),
                               tuple(txn_ops.micro_ops(value)),
-                              crashed=False)
+                              crashed=False, start=start, end=pos)
             elif op.type == "info":
                 micros = tuple(
                     (k, key, None) if k == txn_ops.READ else (k, key, v)
                     for k, key, v in txn_ops.micro_ops(inv.value))
-                self._add_txn(inv, micros, crashed=True)
+                self._add_txn(inv, micros, crashed=True, start=start)
 
     def resolve_stragglers(self) -> None:
         """The stream is over: still-pending invocations resolve as
@@ -320,13 +356,15 @@ class IncrementalInfer:
         then still-pending reads finalize — a value with no appender
         now is a genuine phantom / aborted read."""
         txn_ops = self._ops_mod
-        for _p, inv in sorted(self._live.items(),
-                              key=lambda kv: kv[1].index):
+        for p, inv in sorted(self._live.items(),
+                             key=lambda kv: kv[1].index):
             micros = tuple(
                 (k, key, None) if k == txn_ops.READ else (k, key, v)
                 for k, key, v in txn_ops.micro_ops(inv.value))
-            self._add_txn(inv, micros, crashed=True)
+            self._add_txn(inv, micros, crashed=True,
+                          start=self._live_start.get(p, self._pos))
         self._live.clear()
+        self._live_start.clear()
         for hk, ks in self._keys.items():
             still = ks.pending
             ks.pending = []
@@ -351,11 +389,23 @@ class IncrementalInfer:
                 ks = self._key(k)
                 ks.failed_vals.setdefault(hashable(v), f.op.index)
 
-    def _add_txn(self, op: Any, micros: Tuple, crashed: bool) -> None:
+    def _add_txn(self, op: Any, micros: Tuple, crashed: bool,
+                 start: int = -1, end: int = -1) -> None:
         from jepsen_tpu.txn.ops import APPEND, READ, Txn
         tid = len(self.txns)
         self.txns.append(Txn(tid=tid, op=op, micros=micros,
-                             crashed=crashed))
+                             crashed=crashed, end=end))
+        # commit-order in-edges: every txn added earlier committed (if
+        # at all) at a smaller stream position, so the only NEW cm
+        # edges a txn can bring are into itself — u→tid whenever u's
+        # commit precedes this txn's start. O(n) vector scan per txn;
+        # the dense-session cap bounds the quadratic total.
+        if tid and start >= 0:
+            ends = np.asarray(self.ends, np.int64)
+            for u in np.nonzero((ends >= 0) & (ends < start))[0]:
+                self._cm_fresh.append((int(u), tid))
+        self.starts.append(start)
+        self.ends.append(end)
         touched: List[Any] = []
         for kind, k, v in micros:
             hk = hashable(k)
@@ -516,6 +566,27 @@ class IncrementalInfer:
         return (arr[:, 0].astype(np.int32),
                 arr[:, 1].astype(np.int32),
                 arr[:, 2].astype(np.int32))
+
+    def drain_new_cm(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Commit-order edges proven since the last drain, as
+        (src, dst) int32 arrays — the lattice closure's fourth lane
+        (:data:`CM`) delta. Separate from :meth:`drain_new_edges`
+        because cm is not a :class:`DepGraph` edge type: the post-hoc
+        path derives it from txn intervals (:func:`commit_mask`)."""
+        fresh, self._cm_fresh = self._cm_fresh, []
+        if not fresh:
+            z = np.zeros(0, np.int32)
+            return z, z.copy()
+        arr = np.asarray(fresh, np.int64)
+        return arr[:, 0].astype(np.int32), arr[:, 1].astype(np.int32)
+
+    def intervals(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-tid (start, commit) stream positions — what the lattice
+        host reference needs to rebuild :func:`commit_mask` exactly as
+        the incremental cm lane saw it (incremental ``Txn.op.index``
+        values are whatever the client sent; positions are ours)."""
+        return (np.asarray(self.starts, np.int64),
+                np.asarray(self.ends, np.int64))
 
     def graph(self) -> DepGraph:
         """The accumulated dependency graph (host fallback rungs and

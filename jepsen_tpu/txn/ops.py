@@ -116,11 +116,18 @@ class Txn:
     carry their observed versions) and from the invocation otherwise
     (an ``info`` txn's reads stay ``None`` — a version observed by a
     crashed client never reached anyone and cannot order anything).
+
+    ``end`` is the completion op's history index for ``ok`` txns and
+    ``-1`` for crashed ones — the start/commit interval
+    (``op.index``, ``end``) the snapshot-isolation lattice level turns
+    into commit-order edges. A crashed txn has no commit point, so it
+    emits no such edges.
     """
     tid: int
     op: Op
     micros: Tuple[Tuple[str, Any, Any], ...]
     crashed: bool
+    end: int = -1
 
     @property
     def process(self) -> Any:
@@ -179,8 +186,11 @@ def collect(history: Sequence[Op]
         # completed value back onto the op (a dataclasses.replace per
         # txn) was ~25% of collect at the 100k rung, for a field no
         # consumer reads
+        end = -1
+        if not p.crashed and comp is not None and comp.index >= 0:
+            end = comp.index
         txns.append(Txn(tid=len(txns), op=inv,
-                        micros=micros, crashed=p.crashed))
+                        micros=micros, crashed=p.crashed, end=end))
     return txns, fails
 
 

@@ -90,6 +90,37 @@ def _txn_cell(res: dict) -> str:
     return f" {badges}{_witness_html(res)}"
 
 
+# mirrors txn.lattice.LEVELS (weak -> strong); kept local so the web
+# view never imports the checker stack just to render a report
+_LATTICE_LEVELS = ("read-committed", "causal", "pl-2", "si",
+                   "serializable")
+
+
+def _lattice_cell(res: dict) -> str:
+    """Per-level lattice verdict badges for a consistency-checked txn
+    result: one badge per reported level in lattice order, green
+    where the level holds, red where violated, and the WEAKEST
+    violated level (the first guarantee the history breaks walking up
+    the lattice) outlined so it reads at a glance."""
+    holds = res.get("holds")
+    if not isinstance(holds, dict) or not holds:
+        return ""
+    wv = res.get("weakest-violated")
+    out = []
+    for lvl in _LATTICE_LEVELS:
+        if lvl not in holds:
+            continue
+        ok = bool(holds[lvl])
+        color = "#2e7d32" if ok else "#c62828"
+        mark = "&#10003;" if ok else "&#10007;"
+        extra = "outline:2px solid #ffab00;" if lvl == wv else ""
+        out.append(
+            f"<span class='badge' "
+            f"style='background:{color};{extra}'>"
+            f"{html.escape(lvl)} {mark}</span>")
+    return (" " + " ".join(out)) if out else ""
+
+
 def _run_row(root: str, name: str, run: str) -> str:
     valid = ""
     res: dict = {}
@@ -110,9 +141,11 @@ def _run_row(root: str, name: str, run: str) -> str:
         if os.path.exists(os.path.join(run, a)))
     # txn verdicts may live at the top level (cli check / serve runs)
     # or composed under results.txn (suite runs)
-    txn_res = res if "anomalies" in res else \
+    txn_res = res if ("anomalies" in res or "holds" in res) else \
         (res.get("results", {}) or {}).get("txn", {})
-    txn_cell = _txn_cell(txn_res if isinstance(txn_res, dict) else {})
+    if not isinstance(txn_res, dict):
+        txn_res = {}
+    txn_cell = _lattice_cell(txn_res) + _txn_cell(txn_res)
     return (f"<tr><td><a href='/files/{rel}/'>{html.escape(name)}</a>"
             f"</td><td>{html.escape(os.path.basename(run))}</td>"
             f"<td>{_badge(valid)}{txn_cell}</td>"

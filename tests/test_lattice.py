@@ -1,0 +1,341 @@
+"""Consistency-model lattice (ISSUE 17): SI / causal /
+session-guarantee checking as one parameterized word kernel.
+
+Crafted fixtures with documented per-level ground truth (write-skew
+SI-invalid-but-causal-valid, lost-update invalid at EVERY level,
+long-fork, session-MR), held bit-identical across the word-packed
+device ladder, the f32 fallback body, and the host chain-node
+reference; randomized differentials; the streaming session's
+incremental per-level holds vs the one-shot checker; the serve
+protocol's ``consistency`` option end-to-end over HTTP."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import fixtures, obs, txn
+from jepsen_tpu import history as h
+from jepsen_tpu.checkers import facade
+from jepsen_tpu.txn import cycles, lattice
+
+ALL_LEVELS = list(lattice.LEVELS)
+
+# per-fixture ground truth (documented beside TXN_LATTICE_KINDS)
+TRUTH = {
+    "write-skew": {"read-committed": True, "causal": True,
+                   "pl-2": True, "si": False, "serializable": False},
+    "lost-update": {lvl: False for lvl in ALL_LEVELS},
+    "long-fork": {"read-committed": True, "causal": True,
+                  "pl-2": True, "si": False, "serializable": False},
+    "session-mr": {"read-committed": True, "causal": True,
+                   "pl-2": False, "si": False, "serializable": False},
+}
+WEAKEST = {"write-skew": "si", "lost-update": "read-committed",
+           "long-fork": "si", "session-mr": "pl-2"}
+
+
+def _block(kind):
+    return h.index([o.with_(index=-1)
+                    for o in fixtures.txn_anomaly_block(kind)])
+
+
+def _check(hist, monkeypatch=None, *, body="word", **kw):
+    if body == "f32":
+        assert monkeypatch is not None
+        monkeypatch.setenv("JEPSEN_TPU_NO_WORD_CLOSURE", "1")
+        try:
+            return txn.check_history(hist, consistency=ALL_LEVELS,
+                                     **kw)
+        finally:
+            monkeypatch.delenv("JEPSEN_TPU_NO_WORD_CLOSURE")
+    if body == "host":
+        kw["force_host"] = True
+    return txn.check_history(hist, consistency=ALL_LEVELS, **kw)
+
+
+def _sig(res):
+    per = res.get("levels") or {}
+    return (res.get("valid"), res.get("holds"),
+            res.get("weakest-violated"),
+            {lvl: ((per.get(lvl) or {}).get("anomalies"),
+                   (per.get(lvl) or {}).get("witness"))
+             for lvl in ALL_LEVELS})
+
+
+# -- crafted fixtures, three engines ----------------------------------------
+
+@pytest.mark.parametrize("kind", fixtures.TXN_LATTICE_KINDS)
+def test_fixture_ground_truth_all_engines(kind, monkeypatch):
+    hist = _block(kind)
+    word = _check(hist)
+    f32 = _check(hist, monkeypatch, body="f32")
+    host = _check(hist, body="host")
+    assert word["holds"] == TRUTH[kind], kind
+    assert word["weakest-violated"] == WEAKEST[kind]
+    # per-level verdicts + witnesses bit-identical across all bodies
+    assert _sig(word) == _sig(f32) == _sig(host)
+    assert host["engine"] == "txn-lattice-host"
+    assert word["engine"] in ("txn-lattice-mxu", "txn-lattice-host")
+    # the weakest violated level names its anomaly class + a witness;
+    # stronger levels may be violated purely by inheritance (their
+    # own anomaly list stays empty); holding levels name nothing
+    for lvl, ok in TRUTH[kind].items():
+        d = word["levels"][lvl]
+        assert d["holds"] is ok
+        if lvl == WEAKEST[kind]:
+            assert d["anomalies"] and d.get("witness")
+        if ok:
+            assert not d["anomalies"]
+
+
+def test_write_skew_si_invalid_causal_valid():
+    """The acceptance fixture: concurrent-interval write skew is
+    causal-valid (no ww/wr cycle) but SI-invalid (G-SIb: an rw edge
+    closes a commit-order cycle)."""
+    res = _check(_block("write-skew"))
+    assert res["holds"]["causal"] is True
+    assert res["holds"]["si"] is False
+    assert "G-SIb" in res["levels"]["si"]["anomalies"]
+    assert res["weakest-violated"] == "si"
+
+
+def test_lost_update_invalid_at_every_level():
+    """The acceptance fixture: contradicting recovered ww orders (G0)
+    plus a time-travel dependency edge — no level of the lattice
+    survives it."""
+    res = _check(_block("lost-update"))
+    assert res["holds"] == {lvl: False for lvl in ALL_LEVELS}
+    assert res["valid"] is False
+    assert "G0" in res["levels"]["read-committed"]["anomalies"]
+
+
+def test_session_mr_scan_violation():
+    res = _check(_block("session-mr"))
+    assert res["holds"]["causal"] is True
+    assert res["holds"]["pl-2"] is False
+    assert res.get("session-violations")
+    assert res["session-violations"][0]["type"] == "monotonic-reads"
+
+
+def test_holds_monotone_and_valid_semantics():
+    """holds is monotone along the lattice by construction, and valid
+    means 'every REQUESTED level holds'."""
+    for kind in fixtures.TXN_LATTICE_KINDS:
+        holds = _check(_block(kind))["holds"]
+        seen_false = False
+        for lvl in ALL_LEVELS:          # weak -> strong
+            seen_false = seen_false or not holds[lvl]
+            if seen_false:
+                assert holds[lvl] is False
+    ws = _block("write-skew")
+    assert txn.check_history(ws, consistency="causal")["valid"] is True
+    assert txn.check_history(ws, consistency="si")["valid"] is False
+    both = txn.check_history(ws, consistency=["causal", "si"])
+    assert both["valid"] is False
+    assert both["consistency"] == ["causal", "si"]
+
+
+def test_level_canonicalization():
+    assert lattice.canon_level("snapshot-isolation") == "si"
+    assert lattice.canon_levels("serializable") == ("serializable",)
+    with pytest.raises(ValueError):
+        lattice.canon_level("strict-serializable-ish")
+
+
+def test_legacy_path_unchanged():
+    """consistency=None is the pre-lattice checker: same keys, no
+    holds map, serializable semantics."""
+    hist = _block("write-skew")
+    res = txn.check_history(hist)
+    assert "holds" not in res
+    assert res["valid"] is False            # write skew is G2
+    assert "G2" in res["anomalies"]
+
+
+# -- randomized differential ------------------------------------------------
+
+def test_lattice_fuzz_differential(monkeypatch):
+    """Random histories (half with an injected lattice fixture):
+    per-level holds + anomalies + witnesses identical between the
+    device ladder and the host reference, and the injected kind's
+    documented weakest level is reported."""
+    import random
+    rng = random.Random(1717)
+    for t in range(8):
+        hist = fixtures.gen_txn_history(
+            rng.randrange(10, 60), keys=rng.randrange(2, 4),
+            processes=4, seed=rng.randrange(1 << 30))
+        injected = None
+        if t % 2:
+            injected = rng.choice(fixtures.TXN_LATTICE_KINDS)
+            hist = hist + [o.with_(index=-1) for o in
+                           fixtures.txn_anomaly_block(injected)]
+        word = _check(hist)
+        host = _check(hist, body="host")
+        assert _sig(word) == _sig(host), (t, injected)
+        if injected is not None:
+            assert word["weakest-violated"] == WEAKEST[injected]
+
+
+# -- streaming session ------------------------------------------------------
+
+def test_incremental_session_matches_posthoc():
+    """A live txn session checked at every level: per-append holds
+    only ever lose levels (sticky, monotone), and the close verdict's
+    holds map equals the one-shot checker's — differential identity,
+    not resemblance."""
+    from jepsen_tpu.serve.session import Session
+    from jepsen_tpu.txn.ops import list_append_model
+    hist = h.index(
+        fixtures.gen_txn_history(24, keys=2, processes=3, seed=11)
+        + [o.with_(index=-1)
+           for o in fixtures.txn_anomaly_block("write-skew")])
+    sess = Session("lx", "t", "txn-list-append", list_append_model(),
+                   opts={"consistency": ALL_LEVELS})
+    violated = set()
+    for i in range(0, len(hist), 20):
+        r = sess.advance_block(hist[i:i + 20], seq=i // 20 + 1)
+        assert isinstance(r.get("holds"), dict)
+        now_violated = {lvl for lvl, v in r["holds"].items() if not v}
+        assert violated <= now_violated     # sticky per level
+        violated = now_violated
+    res = sess.close()
+    one_shot = facade.auto_check_txn(
+        list(hist), {"consistency": ALL_LEVELS})
+    assert res["valid"] is False and one_shot["valid"] is False
+    assert res["holds"] == one_shot["holds"] == TRUTH["write-skew"]
+    assert res.get("incremental-divergence") is None
+    assert "holds" in res["incremental"]
+
+
+def test_incremental_session_valid_stream_close():
+    from jepsen_tpu.serve.session import Session
+    from jepsen_tpu.txn.ops import list_append_model
+    hist = h.index(fixtures.gen_txn_history(30, keys=3, processes=4,
+                                            seed=23))
+    sess = Session("lv", "t", "txn-list-append", list_append_model(),
+                   opts={"consistency": ["causal", "si"]})
+    for i in range(0, len(hist), 25):
+        r = sess.advance_block(hist[i:i + 25], seq=i // 25 + 1)
+        assert r["valid-so-far"] is True
+        # holds always reports the FULL lattice (all levels ride the
+        # one ladder); valid is scoped to the requested set
+        assert r["holds"]["causal"] is True
+        assert r["holds"]["si"] is True
+    res = sess.close()
+    assert res["valid"] is True
+    assert res["holds"]["causal"] is True
+    assert res["holds"]["si"] is True
+    assert res.get("incremental-divergence") is None
+
+
+# -- serve protocol ---------------------------------------------------------
+
+def _http(url, method, path, payload=None):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url + path, data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_serve_consistency_option_end_to_end():
+    """One daemon, mixed-level one-shot checks + a live session: the
+    allow-listed consistency option reaches the checker, per-level
+    holds come back over HTTP on every append, and the close verdict
+    equals the one-shot result at the same levels."""
+    from jepsen_tpu import serve
+    hist = h.index([o.with_(index=-1) for o in
+                    fixtures.txn_anomaly_block("write-skew")])
+    ops_json = [op.to_dict() for op in hist]
+    d = serve.Daemon(port=0).start(dispatch=True)
+    url = f"http://127.0.0.1:{d.port}"
+    try:
+        # unknown level: THIS client's 400 at admission
+        code, r = _http(url, "POST", "/check",
+                        {"model": "txn-list-append", "history": ops_json,
+                         "options": {"consistency": "pl-nope"}})
+        assert code == 400
+        # one-shot at si (alias form) — invalid with holds
+        code, r = _http(url, "POST", "/check",
+                        {"model": "txn-list-append", "history": ops_json,
+                         "options":
+                             {"consistency": "snapshot-isolation"}})
+        assert code == 202
+        rid = r["id"]
+        deadline = time.monotonic() + 60
+        res = None
+        while time.monotonic() < deadline:
+            code, res = _http(url, "GET", f"/check/{rid}")
+            if res.get("status") in ("done", "timeout"):
+                break
+            time.sleep(0.05)
+        assert res and res["status"] == "done"
+        assert res["result"]["valid"] is False
+        assert res["result"]["holds"]["si"] is False
+        assert res["result"]["holds"]["causal"] is True
+        assert res["result"]["consistency"] == ["si"]
+        # live session at causal+si: per-append holds, close == one-shot
+        code, r = _http(url, "POST", "/session",
+                        {"model": "txn-list-append", "tenant": "lt",
+                         "options": {"consistency": ["causal", "si"]}})
+        assert code == 201
+        sid = r["session"]
+        holds_seen = []
+        for i in range(0, len(hist), 2):
+            code, r = _http(url, "POST", f"/session/{sid}/append",
+                            {"history": ops_json[i:i + 2],
+                             "seq": i // 2 + 1})
+            assert code == 200
+            holds_seen.append(r.get("holds"))
+        assert all(isinstance(x, dict) for x in holds_seen)
+        assert holds_seen[-1]["causal"] is True
+        assert holds_seen[-1]["si"] is False
+        code, r = _http(url, "POST", f"/session/{sid}/close", {})
+        assert code == 200
+        final = r["result"]
+        one_shot = facade.auto_check_txn(
+            list(hist), {"consistency": ["causal", "si"]})
+        assert final["valid"] is False
+        assert final["holds"] == one_shot["holds"]
+        assert final["holds"]["causal"] is True
+        assert final["holds"]["si"] is False
+        assert final.get("incremental-divergence") is None
+    finally:
+        d.shutdown()
+
+
+def test_consistency_in_coalescing_signature():
+    """Same level set -> one group; different level sets stay apart
+    (a causal tenant's request must never ride an si group's
+    dispatch)."""
+    from jepsen_tpu.serve import request as rq
+    from jepsen_tpu.txn.ops import list_append_model
+
+    def sig(opts):
+        r = rq.CheckRequest(
+            id=rq.new_request_id(), tenant="t",
+            model_name="txn-list-append", model=list_append_model(),
+            packed=None, history=[], opts=opts)
+        return r.model_sig
+    assert sig({"consistency": ["si"]}) == sig({"consistency": ["si"]})
+    assert sig({"consistency": ["si"]}) != sig({"consistency":
+                                                ["causal"]})
+    assert sig({"consistency": ["si"]}) != sig({})
+
+
+def test_lattice_obs_counters():
+    with obs.capture() as cap:
+        _check(_block("write-skew"))
+    assert cap.counters.get("txn.lattice.check", 0) >= 1
+    assert cap.counters.get("txn.lattice.violations", 0) >= 1
+    dev = (cap.counters.get("txn.lattice.word", 0)
+           + cap.counters.get("txn.lattice.device", 0)
+           + cap.counters.get("txn.lattice.host", 0))
+    assert dev >= 1

@@ -166,3 +166,126 @@ class TestBoundedSetModel:
             oracle = wgl_ref.check_packed(m.set_model(), packed)
             assert dense["valid"] == oracle["valid"], \
                 (trial, dense, oracle)
+
+
+class TestBoundedQueueModel:
+    """Int-coded bounded FIFO queue (ISSUE 17 satellite): one
+    base-(universe+1) int per state, memo-enumerable, so queue
+    workloads reach the dense-walk engines — differentially
+    equivalent to the tuple-state FIFOQueue on unique-enqueue
+    histories."""
+
+    def test_step_semantics(self):
+        q = m.bounded_queue(6)
+        q = q.step(invoke(0, "enqueue", 2))
+        q = q.step(invoke(0, "enqueue", 5))
+        assert tuple(q._items()) == (2, 5)
+        assert not q.step(invoke(0, "enqueue", 6))       # out of universe
+        assert not q.step(invoke(0, "enqueue", 2))       # pending dup
+        assert not q.step(invoke(0, "dequeue", 5))       # head is 2
+        q = q.step(invoke(0, "dequeue", 2))
+        assert tuple(q._items()) == (5,)
+        q = q.step(invoke(0, "dequeue", None))           # unchecked pop
+        assert tuple(q._items()) == ()
+        assert not q.step(invoke(0, "dequeue", None))    # empty
+
+    def test_memo_enumerable_exact_count(self):
+        # arrangements of <=6 distinct values: sum_k P(6, k) = 1957
+        ops = [invoke(0, "enqueue", v) for v in range(6)] + \
+            [invoke(0, "dequeue", None)]
+        mm = memo_ops(m.bounded_queue(6), ops)
+        assert mm.n_states == 1957
+
+    def test_differential_vs_fifo_queue(self):
+        """Random unique-enqueue histories (some corrupted): the
+        dense engine over BoundedQueueModel and the host oracle over
+        FIFOQueue must agree on linearizability."""
+        import random
+
+        from jepsen_tpu.checkers import reach, wgl_ref
+        from jepsen_tpu.history import pack
+        from jepsen_tpu.op import ok as op_ok
+
+        rng = random.Random(44)
+        for trial in range(8):
+            universe = 5
+            pending, nxt = [], 0
+            hist = []
+            p = 0
+            for _ in range(rng.randrange(4, 10)):
+                if nxt < universe and (not pending
+                                       or rng.random() < 0.6):
+                    hist.append(invoke(p, "enqueue", nxt))
+                    hist.append(op_ok(p, "enqueue", nxt))
+                    pending.append(nxt)
+                    nxt += 1
+                else:
+                    v = pending[0]
+                    if rng.random() < 0.3 and len(pending) > 1:
+                        v = pending[-1]                  # wrong head
+                    else:
+                        pending.pop(0)
+                    hist.append(invoke(p, "dequeue", None))
+                    hist.append(op_ok(p, "dequeue", v))
+                p += 1
+            hist = [o.with_(index=i) for i, o in enumerate(hist)]
+            packed = pack(hist)
+            dense = reach.check_packed(m.bounded_queue(universe),
+                                       packed)
+            oracle = wgl_ref.check_packed(m.fifo_queue(), packed)
+            assert dense["valid"] == oracle["valid"], \
+                (trial, dense, oracle)
+
+
+class TestBoundedMapModel:
+    """Int-coded bounded register map (ISSUE 17 satellite): one
+    base-(vals+1) digit per key — the memo-friendly MultiRegister."""
+
+    def test_step_semantics(self):
+        bm = m.bounded_map(3, 3)
+        bm = bm.step(invoke(0, "write", {0: 1, 2: 2}))
+        assert bm.step(invoke(0, "read", {0: 1, 2: 2}))
+        assert bm.step(invoke(0, "read", {1: None}))     # unset ok
+        assert not bm.step(invoke(0, "read", {0: 2}))
+        assert not bm.step(invoke(0, "write", {0: 3}))   # value cap
+        assert not bm.step(invoke(0, "write", {3: 0}))   # key cap
+
+    def test_memo_enumerable_exact_count(self):
+        ops = [invoke(0, "write", {k: v})
+               for k in range(3) for v in range(3)]
+        mm = memo_ops(m.bounded_map(3, 3), ops)
+        assert mm.n_states == 4 ** 3                     # (vals+1)^keys
+
+    def test_differential_vs_multi_register(self):
+        import random
+
+        from jepsen_tpu.checkers import reach, wgl_ref
+        from jepsen_tpu.history import pack
+        from jepsen_tpu.op import ok as op_ok
+
+        rng = random.Random(55)
+        for trial in range(8):
+            state = {}
+            hist = []
+            p = 0
+            for _ in range(rng.randrange(4, 10)):
+                k = rng.randrange(3)
+                if rng.random() < 0.5:
+                    v = rng.randrange(3)
+                    hist.append(invoke(p, "write", {k: v}))
+                    hist.append(op_ok(p, "write", {k: v}))
+                    state[k] = v
+                else:
+                    v = state.get(k)
+                    if rng.random() < 0.3:
+                        v = (0 if v is None
+                             else (v + 1) % 3)           # corrupt
+                    hist.append(invoke(p, "read", {k: None}))
+                    hist.append(op_ok(p, "read", {k: v}))
+                p += 1
+            hist = [o.with_(index=i) for i, o in enumerate(hist)]
+            packed = pack(hist)
+            dense = reach.check_packed(m.bounded_map(3, 3), packed)
+            oracle = wgl_ref.check_packed(m.multi_register(), packed)
+            assert dense["valid"] == oracle["valid"], \
+                (trial, dense, oracle)

@@ -123,3 +123,21 @@ def test_checkers_on_handmade_lossy_history():
     assert tq["valid"] is False                 # 'b' lost
     assert tq["lost-count"] == 1
     assert tq["duplicated-count"] == 1
+
+
+def test_queue_run_reaches_device_engine():
+    """The bounded-universe workload (ISSUE 17 satellite): the queue
+    suite composes a ``linear`` checker over the int-coded
+    bounded-queue model and the history lands on the dense device
+    engine — a recorded route, not the host-only queue invariants."""
+    t = queue.queue_test(mode="safe", time_limit=1.0, seed=7,
+                         with_nemesis=False, store=False, universe=6)
+    done = core.run(t)
+    res = done["results"]["results"]
+    assert res["queue"]["valid"] is True
+    assert res["linear"]["valid"] is True
+    assert res["linear"]["engine"] == "reach"
+    # the default stays the unbounded host-only composition
+    t2 = queue.queue_test(mode="safe", time_limit=0.5, seed=7,
+                          with_nemesis=False, store=False)
+    assert "linear" not in t2["checker"].checkers

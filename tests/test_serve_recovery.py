@@ -816,3 +816,69 @@ def test_prep_thread_fault_falls_back_exactly_once(monkeypatch):
     assert len(falls) == 1
     # the fault counter is bumped on the PRODUCER thread: global view
     assert _counter_delta(c0).get("serve.fault.prep") == 1
+
+
+# -- bad-payload corruption faults (ISSUE 17 satellite) -------------------
+
+def test_corrupt_lease_quarantined_never_trusted(tmp_path):
+    """The ``lease-write`` fault lands a schema-invalid lease (junk
+    expiry) its claimer believes it holds: every reader must detect
+    it, quarantine the file, and treat the entry as unclaimed — a
+    corrupt lease is NEVER trusted as live."""
+    j = jr.Journal(str(tmp_path / "j"))
+    faults.arm("lease-write")
+    j.claim("e-bad", replica="a", ttl_s=60.0)   # writer believes success
+    with obs.capture() as cap:
+        assert j.lease_live("e-bad") is None    # detected, not trusted
+    assert cap.counters.get("serve.lease.corrupt") == 1
+    assert any(d["stage"] == "serve-lease"
+               and d["event"] == "quarantine"
+               and d["cause"] == "bad-payload"
+               for d in cap.ledger)
+    # the bad payload is preserved beside the path, not deleted
+    assert os.path.exists(j._lease_path("e-bad") + ".corrupt")
+    assert not os.path.exists(j._lease_path("e-bad"))
+    # the entry is immediately stealable by a healthy sibling
+    assert j.claim("e-bad", replica="b", ttl_s=60.0)
+    assert j.lease_live("e-bad") == "b"
+
+
+def test_corrupt_journal_entry_replay_quarantines(tmp_path):
+    """The ``journal-write`` fault lands a syntactically-valid but
+    garbage-shaped entry while the writer reports success (a torn /
+    corrupted admission write): restart replay must detect it and
+    finish the id QUARANTINED with cause journal-corrupt — an
+    unreadable entry is a recorded verdict, never trusted input."""
+    from jepsen_tpu import serve
+    root = str(tmp_path)
+    d1 = serve.Daemon(port=0, store_root=root)
+    d1.start(dispatch=False)
+    url = f"http://127.0.0.1:{d1.port}"
+    faults.arm("journal-write")
+    code, resp = _post_json(url, _check_body(tenant="t-c"))
+    assert code == 202                          # admission believed it
+    rid = resp["id"]
+    with open(d1.journal._req_path(rid)) as f:
+        assert json.load(f) == {"corrupted": True}
+    d1.shutdown(drain_timeout=0.1)
+
+    d2 = serve.Daemon(port=0, store_root=root)
+    with obs.capture() as cap:
+        assert d2.replay_journal() == 0         # nothing trusted
+    falls = [f for f in cap.fallbacks()
+             if f["stage"] == "serve-journal"]
+    assert len(falls) == 1
+    term = d2.journal.lookup_terminal(rid)
+    assert term is not None
+    assert term["status"] == rq.QUARANTINED
+    assert term["result"]["cause"] == "journal-corrupt"
+    assert term["result"]["valid"] == "unknown"
+    # the quarantined verdict is servable over HTTP on the new daemon
+    d2.start(dispatch=False)
+    try:
+        code, st = _get_json(f"http://127.0.0.1:{d2.port}",
+                             f"/check/{rid}")
+        assert code in (200, 500)
+        assert st["status"] == rq.QUARANTINED
+    finally:
+        d2.shutdown(drain_timeout=0.1)

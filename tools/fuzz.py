@@ -403,6 +403,78 @@ def txn_trials(k: int, seed: int) -> list:
     return bad
 
 
+def lattice_trials(k: int, seed: int) -> list:
+    """Consistency-lattice differential: ``k`` random list-append
+    histories — roughly half with an injected lattice fixture block
+    of documented per-level ground truth
+    (``fixtures.TXN_LATTICE_KINDS``) — checked at EVERY lattice level
+    in one dispatch by the word-packed device closure, the f32
+    fallback body, and the host lattice reference. Per-level holds,
+    anomaly lists AND witnesses must be identical across all three
+    engines, and an injected block's documented weakest-violated
+    level must be reported. Returns mismatch dicts (empty = clean)."""
+    import random as _random
+
+    from jepsen_tpu import fixtures, txn
+    from jepsen_tpu.txn import lattice
+
+    weakest = {"write-skew": "si", "lost-update": "read-committed",
+               "long-fork": "si", "session-mr": "pl-2"}
+    levels = list(lattice.LEVELS)
+    rng = _random.Random(seed)
+    bad = []
+    t0 = time.monotonic()
+    for t in range(k):
+        s = rng.randrange(1 << 30)
+        n_txns = rng.randrange(10, 100)
+        keys = rng.randrange(2, 5)
+        h = fixtures.gen_txn_history(n_txns, keys=keys, processes=5,
+                                     seed=s)
+        injected = None
+        if rng.random() < 0.5:
+            injected = rng.choice(fixtures.TXN_LATTICE_KINDS)
+            h = h + [op.with_(index=-1) for op in
+                     fixtures.txn_anomaly_block(injected)]
+        dev = txn.check_history(h, consistency=levels)
+        os.environ["JEPSEN_TPU_NO_WORD_CLOSURE"] = "1"
+        try:
+            f32 = txn.check_history(h, consistency=levels)
+        finally:
+            os.environ.pop("JEPSEN_TPU_NO_WORD_CLOSURE", None)
+        host = txn.check_history(h, consistency=levels,
+                                 force_host=True)
+
+        def _sig(r):
+            per = r.get("levels") or {}
+            return (r.get("valid"), r.get("holds"),
+                    r.get("weakest-violated"),
+                    {lvl: ((per.get(lvl) or {}).get("anomalies"),
+                           (per.get(lvl) or {}).get("witness"))
+                     for lvl in levels})
+
+        ok = _sig(dev) == _sig(f32) == _sig(host)
+        if injected is not None:
+            ok = (ok and dev.get("weakest-violated")
+                  == weakest[injected])
+        if not ok:
+            entry = {"trial": t, "seed": s, "injected": injected,
+                     "device": {"holds": dev.get("holds"),
+                                "weakest": dev.get("weakest-violated"),
+                                "engine": dev.get("engine")},
+                     "f32": {"holds": f32.get("holds"),
+                             "weakest": f32.get("weakest-violated"),
+                             "engine": f32.get("engine")},
+                     "host": {"holds": host.get("holds"),
+                              "weakest": host.get("weakest-violated"),
+                              "engine": host.get("engine")}}
+            bad.append(entry)
+            print(f"LATTICE MISMATCH {entry}", file=sys.stderr)
+        if t % 25 == 24:
+            print(f"lattice {t + 1}/{k} ok "
+                  f"({time.monotonic() - t0:.0f}s)", flush=True)
+    return bad
+
+
 def word_trials(k: int, seed: int) -> list:
     """Word-packed post-hoc walk differential: ``k`` random register
     histories (the :func:`trial_params` mix — ragged concurrency,
@@ -481,6 +553,12 @@ def main() -> int:
                          "injected ww/wr/rw cycles; word-packed "
                          "closure vs f32 body vs host SCC every "
                          "trial)")
+    ap.add_argument("--lattice", type=int, default=0, metavar="K",
+                    help="additionally run K consistency-lattice "
+                         "trials (random list-append histories with "
+                         "injected lattice fixtures; per-level holds "
+                         "+ anomalies + witnesses, word closure vs "
+                         "f32 body vs host reference every trial)")
     ap.add_argument("--word", type=int, default=0, metavar="K",
                     help="additionally run K word-packed post-hoc "
                          "walk trials (forced word body vs dense "
@@ -508,6 +586,9 @@ def main() -> int:
         txn_bad: list = []
         if args.txn:
             txn_bad = txn_trials(args.txn, args.seed + 777)
+        lat_bad: list = []
+        if args.lattice:
+            lat_bad = lattice_trials(args.lattice, args.seed + 31337)
         word_bad: list = []
         if args.word:
             word_bad = word_trials(args.word, args.seed + 4242)
@@ -527,6 +608,8 @@ def main() -> int:
         "chunklock_mismatches": len(ckl_bad),
         "txn_trials": args.txn,
         "txn_mismatches": len(txn_bad),
+        "lattice_trials": args.lattice,
+        "lattice_mismatches": len(lat_bad),
         "word_trials": args.word,
         "word_mismatches": len(word_bad),
         "swallowed_checker_crashes": sum(
@@ -534,7 +617,8 @@ def main() -> int:
             if k.startswith("checker.swallowed.")),
         "obs": obs_counters,
         "elapsed_s": round(time.monotonic() - t0, 1)}))
-    return 1 if (mismatches or ckl_bad or txn_bad or word_bad) else 0
+    return 1 if (mismatches or ckl_bad or txn_bad or lat_bad
+                 or word_bad) else 0
 
 
 if __name__ == "__main__":

@@ -78,6 +78,62 @@ def build_pool(*, sizes, tenants: int, violation_frac: float,
     return pool
 
 
+def build_txn_pool(*, tenants: int, seed: int = 7,
+                   clean_sizes: Tuple[int, ...] = (12, 30)
+                   ) -> List[Dict]:
+    """``--mixed-consistency`` payload pool: transactional histories
+    with KNOWN per-level lattice ground truth, each submitted at one
+    requested consistency level (entries round-robin the level set,
+    so one coalescer carries mixed-level traffic — same-level
+    requests batch together, different-level sets form their own
+    groups). Every entry carries the full expected ``holds`` map: the
+    exit gate asserts the daemon's per-level verdicts, not just the
+    boolean."""
+    from jepsen_tpu import fixtures
+    from jepsen_tpu.txn import lattice
+
+    all_true = {lvl: True for lvl in lattice.LEVELS}
+    all_false = {lvl: False for lvl in lattice.LEVELS}
+    skew = {"read-committed": True, "causal": True, "pl-2": True,
+            "si": False, "serializable": False}
+    fixture_holds = {
+        "write-skew": skew,
+        "lost-update": all_false,
+        "long-fork": dict(skew),
+        "session-mr": {"read-committed": True, "causal": True,
+                       "pl-2": False, "si": False,
+                       "serializable": False},
+    }
+    variants: List[Tuple[str, List, Dict[str, bool]]] = []
+    for i, n in enumerate(clean_sizes):
+        variants.append(
+            ("clean", fixtures.gen_txn_history(n, seed=seed + i),
+             all_true))
+    for kind in fixtures.TXN_LATTICE_KINDS:
+        variants.append(
+            (kind, fixtures.txn_anomaly_block(kind), fixture_holds[kind]))
+    pool: List[Dict] = []
+    i = 0
+    for t in range(tenants):
+        for name, hist, holds in variants:
+            level = lattice.LEVELS[i % len(lattice.LEVELS)]
+            i += 1
+            pool.append({
+                "tenant": f"tenant-{t}",
+                "expect": holds[level],
+                "expect_holds": dict(holds),
+                "level": level, "kind": name,
+                "ops": len(hist),
+                "body": json.dumps({
+                    "model": "txn-list-append",
+                    "tenant": f"tenant-{t}",
+                    "options": {"consistency": [level]},
+                    "history": [op.to_dict() for op in hist],
+                }).encode(),
+            })
+    return pool
+
+
 def _post(url: str, body: bytes, path: str = "/check",
           timeout: float = 30.0) -> Tuple[int, Dict]:
     req = urllib.request.Request(
@@ -345,6 +401,9 @@ def run_load(url: str, *, rate: float, duration: float,
                "expect": payload["expect"], "t_submit": t_sched,
                "status": "lost", "latency_s": None, "match": None,
                "replica": url}
+        if payload.get("level"):
+            rec["level"] = payload["level"]
+            rec["kind"] = payload.get("kind")
         t0 = time.monotonic()
         code, resp = _post(url, payload["body"])
         if chaos_tolerant and code == -1:
@@ -395,6 +454,22 @@ def run_load(url: str, *, rate: float, duration: float,
                     rec["match"] = (valid == payload["expect"]
                                     if st["status"] == "done"
                                     else None)
+                    if (rec["match"] and
+                            payload.get("expect_holds") is not None):
+                        # mixed-consistency pool: the boolean is not
+                        # enough — the per-level holds map the daemon
+                        # computed at the requested level must match
+                        # the fixture's ground truth at that level
+                        holds = (st.get("result") or {}).get(
+                            "holds") or {}
+                        want = {lvl: payload["expect_holds"][lvl]
+                                for lvl in
+                                (st.get("result") or {}).get(
+                                    "consistency", [])}
+                        rec["match"] = (
+                            want != {} and
+                            all(holds.get(lvl) == v
+                                for lvl, v in want.items()))
                     if st["status"] == "done":
                         _saw_verdict()
                     # the daemon's stamped stage split (queue wait vs
@@ -441,6 +516,14 @@ def run_load(url: str, *, rate: float, duration: float,
                         if r["status"] == "timeout"),
         "verdict_mismatches": len(mismatches),
         "sustained_req_s": round(len(done) / wall, 2),
+        **({"per_level": {
+            lvl: {"completed": sum(1 for r in done
+                                   if r.get("level") == lvl),
+                  "mismatches": sum(1 for r in mismatches
+                                    if r.get("level") == lvl)}
+            for lvl in sorted({r["level"] for r in records
+                               if r.get("level")})}}
+           if any(r.get("level") for r in records) else {}),
         "p50_s": _percentile([r["latency_s"] for r in done], 0.50),
         "p99_s": _percentile([r["latency_s"] for r in done], 0.99),
         # admission-anchored quantiles: the window the daemon's e2e
@@ -877,11 +960,20 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
     tenants = int(opts.get("tenants") or 4)
     sizes = opts.get("sizes") or ([16, 32, 48] if quick
                                   else [32, 96, 200, 400])
-    pool = build_pool(sizes=sizes, tenants=tenants,
-                      violation_frac=float(
-                          opts.get("violation_frac", 0.25)),
-                      model=opts.get("model", "cas-register"),
-                      seed=int(opts.get("seed", 7)))
+    if opts.get("mixed_consistency"):
+        # transactional pool: every payload is a txn history with a
+        # known per-level lattice ground truth, submitted at one
+        # requested level (levels round-robin across the pool)
+        pool = build_txn_pool(tenants=tenants,
+                              seed=int(opts.get("seed", 7)),
+                              clean_sizes=((8, 16) if quick
+                                           else (12, 30, 60)))
+    else:
+        pool = build_pool(sizes=sizes, tenants=tenants,
+                          violation_frac=float(
+                              opts.get("violation_frac", 0.25)),
+                          model=opts.get("model", "cas-register"),
+                          seed=int(opts.get("seed", 7)))
     url = opts.get("url")
     replicas = [u for u in (opts.get("replicas") or []) if u]
     if replicas:
@@ -1088,6 +1180,13 @@ def main(argv=None) -> int:
                          "windows of half each)")
     ap.add_argument("--tenants", type=int, default=4)
     ap.add_argument("--model", default="cas-register")
+    ap.add_argument("--mixed-consistency", action="store_true",
+                    help="txn lattice pool: tenants submit "
+                         "transactional histories at DIFFERENT "
+                         "consistency levels through one coalescer; "
+                         "the exit gate asserts every per-level "
+                         "holds verdict against the fixture ground "
+                         "truth (overrides --model)")
     ap.add_argument("--violation-frac", type=float, default=0.25)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--store-root", default=None,
@@ -1139,6 +1238,7 @@ def main(argv=None) -> int:
         "rate": args.rate,
         "duration": args.duration, "tenants": args.tenants,
         "model": args.model, "violation_frac": args.violation_frac,
+        "mixed_consistency": args.mixed_consistency,
         "seed": args.seed, "store_root": args.store_root,
         "quick": args.quick, "warmup": not args.no_warmup,
         "chaos_tolerant": args.chaos_tolerant,
