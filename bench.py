@@ -105,6 +105,16 @@ def _lane_operands(model, packed):
     return rs, geom, host_args, int(P_np.nbytes)
 
 
+def _pallas_needs_accelerator() -> bool:
+    """True when compiled-Pallas probes cannot run on this backend
+    (CPU only supports interpret mode, whose timings would mislead)."""
+    try:
+        import jax
+        return jax.default_backend() == "cpu"
+    except Exception:                                   # noqa: BLE001
+        return True
+
+
 def kernel_probe(model, packed, prep=None, prep_s=None) -> dict:
     """Steady-state device-kernel probe for the single-history lane
     walk: returns kernel_s (dispatch-slope), transfer_s / bytes, the
@@ -1163,17 +1173,30 @@ def main() -> int:
                                              prep=probe_prep)
         except Exception as e:                          # noqa: BLE001
             out["transfer"] = {"error": f"{type(e).__name__}: {e}"}
-        try:
-            out["kernel"] = kernel_probe(model, packed, prep=probe_prep,
-                                         prep_s=probe_prep_s)
-        except Exception as e:                          # noqa: BLE001
-            # probe is diagnostics, not the metric: histories the lane
-            # kernel does not admit (or CPU-only runs) skip it
-            out["kernel"] = {"error": f"{type(e).__name__}: {e}"}
-        try:
-            out["chunklock"] = chunklock_probe(model, packed)
-        except Exception as e:                          # noqa: BLE001
-            out["chunklock"] = {"error": f"{type(e).__name__}: {e}"}
+        # the two Pallas probes measure compiled-kernel timings: on the
+        # CPU backend Pallas only runs in interpret mode, whose
+        # timings would be misleading — a structured skip, never a raw
+        # exception string in the bench JSON (BENCH r08 regression)
+        pallas_cpu = _pallas_needs_accelerator()
+        if pallas_cpu:
+            out["kernel"] = {"skipped": "pallas-needs-accelerator"}
+        else:
+            try:
+                out["kernel"] = kernel_probe(model, packed,
+                                             prep=probe_prep,
+                                             prep_s=probe_prep_s)
+            except Exception as e:                      # noqa: BLE001
+                # probe is diagnostics, not the metric: histories the
+                # lane kernel does not admit skip it
+                out["kernel"] = {"error": f"{type(e).__name__}: {e}"}
+        if pallas_cpu:
+            out["chunklock"] = {"skipped": "pallas-needs-accelerator"}
+        else:
+            try:
+                out["chunklock"] = chunklock_probe(model, packed)
+            except Exception as e:                      # noqa: BLE001
+                out["chunklock"] = {"error":
+                                    f"{type(e).__name__}: {e}"}
         try:
             # post-hoc kernel BODIES on this rung's history: the
             # word-packed walk vs the dense/pallas chain, winner

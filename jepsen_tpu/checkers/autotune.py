@@ -5,7 +5,9 @@ beats the dense einsum step ~33x on XLA:CPU", "H=32 beats H=64",
 "the packed closure routs f32 past Np=512" — because every process
 starts from heuristics. This module persists measured winners under
 ``<store-root>/.cache/autotune.json`` keyed by **(kind, backend,
-geometry bucket)** so route selection (``reach.check_packed``, the
+process count, geometry bucket)** — multi-host entries carry a
+``P<n>`` key segment so pod winners never steer single-host routing
+(and vice versa) — so route selection (``reach.check_packed``, the
 lockstep dispatch seams, ``txn.cycles``, the facade's group width)
 consults recorded winners BEFORE falling back to heuristics.
 
@@ -78,6 +80,31 @@ def backend() -> str:
         return "cpu"
 
 
+def _process_count() -> int:
+    """Live process count WITHOUT forcing backend bring-up (reads the
+    ``jax.distributed`` runtime state directly — ``jax.process_count``
+    would spin up the local client just to answer 1)."""
+    try:
+        from jax._src.distributed import global_state
+        return int(getattr(global_state, "num_processes", None) or 1)
+    # jtlint: ok fallback — no jax on the lint/tools path: single-process keying
+    except Exception:                                   # noqa: BLE001
+        return 1
+
+
+def _entry_key(kind: str, be: str, geom_key: str,
+               process_count: Optional[int]) -> str:
+    """Table key. Multi-host runs get a ``P<n>`` segment — a winner
+    measured on a 4-host mesh (DCN in the loop) must never steer
+    single-host routing, and vice versa. Single-process keys keep the
+    historical 3-part format, so existing tables stay live."""
+    pc = _process_count() if process_count is None else \
+        int(process_count)
+    if pc > 1:
+        return f"{kind}|{be}|P{pc}|{geom_key}"
+    return f"{kind}|{be}|{geom_key}"
+
+
 def _bucket_pow2(x: int) -> int:
     return 1 << max(0, (max(int(x), 1) - 1).bit_length())
 
@@ -127,10 +154,13 @@ def _load() -> Dict[str, Any]:
 
 
 def winner(kind: str, geom_key: str, *,
-           backend_name: Optional[str] = None) -> Optional[str]:
-    """The recorded winning body for ``(kind, backend, geom_key)``,
-    or None (miss / stale / disabled). ``kind`` is one of ``walk``,
-    ``lockstep``, ``closure``, ``group``."""
+           backend_name: Optional[str] = None,
+           process_count: Optional[int] = None) -> Optional[str]:
+    """The recorded winning body for ``(kind, backend,
+    process_count, geom_key)``, or None (miss / stale / disabled).
+    ``kind`` is one of ``walk``, ``lockstep``, ``closure``, ``group``.
+    ``process_count`` defaults to the live runtime's — lookups from a
+    pod consult only pod-measured winners."""
     if not enabled():
         return None
     data = _load()
@@ -141,7 +171,8 @@ def winner(kind: str, geom_key: str, *,
         obs.count("autotune.stale")
         return None
     be = backend_name if backend_name is not None else backend()
-    entry = (data.get("entries") or {}).get(f"{kind}|{be}|{geom_key}")
+    entry = (data.get("entries") or {}).get(
+        _entry_key(kind, be, geom_key, process_count))
     if entry is None:
         obs.count("autotune.miss")
         return None
@@ -156,7 +187,8 @@ def winner(kind: str, geom_key: str, *,
 def record(kind: str, geom_key: str, body: str, *,
            metric: Optional[float] = None,
            detail: Optional[Dict[str, Any]] = None,
-           backend_name: Optional[str] = None) -> Optional[str]:
+           backend_name: Optional[str] = None,
+           process_count: Optional[int] = None) -> Optional[str]:
     """Persist a measured winner (atomic read-modify-write). Returns
     the table path, or None when persistence/autotune is off. Callers
     pass the measured figure of merit in ``metric`` (higher = better;
@@ -177,7 +209,7 @@ def record(kind: str, geom_key: str, body: str, *,
             entry["metric"] = round(float(metric), 6)
         if detail:
             entry["detail"] = detail
-        entries[f"{kind}|{be}|{geom_key}"] = entry
+        entries[_entry_key(kind, be, geom_key, process_count)] = entry
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:

@@ -1,0 +1,165 @@
+"""Shared lockstep dispatch/collect core (ROADMAP item 1 down-payment).
+
+The keyed lockstep schedulers (:func:`reach._dispatch_lockstep_groups`
+and :func:`reach._dispatch_lockstep_stream`) and the chunk-lockstep
+engine (:func:`reach_chunklock.walk_chunklock`) each grew their own copy
+of the same pack→dispatch→fallback→recovery state machine. This module
+is that seam extracted ONCE, so engine variants — including the
+multi-host chunk-sharded path — parameterize it instead of adding a
+sixth choreography:
+
+- :class:`DispatchState` — round-robin device placement over the mesh,
+  pad-lane dedup accounting, the in-flight window and FIFO drain
+  (previously ``reach._LockstepDispatchState``; reach keeps an alias).
+- :func:`dispatch_packed` — the bit-packed 0/1 seed upload with the
+  dense retry and the exactly-one-fallback record (previously inlined
+  in ``walk_chunklock`` phase A; the multi-host phase-A dispatch is the
+  second caller).
+- :func:`rescue_once` — host-side exact recovery under the ordinary
+  contract: the ONE ``engine.fallback`` record lands only AFTER the
+  recovery succeeds, so a failure that persists through recovery
+  propagates unrecorded (it was not the degraded path's fault).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from jepsen_tpu import obs
+from jepsen_tpu.checkers import transfer
+
+# in-flight lockstep dispatch groups beyond the one being collected.
+# Depth 1 queues the NEXT group's device programs — paying its
+# marshalling, compile (on a fresh geometry), and transfer host time —
+# while the device walks the current group; the same K-deep dispatch
+# trick bench.py's kernel probe validates. Deeper pipelines pin more
+# operand sets in HBM for ~no added overlap (the host stage is the
+# bottleneck, and it is already fully hidden at depth 1).
+PIPE_DEPTH = 1
+
+
+class DispatchState:
+    """Shared per-dispatch bookkeeping of the synchronous and streaming
+    lockstep schedulers: round-robin device placement over the mesh,
+    pad-lane dedup accounting (mesh pad lanes are cross-group
+    duplicates — their returns must not count as real work), the
+    in-flight window, and the FIFO drain. ONE implementation so the two
+    schedulers' diag/obs output — which the stream-vs-sync differential
+    tests treat as equivalent — cannot drift."""
+
+    __slots__ = ("devs", "n_dev", "depth", "dead", "seen", "dev_groups",
+                 "inflight", "inflight_hwm", "fetch_s",
+                 "fetch_degraded")
+
+    def __init__(self, devices: Optional[Sequence], dead: np.ndarray):
+        self.devs = list(devices) if devices else None
+        self.n_dev = len(self.devs) if self.devs else 1
+        # one walking plus one queued group per device; FIFO collection
+        # drains the oldest shard while the rest keep walking
+        self.depth = self.n_dev * (PIPE_DEPTH + 1) - 1
+        self.dead = dead
+        self.seen: set = set()
+        self.dev_groups = [0] * self.n_dev
+        self.inflight: list = []
+        self.inflight_hwm = 0
+        self.fetch_s = 0.0
+        self.fetch_degraded = False
+
+    def place(self, gi: int, g, prep) -> Tuple[int, Dict[str, Any]]:
+        """Pin group ``gi`` to its round-robin device; returns the
+        device index and the dispatch span args."""
+        di = gi % self.n_dev
+        sp: Dict[str, Any] = {"lanes": len(g)}
+        if self.devs:
+            prep.device = self.devs[di]
+            self.dev_groups[di] += 1
+            sp["device"] = di
+        return di, sp
+
+    def admit(self, g, fl, di: int) -> dict:
+        """Group diag (with pad-lane dedup) + in-flight append."""
+        from jepsen_tpu.checkers import reach_batch
+
+        gd = reach_batch.group_diag(fl.geom, fl.R_lens)
+        x = fl.dsegs.get("xfer")
+        if x is not None:
+            # wire bytes this group actually moved vs the blanket
+            # int32/f32 format — summed by _lockstep_accounting
+            gd["put_bytes"], gd["put_bytes_unpacked"] = x
+        if self.devs:
+            gd["device"] = di
+            dup = sum(int(fl.R_lens[j]) for j, k in enumerate(g)
+                      if k in self.seen)
+            self.seen.update(g)
+            if dup:
+                gd["pad_lane_returns"] = dup
+        self.inflight.append((g, fl, di))
+        self.inflight_hwm = max(self.inflight_hwm, len(self.inflight))
+        return gd
+
+    def drain(self, limit: int) -> None:
+        from jepsen_tpu.checkers import reach_batch
+
+        while len(self.inflight) > limit:
+            g0, fl0, di0 = self.inflight.pop(0)
+            t0 = _time.monotonic()
+            sp: Dict[str, Any] = {"lanes": len(g0)}
+            if self.devs:
+                sp["device"] = di0
+            with obs.span("lockstep.collect", **sp):
+                self.dead[np.asarray(g0, np.int64)] = \
+                    reach_batch.collect_returns_batch(fl0)
+            if getattr(fl0, "degraded", False):
+                self.fetch_degraded = True
+            self.fetch_s += _time.monotonic() - t0
+
+    def mesh_info(self, pad_lanes: int) -> Optional[dict]:
+        if not self.devs:
+            return None
+        return {"n_devices": self.n_dev,
+                "per_device_groups": self.dev_groups,
+                "inflight_max": self.inflight_hwm,
+                "pad_lanes": pad_lanes}
+
+
+def dispatch_packed(run, dense_args: Sequence[np.ndarray],
+                    seed: np.ndarray, base_bytes: int, *,
+                    stage: str = "packed-xfer"):
+    """Dispatch ``run(*dense_args, seed_wire)`` with the exactly-0/1
+    ``seed`` operand bit-packed on the wire (8 per byte, unpacked on
+    device) when the transfer diet allows. A packed dispatch failure
+    retries ONCE with the dense seed and records exactly one
+    ``engine.fallback`` — AFTER the dense retry succeeds, because a
+    failure that persists dense (e.g. Pallas unsupported on this
+    backend) was not the packed wire's fault and must propagate
+    unrecorded. ``base_bytes`` is the blanket int32/f32 wire baseline
+    for the put accounting."""
+    import jax.numpy as jnp
+
+    dense_bytes = sum(int(a.nbytes) for a in dense_args)
+    if transfer.packed_enabled():
+        seed_w = transfer.pack_bool(seed)
+        transfer.count_put(dense_bytes + seed_w.nbytes, base_bytes)
+        try:
+            return run(*dense_args, seed_w)
+        except Exception as e:                          # noqa: BLE001
+            # the dense retry re-crosses the whole operand set
+            transfer.count_put(dense_bytes + seed.nbytes, 0)
+            out = run(*dense_args, jnp.asarray(seed))
+            obs.engine_fallback(stage, type(e).__name__)
+            return out
+    transfer.count_put(dense_bytes + seed.nbytes, base_bytes)
+    return run(*dense_args, jnp.asarray(seed))
+
+
+def rescue_once(stage: str, cause: str, fn, **fields):
+    """Run host-side exact recovery ``fn()`` under the exactly-one-
+    fallback contract: the single ``engine.fallback(stage, cause)``
+    record lands only once ``fn`` has succeeded. Shared by the
+    multi-host gather rescue and any future engine variant's recovery
+    ladder, so the contract is written (and tested) once."""
+    out = fn()
+    obs.engine_fallback(stage, cause, **fields)
+    return out

@@ -60,6 +60,7 @@ import numpy as np
 
 from jepsen_tpu import history as h
 from jepsen_tpu import obs
+from jepsen_tpu.checkers import dispatch_core
 from jepsen_tpu.checkers import events as ev
 from jepsen_tpu.checkers import transfer
 from jepsen_tpu.models import Model
@@ -2154,14 +2155,10 @@ def _union_results_parts(engine: str, model: Model,
     return results  # type: ignore[return-value]
 
 
-# in-flight lockstep dispatch groups beyond the one being collected.
-# Depth 1 queues the NEXT group's device programs — paying its
-# marshalling, compile (on a fresh geometry), and transfer host time —
-# while the device walks the current group; the same K-deep dispatch
-# trick bench.py's kernel probe validates. Deeper pipelines pin more
-# operand sets in HBM for ~no added overlap (the host stage is the
-# bottleneck, and it is already fully hidden at depth 1).
-_LOCKSTEP_PIPE_DEPTH = 1
+# in-flight lockstep dispatch groups beyond the one being collected —
+# see dispatch_core.PIPE_DEPTH (the extracted dispatch/collect core
+# both lockstep engines share).
+_LOCKSTEP_PIPE_DEPTH = dispatch_core.PIPE_DEPTH
 
 
 def _lockstep_accounting(gdiags: List[dict], prep_s: float,
@@ -2245,88 +2242,11 @@ def _lockstep_accounting(gdiags: List[dict], prep_s: float,
             diag["mesh"] = dict(mesh)
 
 
-class _LockstepDispatchState:
-    """Shared per-dispatch bookkeeping of the synchronous and streaming
-    lockstep schedulers: round-robin device placement over the mesh,
-    pad-lane dedup accounting (mesh pad lanes are cross-group
-    duplicates — their returns must not count as real work), the
-    in-flight window, and the FIFO drain. ONE implementation so the two
-    schedulers' diag/obs output — which the stream-vs-sync differential
-    tests treat as equivalent — cannot drift."""
-
-    __slots__ = ("devs", "n_dev", "depth", "dead", "seen", "dev_groups",
-                 "inflight", "inflight_hwm", "fetch_s",
-                 "fetch_degraded")
-
-    def __init__(self, devices: Optional[Sequence], dead: np.ndarray):
-        self.devs = list(devices) if devices else None
-        self.n_dev = len(self.devs) if self.devs else 1
-        # one walking plus one queued group per device; FIFO collection
-        # drains the oldest shard while the rest keep walking
-        self.depth = self.n_dev * (_LOCKSTEP_PIPE_DEPTH + 1) - 1
-        self.dead = dead
-        self.seen: set = set()
-        self.dev_groups = [0] * self.n_dev
-        self.inflight: List = []
-        self.inflight_hwm = 0
-        self.fetch_s = 0.0
-        self.fetch_degraded = False
-
-    def place(self, gi: int, g, prep) -> Tuple[int, Dict[str, Any]]:
-        """Pin group ``gi`` to its round-robin device; returns the
-        device index and the dispatch span args."""
-        di = gi % self.n_dev
-        sp: Dict[str, Any] = {"lanes": len(g)}
-        if self.devs:
-            prep.device = self.devs[di]
-            self.dev_groups[di] += 1
-            sp["device"] = di
-        return di, sp
-
-    def admit(self, g, fl, di: int) -> dict:
-        """Group diag (with pad-lane dedup) + in-flight append."""
-        from jepsen_tpu.checkers import reach_batch
-
-        gd = reach_batch.group_diag(fl.geom, fl.R_lens)
-        x = fl.dsegs.get("xfer")
-        if x is not None:
-            # wire bytes this group actually moved vs the blanket
-            # int32/f32 format — summed by _lockstep_accounting
-            gd["put_bytes"], gd["put_bytes_unpacked"] = x
-        if self.devs:
-            gd["device"] = di
-            dup = sum(int(fl.R_lens[j]) for j, k in enumerate(g)
-                      if k in self.seen)
-            self.seen.update(g)
-            if dup:
-                gd["pad_lane_returns"] = dup
-        self.inflight.append((g, fl, di))
-        self.inflight_hwm = max(self.inflight_hwm, len(self.inflight))
-        return gd
-
-    def drain(self, limit: int) -> None:
-        from jepsen_tpu.checkers import reach_batch
-
-        while len(self.inflight) > limit:
-            g0, fl0, di0 = self.inflight.pop(0)
-            t0 = _time.monotonic()
-            sp: Dict[str, Any] = {"lanes": len(g0)}
-            if self.devs:
-                sp["device"] = di0
-            with obs.span("lockstep.collect", **sp):
-                self.dead[np.asarray(g0, np.int64)] = \
-                    reach_batch.collect_returns_batch(fl0)
-            if getattr(fl0, "degraded", False):
-                self.fetch_degraded = True
-            self.fetch_s += _time.monotonic() - t0
-
-    def mesh_info(self, pad_lanes: int) -> Optional[dict]:
-        if not self.devs:
-            return None
-        return {"n_devices": self.n_dev,
-                "per_device_groups": self.dev_groups,
-                "inflight_max": self.inflight_hwm,
-                "pad_lanes": pad_lanes}
+# the shared dispatch/collect state machine now lives in
+# dispatch_core (both lockstep engines and the multi-host chunk path
+# parameterize ONE implementation); the alias keeps this module's
+# scheduler code and its historical name readable
+_LockstepDispatchState = dispatch_core.DispatchState
 
 
 def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,

@@ -57,6 +57,7 @@ reproduced: knossos.wgl verdict semantics (SURVEY.md §2.2, §3.2).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import time as _time
@@ -65,6 +66,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from jepsen_tpu import obs
+from jepsen_tpu.checkers import dispatch_core
 from jepsen_tpu.checkers import transfer
 from jepsen_tpu.checkers.reach_lane import _BLOCK, _FAST_PASSES, _idx_dtype
 
@@ -266,18 +268,174 @@ def _localize(P: np.ndarray, ret_slot: np.ndarray,
     return -1, np.asarray(r_final).T.reshape(M * S)
 
 
+def _host_fold(P: np.ndarray, ret_slot: np.ndarray,
+               slot_ops: np.ndarray, M: int, seeds_np: np.ndarray,
+               images_np: np.ndarray, v: np.ndarray, start: int,
+               C: int, per: int, interpret: bool,
+               diag: Dict[str, Any]) -> int:
+    """Host-side exact fold over the per-chunk seed/image summaries —
+    the ONE recovery/combination loop (ISSUE 19) shared by the
+    single-process inexact rescue and the multi-host gathered fold.
+    Boolean algebra only, so it is bit-identical to the on-device
+    :func:`_fold_call` wherever that fold is exact; chunks whose
+    selected union seeds escape the exact boundary set are re-walked
+    sequentially (:func:`_localize`). Returns the global dead return
+    index, -1 = linearizable."""
+    for c in range(start, C):
+        active = seeds_np[c] @ v > 0             # [e_pad] selected
+        sel = active @ seeds_np[c] > 0
+        if not (sel & ~v).any():
+            vn = active @ images_np[c] > 0
+        else:
+            diag["rescues"] += 1
+            dead, vn = _localize(P, ret_slot, slot_ops, M, v, c, per,
+                                 interpret)
+            if dead >= 0:
+                return dead
+        if not vn.any():
+            dead, _ = _localize(P, ret_slot, slot_ops, M, v, c, per,
+                                interpret)
+            if dead < 0:
+                raise ChunklockUnfit(
+                    "fold death not confirmed by re-walk")
+            return dead
+        v = vn
+    return -1
+
+
+def _walk_dist(shard, P: np.ndarray, ret_slot: np.ndarray,
+               slot_ops: np.ndarray, M: int, C: int, e_pad: int,
+               suffix: int, per: int, interpret: bool, phase_b,
+               seeds_d, cnt_d) -> Tuple[int, Dict[str, Any]]:
+    """Multi-host tail of :func:`walk_chunklock`: phase B runs only on
+    this process's contiguous shard of the chunk axis, the per-chunk
+    images are thresholded and word-packed (PR-12 packing — 32x
+    smaller than dense f32 before the packed-wire framing even
+    applies), and ONE ``all_gather`` along the DCN axis assembles the
+    full summary set; the fold then runs host-side through the same
+    :func:`_host_fold` loop as the single-process rescue. A peer that
+    dies mid-gather costs availability of its summaries, not
+    correctness: the operand slices are replicated on every host, so
+    the missing chunks' images are re-derived locally and exactly one
+    ``engine.fallback("dist-gather")`` is recorded after the rescue
+    succeeds."""
+    from jepsen_tpu.checkers import reach_word
+
+    S = int(P.shape[1])
+    MS = M * S
+    Pn = int(shard.process_count)
+    lo, hi = shard.chunk_range(C)
+    perc = -(-C // Pn)
+
+    def images_of(fb_dev, n_rows: int) -> np.ndarray:
+        fb = np.asarray(fb_dev) > 0.5
+        return fb.reshape(e_pad, M, n_rows, S).transpose(2, 0, 1, 3) \
+            .reshape(n_rows, e_pad, MS)
+
+    NW = (MS + 31) // 32
+    diag: Dict[str, Any] = {"chunks": C, "rescues": 0}
+    # pod driver (rank 0 daemon): ship the walk operands FIRST so the
+    # compute peers enter the same walk — their phase B overlaps this
+    # rank's — and the gather rendezvouses; the driver lock spans
+    # send→gather because collectives match by issue order, so two
+    # concurrent checks interleaving theirs would cross-wire every
+    # rank. SPMD callers (tests, dryrun — every rank already runs this
+    # walk) skip the send. A torn pod fails the send or the gather,
+    # and the SAME exact-rescue below recovers both.
+    from jepsen_tpu.parallel import distributed
+    driver = (distributed.driver_mode() and shard.process_index == 0)
+    lock = distributed.driver_lock() if driver else \
+        contextlib.nullcontext()
+    local = None
+    t_g = _time.monotonic()
+    try:
+        with lock:
+            if driver:
+                distributed.send_work(
+                    {"op": "chunklock", "P": P, "ret_slot": ret_slot,
+                     "slot_ops": slot_ops, "M": M, "n_chunks": C,
+                     "e_pad": e_pad, "suffix": suffix,
+                     "interpret": int(interpret)},
+                    timeout_s=distributed.gather_timeout_s())
+            t_b = _time.monotonic()
+            local = images_of(phase_b(lo, hi), hi - lo) if hi > lo \
+                else np.zeros((0, e_pad, MS), bool)
+            obs.count("dist.device_s", _time.monotonic() - t_b)
+            words = np.zeros((perc * e_pad, NW), np.uint32)
+            if hi > lo:                 # pad ranks to a common shape
+                words[:(hi - lo) * e_pad] = reach_word.pack_rows(
+                    local.reshape((hi - lo) * e_pad, MS))
+            gathered = shard.gather(words)      # [Pn, perc*e_pad, NW]
+        wall = _time.monotonic() - t_g
+        actual = int(gathered.nbytes)
+        baseline = gathered.shape[0] * gathered.shape[1] * MS * 4
+        transfer.count_collective(actual, baseline)
+        obs.count("dist.gather")
+        obs.count("dist.dcn_wall_s", wall)
+        bits = reach_word.unpack_rows(
+            gathered.reshape(Pn * perc * e_pad, -1), MS)
+        images_np = bits.reshape(Pn * perc, e_pad, MS)[:C]
+        rescued = 0
+    except Exception as e:                              # noqa: BLE001
+        # exact-rescue: every host holds the FULL operand slices, so
+        # the missing chunks' images are re-derived locally; the one
+        # fallback record lands only after the re-derivation succeeds
+        def rederive() -> np.ndarray:
+            full = np.zeros((C, e_pad, MS), bool)
+            ranges = [(0, C)]
+            if local is not None:
+                full[lo:hi] = local
+                ranges = [(0, lo), (hi, C)]
+            for rlo, rhi in ranges:
+                if rhi > rlo:
+                    full[rlo:rhi] = images_of(phase_b(rlo, rhi),
+                                              rhi - rlo)
+            return full
+
+        images_np = dispatch_core.rescue_once(
+            "dist-gather", type(e).__name__, rederive)
+        rescued = C - (hi - lo)
+        obs.count("dist.rescue_chunks", rescued)
+    seeds_np = np.asarray(seeds_d) > 0.5         # [C, e_pad, MS]
+    counts = np.asarray(cnt_d).astype(np.int64)
+    v0 = np.zeros(MS, bool)
+    v0[0] = True
+    dead = _host_fold(P, ret_slot, slot_ops, M, seeds_np, images_np,
+                      v0, 0, C, per, interpret, diag)
+    obs.gauge("dist.processes", Pn)
+    diag["basis-max"] = int(counts.max(initial=0))
+    diag["dist"] = {"processes": Pn, "local_chunks": [int(lo), int(hi)],
+                    "rescued_chunks": rescued}
+    if not rescued:
+        diag["dist"].update({
+            "dcn_bytes": actual, "dcn_bytes_unpacked": baseline,
+            "dcn_ratio": round(baseline / max(actual, 1), 2),
+            "gather_wall_s": round(wall, 6)})
+    return dead, diag
+
+
 def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
                    slot_ops: np.ndarray, M: int, *,
                    n_chunks: Optional[int] = None,
                    e_pad: Optional[int] = None,
                    suffix: Optional[int] = None,
-                   interpret: bool = False
+                   interpret: bool = False,
+                   shard: Optional[Any] = None
                    ) -> Tuple[int, Dict[str, Any]]:
     """Chunk-lockstep returns walk over one history. Returns
     ``(dead, diag)``: ``dead`` is the first return index at which the
     exact config set emptied (-1 = linearizable), bit-identical to
     :func:`reach_lane.walk_returns`; ``diag`` carries chunk geometry
-    and rescue counts."""
+    and rescue counts.
+
+    ``shard`` (a :class:`jepsen_tpu.parallel.distributed.ChunkShard`,
+    default auto-detected from the ``jax.distributed`` runtime) engages
+    the multi-host variant: phases A/glue are replicated (cheap and
+    deterministic, so every process derives identical seeds), phase B
+    walks only the local chunk range, and the word-packed summaries
+    cross DCN once (:func:`_walk_dist`). Pass ``shard=False`` to force
+    the single-process path inside a distributed runtime (the
+    differential tests' reference)."""
     import jax.numpy as jnp
 
     from jepsen_tpu.checkers import reach_batch
@@ -295,6 +453,12 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
     C = max(2, min(C, Rn))
     if not fits(S, M, W, C, e_pad):
         raise ChunklockUnfit("geometry exceeds VMEM envelope")
+    if shard is None:
+        if dist_enabled():
+            from jepsen_tpu.parallel import distributed
+            shard = distributed.ChunkShard.detect()
+    elif shard is False:
+        shard = None
     per = -(-Rn // C)
     blk = min(32, _BLOCK) if interpret else \
         min(_BLOCK, reach_batch._adaptive_block(C, W))
@@ -317,41 +481,42 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
     run_a = reach_batch._batch_call(  # soundness, not an under-approx
         b_a, W, M, S, C, O1, L_pad, n_pass, interpret, cdt)
     # phase-A seeds are 0/1 exactly: they cross the wire bit-packed
-    # (8 per byte, unpacked on device by _batch_call.run); a packed
-    # dispatch failure records one fallback and retries dense
+    # (8 per byte, unpacked on device by _batch_call.run) through the
+    # shared dispatch core — a packed dispatch failure records one
+    # fallback and retries dense
     a_base = (ops_a.size * 4 + rs_a.size * 4 + P32.nbytes
               + r0_a.nbytes)
-    if transfer.packed_enabled():
-        seed_a = transfer.pack_bool(r0_a)
-        transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
-                           + seed_a.nbytes, a_base)
-        try:
-            _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
-                                   seed_a)
-        except Exception as e:                          # noqa: BLE001
-            # the dense retry re-crosses the whole phase-A operand set;
-            # the ONE fallback record lands only if it succeeds — a
-            # failure that persists dense (e.g. Pallas unsupported on
-            # this backend) was not the packed wire's fault
-            transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
-                               + r0_a.nbytes, 0)
-            _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
-                                   jnp.asarray(r0_a))
-            obs.engine_fallback("packed-xfer", type(e).__name__)
-    else:
-        transfer.count_put(ops_a.nbytes + rs_a.nbytes + P32.nbytes
-                           + r0_a.nbytes, a_base)
-        _ck_a, final_a = run_a(ops_a.reshape(-1), rs_a, P32,
-                               jnp.asarray(r0_a))
+    _ck_a, final_a = dispatch_core.dispatch_packed(
+        run_a, (ops_a.reshape(-1), rs_a, P32), r0_a, a_base)
     seeds_d, r0_b, cnt_d = _glue_call(C, M, S, e_pad)(final_a)
-    # phase B through the batch engine's segmented put+dispatch
-    # pipeline: segment i+1's operand upload streams while the device
-    # walks segment i (the dominant wire cost at the 10M rung), still
-    # with no intermediate fetch
-    geom_b = (blk, W, e_pad * M, S, C, O1, per_pad)
-    _cks, final_b = reach_batch._pipe_walk_b(
-        (ops_b.reshape(-1), rs_b, P32, r0_b), geom_b, n_pass,
-        interpret, {})
+
+    def phase_b(lo: int, hi: int):
+        """Phase B over chunks [lo, hi) — the ONE lockstep dispatch
+        the single-process fold and every shard of the multi-host
+        path run, through the batch engine's segmented put+dispatch
+        pipeline (segment i+1's operand upload streams while the
+        device walks segment i, no intermediate fetch)."""
+        Cl = hi - lo
+        if lo == 0 and hi == C:
+            args_b = (ops_b.reshape(-1), rs_b, P32, r0_b)
+        else:
+            r0_np = np.ascontiguousarray(
+                np.asarray(r0_b).reshape(e_pad * M, C, S)[:, lo:hi]
+                .reshape(e_pad * M, Cl * S))
+            args_b = (np.ascontiguousarray(
+                          ops_b[:, lo:hi]).reshape(-1),
+                      np.ascontiguousarray(rs_b[:, lo:hi]), P32,
+                      r0_np)
+        geom_b = (blk, W, e_pad * M, S, Cl, O1, per_pad)
+        _cks, final_b = reach_batch._pipe_walk_b(
+            args_b, geom_b, n_pass, interpret, {})
+        return final_b
+
+    if shard is not None and getattr(shard, "process_count", 1) > 1:
+        return _walk_dist(shard, P, ret_slot, slot_ops, M, C, e_pad,
+                          suffix, per, interpret, phase_b, seeds_d,
+                          cnt_d)
+    final_b = phase_b(0, C)
     packed = _fold_call(C, M, S, e_pad)(final_b, seeds_d, cnt_d)
     out = np.asarray(packed)                     # the ONE round trip
     MS = M * S
@@ -382,27 +547,9 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
     images_np = fb.reshape(e_pad, M, C, S).transpose(2, 0, 1, 3) \
         .reshape(C, e_pad, MS)
     start = int(np.nonzero(inexact)[0][0])
-    v = all_v[start]
-    for c in range(start, C):
-        active = seeds_np[c] @ v > 0             # [e_pad] selected
-        sel = active @ seeds_np[c] > 0
-        if not (sel & ~v).any():
-            vn = active @ images_np[c] > 0
-        else:
-            diag["rescues"] += 1
-            dead, vn = _localize(P, ret_slot, slot_ops, M, v, c, per,
-                                 interpret)
-            if dead >= 0:
-                return dead, diag
-        if not vn.any():
-            dead, _ = _localize(P, ret_slot, slot_ops, M, v, c, per,
-                                interpret)
-            if dead < 0:
-                raise ChunklockUnfit(
-                    "fold death not confirmed by re-walk")
-            return dead, diag
-        v = vn
-    return -1, diag
+    dead = _host_fold(P, ret_slot, slot_ops, M, seeds_np, images_np,
+                      all_v[start], start, C, per, interpret, diag)
+    return dead, diag
 
 
 def check_packed(model, packed, *, max_states: int = 100_000,
@@ -410,11 +557,15 @@ def check_packed(model, packed, *, max_states: int = 100_000,
                  n_chunks: Optional[int] = None,
                  e_pad: Optional[int] = None,
                  suffix: Optional[int] = None,
-                 interpret: bool = False) -> Dict[str, Any]:
+                 interpret: bool = False,
+                 process_shard: Optional[Any] = None) -> Dict[str, Any]:
     """Standalone entry (the ``chunklock`` algorithm name): prep +
     chunk-lockstep walk + knossos-style verdict/witness. Raises
     :class:`ChunklockUnfit` / :class:`reach.DenseOverflow` etc. when
-    the history is outside the envelope — callers fall back."""
+    the history is outside the envelope — callers fall back.
+    ``process_shard`` forwards to :func:`walk_chunklock`'s ``shard``
+    (None = auto-detect the multi-host runtime, False = force
+    single-process, or an injected ChunkShard)."""
     from jepsen_tpu.checkers import events as ev
     from jepsen_tpu.checkers import reach
 
@@ -434,7 +585,8 @@ def check_packed(model, packed, *, max_states: int = 100_000,
     P_np = reach._build_P(memo, S_pad)
     dead, diag = walk_chunklock(
         P_np, rs.ret_slot, rs.slot_ops, M, n_chunks=n_chunks,
-        e_pad=e_pad, suffix=suffix, interpret=interpret)
+        e_pad=e_pad, suffix=suffix, interpret=interpret,
+        shard=process_shard)
     elapsed = _time.monotonic() - t0
     if dead < 0:
         out = reach._result_valid("reach-chunklock", stream, memo,
@@ -451,3 +603,9 @@ def check_packed(model, packed, *, max_states: int = 100_000,
 
 def enabled() -> bool:
     return not os.environ.get("JEPSEN_TPU_NO_CHUNKLOCK")
+
+
+def dist_enabled() -> bool:
+    """Gate on the multi-host chunk-axis sharding (auto-detected from
+    the ``jax.distributed`` runtime when on)."""
+    return not os.environ.get("JEPSEN_TPU_NO_DIST_CHUNKLOCK")

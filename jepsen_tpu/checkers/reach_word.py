@@ -99,6 +99,32 @@ def unpack_words(words: np.ndarray, M: int) -> np.ndarray:
     return b[:, :M].astype(bool)
 
 
+def pack_rows(R: np.ndarray) -> np.ndarray:
+    """bool [rows, N] -> uint32 [rows, ceil(N/32)], any N: the general
+    row packing behind the multi-host DCN payload (per-chunk summary
+    bits cross hosts 32x denser than dense f32). Same little-endian
+    bit layout as :func:`pack_words`, which it generalizes past
+    power-of-two mask widths."""
+    rows, N = R.shape
+    pad = (-N) % 32
+    if pad:
+        R = np.concatenate([R, np.zeros((rows, pad), bool)], axis=1)
+    packed = np.packbits(np.ascontiguousarray(R, np.uint8),
+                         axis=1, bitorder="little")
+    return packed.view(np.uint32).reshape(rows, (N + pad) // 32)
+
+
+def unpack_rows(words: np.ndarray, N: int) -> np.ndarray:
+    """uint32 [rows, NW] -> bool [rows, N] (inverse of
+    :func:`pack_rows`)."""
+    rows, NW = words.shape
+    b = np.unpackbits(
+        np.ascontiguousarray(words).view(np.uint8)
+        .reshape(rows, NW * 4),
+        axis=1, bitorder="little")
+    return b[:, :N].astype(bool)
+
+
 def table_from_P(P: np.ndarray) -> np.ndarray:
     """Recover the flat transition table the word body gathers from a
     per-op transition-matrix tensor ``P[o, s, t]`` (one-hot rows,

@@ -169,6 +169,18 @@ def count_put(actual: int, baseline: int) -> None:
     obs.count("transfer.unpacked_bytes", int(baseline))
 
 
+def count_collective(actual: int, baseline: int) -> None:
+    """Account one cross-host collective payload (the transfer diet
+    applied to DCN): ``actual`` word-packed bytes the ``all_gather``
+    moved vs the ``baseline`` dense f32 equivalent of the same
+    summaries — the ≥32x evidence MULTICHIP reports and the dist-smoke
+    CI job asserts."""
+    obs.count("transfer.collective_bytes", int(actual))
+    obs.count("transfer.collective_bytes_unpacked", int(baseline))
+    obs.count("dist.dcn_bytes", int(actual))
+    obs.count("dist.dcn_bytes_unpacked", int(baseline))
+
+
 # -- device-resident operand cache ---------------------------------------
 #
 # The batched schedulers upload the SAME union transition tensor P once

@@ -143,6 +143,29 @@ def _cmd_check_serve(args) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+    if args.coordinator:
+        # pod mode: bring up jax.distributed BEFORE any backend spins
+        # up. Rank 0 becomes the daemon (ONE fleet replica fronting
+        # the whole pod); every other rank stays resident as a
+        # compute peer and never binds a port or claims a lease.
+        from jepsen_tpu.parallel import distributed
+        distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes or None,
+            process_id=args.process_id
+            if args.process_id is not None else None)
+        rank, n_ranks = distributed.process_info()
+        if n_ranks > 1 and rank > 0:
+            import signal as _sig
+
+            def _peer_term(signum, frame):
+                raise SystemExit(0)
+
+            _sig.signal(_sig.SIGTERM, _peer_term)
+            from jepsen_tpu.serve import http as serve_http
+            serve_http.run_compute_peer(rank=rank, n_ranks=n_ranks)
+            print(json.dumps({"shutdown": "clean", "peer": rank}))
+            return 0
     engine_kw = {}
     if args.max_states:
         engine_kw["max_states"] = args.max_states
@@ -381,6 +404,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      help="fleet lease time-to-live in seconds; a "
                           "dead replica's work drains to survivors "
                           "after this long")
+    csp.add_argument("--coordinator", default="",
+                     help="pod mode: jax.distributed coordinator "
+                          "address (host:port); rank 0 serves HTTP "
+                          "and holds the fleet lease, other ranks "
+                          "stay resident as compute peers")
+    csp.add_argument("--num-processes", type=int, default=0,
+                     help="pod mode: total process count (0 = "
+                          "environment-discovered)")
+    csp.add_argument("--process-id", type=int, default=None,
+                     help="pod mode: this process's rank (unset = "
+                          "environment-discovered)")
     csp.set_defaults(fn=_cmd_check_serve)
 
     ckp = sub.add_parser(

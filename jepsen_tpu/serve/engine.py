@@ -185,6 +185,15 @@ class Dispatcher:
         self.dispatch_deadline_s = dispatch_deadline_s
         self.journal = journal          # durable WAL (set by Daemon)
         self.sessions = None            # SessionRegistry (set by Daemon)
+        # pod mode: device seconds this rank spends are mirrored into
+        # dist.device_s so per-host spend reconciles across the pod's
+        # ranks (serve.device_s stays the daemon-local attribution)
+        try:
+            from jepsen_tpu.parallel import distributed
+            self._n_ranks = distributed.process_info()[1]
+        # jtlint: ok fallback — capability probe: no jax on the protocol-only path, single-process attribution
+        except Exception:                               # noqa: BLE001
+            self._n_ranks = 1
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.dispatch_counts: Dict[str, int] = {}
@@ -843,6 +852,8 @@ class Dispatcher:
             waste = share * pad
             obs.histogram("serve.dispatch_wall_s", elapsed)
             obs.count("serve.device_s", share * n_real)
+            if self._n_ranks > 1:
+                obs.count("dist.device_s", share * n_real)
             obs.count("serve.pad_waste_s", waste)
             obs.count(f"serve.lane.{lane.idx}.device_s",
                       share * n_real)

@@ -75,6 +75,8 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+import numpy as np
+
 from jepsen_tpu import edn
 from jepsen_tpu import history as h
 from jepsen_tpu import obs
@@ -245,6 +247,24 @@ class Daemon:
                       and self.journal is not None)
         self._fleet_stop = threading.Event()
         self._fleet_thread: Optional[threading.Thread] = None
+        # pod mode: a multi-host (jax.distributed) daemon is ONE fleet
+        # replica — rank 0 owns the lease and the HTTP socket; ranks
+        # > 0 are compute peers (run_compute_peer, never a Daemon).
+        # process_info degrades to (0, 1) single-process, so this is
+        # dormant off-pod.
+        try:
+            from jepsen_tpu.parallel import distributed
+            self.rank, self.n_ranks = distributed.process_info()
+        # jtlint: ok fallback — capability probe: no jax on the protocol-only path, single-process roles
+        except Exception:                               # noqa: BLE001
+            self.rank, self.n_ranks = 0, 1
+        if self.n_ranks > 1:
+            obs.gauge("dist.processes", self.n_ranks)
+            obs.gauge("dist.rank", self.rank)
+            if self.journal is not None:
+                # the lease payload carries the pod shape: a sibling
+                # replica inspecting the lease sees it fronts n ranks
+                self.journal.lease_meta = {"ranks": self.n_ranks}
         # (tenant, idempotency key) -> request id (bounded; seeded
         # from the journal so the dedup window survives restarts;
         # tenant-scoped so one tenant's key cannot map onto — or leak
@@ -321,6 +341,7 @@ class Daemon:
             self.replay_sessions()
             self._start_sweeper()
             self._start_fleet_scan()
+            self._pod_up()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, name="serve-http",
             daemon=True)
@@ -335,6 +356,7 @@ class Daemon:
         self.replay_sessions()
         self._start_sweeper()
         self._start_fleet_scan()
+        self._pod_up()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -342,8 +364,51 @@ class Daemon:
         finally:
             self.shutdown()
 
+    def _pod_up(self) -> None:
+        """Rank 0 of a pod: turn on driver mode (multi-host walks ship
+        their operands to the compute peers) and run the warmup ping —
+        one tiny word payload through the work channel and the DCN
+        gather, proving every peer answers collectives BEFORE real
+        checks ride on them. A failed warmup turns driver mode back
+        off: the daemon serves single-host rather than paying a gather
+        timeout per check against a torn pod."""
+        if self.n_ranks <= 1 or self.rank != 0:
+            return
+        from jepsen_tpu.parallel import distributed
+        distributed.set_driver(True)
+        ping = np.arange(32, dtype=np.uint32).reshape(1, 32)
+        try:
+            with distributed.driver_lock():
+                distributed.send_work(
+                    {"op": "gather-ping", "words": ping},
+                    timeout_s=distributed.gather_timeout_s())
+                out = distributed.ChunkShard.detect().gather(ping)
+            if out.shape[0] != self.n_ranks:
+                raise RuntimeError(
+                    f"warmup gathered {out.shape[0]}/{self.n_ranks}")
+            obs.count("dist.warmup_ok")
+            log.info("pod warmup: %d ranks answered", self.n_ranks)
+        except Exception as e:                          # noqa: BLE001
+            distributed.set_driver(False)
+            obs.count("dist.warmup_failed")
+            log.warning("pod warmup failed (%r): serving single-host",
+                        e)
+
     def shutdown(self, drain_timeout: float = 30.0) -> bool:
         self.accepting = False
+        if self.n_ranks > 1 and self.rank == 0:
+            # release the compute peers (best-effort: a torn pod's
+            # peers die by signal instead)
+            from jepsen_tpu.parallel import distributed
+            if distributed.driver_mode():
+                try:
+                    with distributed.driver_lock():
+                        distributed.send_work({"op": "shutdown"},
+                                              timeout_s=10.0)
+                # jtlint: ok fallback — best-effort peer release on shutdown; peers also die by signal
+                except Exception:                       # noqa: BLE001
+                    pass
+                distributed.set_driver(False)
         self._sweeper_stop.set()
         self._fleet_stop.set()
         if self._fleet_thread is not None:
@@ -1178,6 +1243,8 @@ class Daemon:
                 "replica": self.replica_id,
                 "lease-ttl-s": self.lease_ttl_s,
                 "leases": self.journal.stats().get("leases", 0)}
+        if self.n_ranks > 1:
+            out["dist"] = {"rank": self.rank, "ranks": self.n_ranks}
         return out
 
     def health(self) -> Dict[str, Any]:
@@ -1194,6 +1261,51 @@ class Daemon:
             out["fleet"] = {"replica": self.replica_id,
                             "lease-ttl-s": self.lease_ttl_s}
         return out
+
+
+def run_compute_peer(*, rank: int, n_ranks: int) -> None:
+    """Pod mode, ranks > 0: no HTTP socket, no lease, no dispatcher —
+    the process stays resident to join the multi-host walks rank 0's
+    daemon drives. The loop blocks in :func:`distributed.recv_work`;
+    each received item is one walk (operands shipped by the driver —
+    this rank's phase B joins the gather collective, its verdict is
+    discarded, rank 0's fold is the one that serves). Exits on the
+    driver's shutdown broadcast. Deliberately NOT a Daemon:
+    constructing one here would bind a second HTTP port and claim
+    leases rank 0 already owns."""
+    from jepsen_tpu.checkers import reach_chunklock as rcl
+    from jepsen_tpu.parallel import distributed
+
+    obs.gauge("dist.processes", n_ranks)
+    obs.gauge("dist.rank", rank)
+    log.info("compute peer up: rank %d of %d", rank, n_ranks)
+    print(f'{{"peer": {rank}, "ranks": {n_ranks}}}', flush=True)
+    while True:
+        item = distributed.recv_work()
+        op = str(item.get("op"))
+        if op == "shutdown":
+            log.info("compute peer rank %d: clean shutdown", rank)
+            return
+        try:
+            if op == "gather-ping":
+                # pod warmup: prove this rank answers a DCN collective
+                distributed.ChunkShard.detect().gather(
+                    np.ascontiguousarray(item["words"]))
+            elif op == "chunklock":
+                rcl.walk_chunklock(
+                    np.ascontiguousarray(item["P"], np.float32),
+                    np.ascontiguousarray(item["ret_slot"], np.int8),
+                    np.ascontiguousarray(item["slot_ops"]),
+                    int(item["M"]), n_chunks=int(item["n_chunks"]),
+                    e_pad=int(item["e_pad"]),
+                    suffix=int(item["suffix"]),
+                    interpret=bool(int(item["interpret"])))
+        except Exception:                               # noqa: BLE001
+            # a peer-side failure costs rank 0 one gather timeout and
+            # a local rescue, never correctness; stay resident
+            obs.count("dist.peer_errors")
+            log.exception("compute peer rank %d: work item failed",
+                          rank)
 
 
 class _Handler(BaseHTTPRequestHandler):

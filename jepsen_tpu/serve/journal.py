@@ -114,6 +114,10 @@ class Journal:
         self.keep_terminal = int(keep_terminal)
         self.fsync = bool(fsync)
         self.gc_every = max(1, int(gc_every))
+        # extra fields merged into every lease payload this journal
+        # writes (pod daemons stamp {"ranks": n} — ONE lease fronts
+        # the whole multi-host replica); None = plain payloads
+        self.lease_meta: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         self._finishes = 0
         os.makedirs(root, exist_ok=True)
@@ -329,6 +333,8 @@ class Journal:
         payload = {"id": entry_id, "replica": replica,
                    "expires-at": round(time.time() + float(ttl_s), 6),
                    "claimed-at": round(time.time(), 6)}
+        if self.lease_meta:
+            payload.update(self.lease_meta)
         # the lease-file corruption point: an armed "lease-write"
         # claim lands as a bad-payload (junk expires-at) lease the
         # claimer BELIEVES it holds — siblings must detect it,
