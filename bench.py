@@ -400,12 +400,14 @@ def serve_probe(quick: bool = True) -> dict:
                                                   path)
     loadgen = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(loadgen)
-    report = loadgen.run_loadgen({"quick": quick})
+    report = loadgen.run_loadgen({"quick": quick,
+                                  "find_capacity": True})
     # the full per-request record set is loadgen's business; keep the
     # bench artifact to the headline numbers + the daemon's counters
     keep = ("warmup", "target_rate", "duration_s", "submitted",
             "completed", "rejected_429", "timeouts",
-            "verdict_mismatches", "sustained_req_s", "p50_s",
+            "verdict_mismatches", "sustained_req_s", "saturated",
+            "capacity", "p50_s",
             "p99_s", "p50_admit_s", "p99_admit_s", "windows",
             "stage_split", "latency_crosscheck",
             "fallbacks", "drained", "error")
@@ -413,7 +415,7 @@ def serve_probe(quick: bool = True) -> dict:
     stats = report.get("stats", {})
     out["counters"] = {k: v
                        for k, v in stats.get("counters", {}).items()
-                       if k.startswith("serve.")}
+                       if k.startswith(("serve.", "pipeline."))}
     out["dispatch"] = stats.get("dispatch", {})
     # the daemon's histogram-derived tails + padding waste: the
     # serving-quality numbers BENCH_r*.json tracks across PRs

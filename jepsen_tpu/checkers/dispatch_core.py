@@ -22,6 +22,7 @@ sixth choreography:
 """
 from __future__ import annotations
 
+import os
 import time as _time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
@@ -39,6 +40,72 @@ from jepsen_tpu.checkers import transfer
 # bottleneck, and it is already fully hidden at depth 1).
 PIPE_DEPTH = 1
 
+# default in-flight window for the SERVE lanes (groups staged per lane,
+# including the one being collected): deep enough to hide host
+# pack+fetch behind device walks across whole admission groups, shallow
+# enough that at most K operand sets are pinned per lane. The autotune
+# table can override per geometry bucket (kind "pipeline").
+SERVE_PIPE_K = 4
+
+
+def pipeline_enabled() -> bool:
+    """The stage/collect dispatch pipeline gate.
+    ``JEPSEN_TPU_NO_PIPELINE=1`` forces K=1 everywhere — every group
+    is collected before the next is staged, the bit-identical
+    degenerate mode (consulted per call: tests toggle it)."""
+    return not os.environ.get("JEPSEN_TPU_NO_PIPELINE")
+
+
+def pipeline_k(geom_key: Optional[str] = None, *,
+               default: int = SERVE_PIPE_K) -> int:
+    """Resolve the in-flight window K (groups staged per lane,
+    including the one being collected). Precedence: the
+    ``JEPSEN_TPU_NO_PIPELINE=1`` opt-out (K=1), the
+    ``JEPSEN_TPU_PIPE_K=<n>`` override, a measured autotune winner
+    for this geometry bucket (kind ``pipeline``, recorded by
+    ``tools/ablate_lane.py --pipeline``; staleness-guarded like every
+    other entry), else ``default``. Always >= 1."""
+    if not pipeline_enabled():
+        return 1
+    env = os.environ.get("JEPSEN_TPU_PIPE_K")
+    if env:
+        try:
+            return max(1, int(env))
+        # jtlint: ok fallback — a malformed override reads as the default depth
+        except ValueError:
+            pass
+    if geom_key:
+        from jepsen_tpu.checkers import autotune
+        w = autotune.winner("pipeline", geom_key)
+        if w is not None:
+            try:
+                return max(1, int(w))
+            # jtlint: ok fallback — a malformed table entry reads as the default depth
+            except (TypeError, ValueError):
+                pass
+    return max(1, int(default))
+
+
+def poll_ready(x) -> bool:
+    """True when a dispatched device value's result is resident (its
+    fetch would not block). Conservative: anything without an
+    ``is_ready`` probe — numpy results, degenerate staged handles —
+    reads as ready, so readiness polling can only make a collect
+    eager, never skip one.  The probe itself lives with the rest of
+    the wire knowledge in :func:`transfer.device_ready`."""
+    return transfer.device_ready(x)
+
+
+def inflight_ready(fl) -> bool:
+    """Readiness of one dispatched-but-unfetched lockstep group
+    (:class:`reach_batch.BatchInflight`): the word body's queued
+    results, or the dense body's final carried config set."""
+    out = getattr(fl, "word_out", None)
+    if out is not None:
+        return all(poll_ready(o) for o in out)
+    final = getattr(fl, "final", None)
+    return poll_ready(final) if final is not None else True
+
 
 class DispatchState:
     """Shared per-dispatch bookkeeping of the synchronous and streaming
@@ -53,12 +120,18 @@ class DispatchState:
                  "inflight", "inflight_hwm", "fetch_s",
                  "fetch_degraded")
 
-    def __init__(self, devices: Optional[Sequence], dead: np.ndarray):
+    def __init__(self, devices: Optional[Sequence], dead: np.ndarray,
+                 k: Optional[int] = None):
         self.devs = list(devices) if devices else None
         self.n_dev = len(self.devs) if self.devs else 1
-        # one walking plus one queued group per device; FIFO collection
-        # drains the oldest shard while the rest keep walking
-        self.depth = self.n_dev * (PIPE_DEPTH + 1) - 1
+        # K groups in flight per device lane (K includes the one being
+        # collected, so the drain limit is n_dev*K - 1); the default
+        # K = PIPE_DEPTH+1 is the historical one-walking-plus-one-
+        # queued window, and JEPSEN_TPU_NO_PIPELINE=1 collapses to the
+        # collect-after-every-dispatch degenerate mode
+        if k is None:
+            k = pipeline_k(default=PIPE_DEPTH + 1)
+        self.depth = self.n_dev * max(1, int(k)) - 1
         self.dead = dead
         self.seen: set = set()
         self.dev_groups = [0] * self.n_dev
@@ -97,7 +170,35 @@ class DispatchState:
                 gd["pad_lane_returns"] = dup
         self.inflight.append((g, fl, di))
         self.inflight_hwm = max(self.inflight_hwm, len(self.inflight))
+        obs.count("pipeline.staged")
         return gd
+
+    def stage(self, gi: int, g, prep, dispatch_fn) -> dict:
+        """The pipeline's STAGE half for one group: device placement +
+        ``dispatch_fn(prep)`` (host pack already done by the caller's
+        prepare; this queues the puts/compiles/kernel launch, fetching
+        nothing) + in-flight admission. Returns the group diag."""
+        di, sp = self.place(gi, g, prep)
+        with obs.span("lockstep.dispatch", **sp):
+            fl = dispatch_fn(prep)
+        return self.admit(g, fl, di)
+
+    def collect(self, limit: int = 0) -> None:
+        """The pipeline's COLLECT half: FIFO-fetch verdicts until at
+        most ``limit`` groups remain in flight (0 = drain all)."""
+        self.drain(limit)
+
+    def collect_ready(self, limit: int = 0) -> None:
+        """Readiness-polled collect: FIFO-fetch only groups whose
+        device results are already resident, stopping at the first
+        still-walking group (never past ``limit`` remaining). A lane
+        thread calls this between stages so finished predecessors
+        drain without blocking the next stage."""
+        from jepsen_tpu.checkers import reach_batch  # noqa: F401
+
+        while (len(self.inflight) > limit
+               and inflight_ready(self.inflight[0][1])):
+            self.drain(len(self.inflight) - 1)
 
     def drain(self, limit: int) -> None:
         from jepsen_tpu.checkers import reach_batch

@@ -432,6 +432,50 @@ def auto_check_many_packed(model: Model, packed_list,
     return out
 
 
+def stage_check_many_packed(model: Model, packed_list, kw: Mapping):
+    """STAGE half of :func:`auto_check_many_packed` for the pipelined
+    serve lanes: attempt ONLY the bucketed-lockstep batch route with
+    the collect deferred (:func:`reach.stage_check_many` — host pack +
+    device puts + kernel launches, nothing fetched). Returns a
+    :class:`reach.StagedMany` handle (collect later, overlap now), or
+    None when the batch is not stageable — the caller then runs the
+    ordinary blocking chain, whose verdicts are bit-identical (same
+    kernels, same assembly). Never raises: a staging failure declines,
+    it does not consume the caller's recovery ladder."""
+    from jepsen_tpu.checkers import autotune, reach, transfer
+
+    transfer.record_mode()
+    ekw = _engine_kw(kw, ("max_states", "max_slots", "max_dense",
+                          "group"))
+    if kw.get("devices") or kw.get("force_host"):
+        # the mesh lane multi-queues its own window; forced-host runs
+        # have no device walk to overlap
+        return None
+    if "group" not in ekw:
+        g = autotune.winner("group", "default")
+        if g and str(g).isdigit():
+            ekw["group"] = int(g)
+    try:
+        with obs.span("facade.stage-many",
+                      histories=len(packed_list)):
+            staged = reach.stage_check_many(model, packed_list, **ekw)
+    except Exception as e:                              # noqa: BLE001
+        # jtlint: ok fallback — the stage probe must never cost the
+        # caller its ladder: decline and let the blocking chain run
+        obs.count("pipeline.stage_error")
+        logging.getLogger("jepsen.reach").warning(
+            "stage_check_many failed (%r); declining to blocking "
+            "path", e)
+        return None
+    if staged is not None:
+        engine = ("reach-lockstep"
+                  if isinstance(staged, reach.StagedMany)
+                  else "reach-batch")
+        obs.engine_selected("reach-many", histories=len(packed_list),
+                            engines=[engine], staged=True)
+    return staged
+
+
 def auto_check_txn(history: Sequence[Op],
                    kw: Optional[Mapping] = None) -> Dict[str, Any]:
     """The transactional (Elle-style) route: list-append dependency

@@ -2293,13 +2293,11 @@ def _dispatch_lockstep_groups(P, ret_flat, ops_flat, offsets, groups,
                 M)
         t1 = _time.monotonic()
         prep_s += t1 - t0
-        di, sp = st.place(gi, g, prep)
-        with obs.span("lockstep.dispatch", **sp):
-            fl = reach_batch.dispatch_prepared(prep)
+        gdiags.append(st.stage(gi, g, prep,
+                               reach_batch.dispatch_prepared))
         dispatch_s += _time.monotonic() - t1
-        gdiags.append(st.admit(g, fl, di))
-        st.drain(st.depth)
-    st.drain(0)
+        st.collect(st.depth)
+    st.collect(0)
     _lockstep_accounting(gdiags, prep_s, 0.0, 0.0, dispatch_s,
                          st.fetch_s, "sync", 0, diag,
                          st.mesh_info(pad_lanes), st.fetch_degraded)
@@ -2611,6 +2609,208 @@ def _check_many_lockstep(model: Model,
                           max_dense)
 
 
+class StagedMany:
+    """A staged-but-uncollected :func:`check_many` lockstep batch: the
+    union prep ran, every dispatch group's walk is QUEUED on device
+    (host pack + puts + kernel launches paid), and nothing has been
+    fetched. Produced by :func:`stage_check_many`; a serve lane holds
+    K of these in flight so group k+1's stage overlaps group k's
+    device walk. ``collect()`` FIFO-fetches the few verdict words and
+    assembles results exactly as the synchronous lockstep lane would —
+    bit-identical verdicts by construction (same kernels, same
+    ``_union_results`` assembly). A collect-side device error
+    propagates to the caller's recovery ladder; the retained host
+    operands make the re-run safe."""
+
+    __slots__ = ("model", "packed_list", "live", "u", "st", "gdiags",
+                 "prep_s", "dispatch_s", "t0", "max_states",
+                 "max_slots", "max_dense", "dead")
+
+    def __init__(self, model, packed_list, live, u, st, gdiags,
+                 prep_s, dispatch_s, t0, max_states, max_slots,
+                 max_dense, dead):
+        self.model = model
+        self.packed_list = packed_list
+        self.live = live
+        self.u = u
+        self.st = st
+        self.gdiags = gdiags
+        self.prep_s = prep_s
+        self.dispatch_s = dispatch_s
+        self.t0 = t0
+        self.max_states = max_states
+        self.max_slots = max_slots
+        self.max_dense = max_dense
+        self.dead = dead
+
+    def ready(self) -> bool:
+        """True when every staged group's device results are resident
+        (collect would not block on the walk)."""
+        return all(dispatch_core.inflight_ready(fl)
+                   for _g, fl, _di in self.st.inflight)
+
+    def collect(self) -> List[Dict[str, Any]]:
+        """Fetch verdicts and assemble per-history results (the
+        accounting tail the synchronous scheduler emits per
+        dispatch)."""
+        self.st.collect(0)
+        _lockstep_accounting(self.gdiags, self.prep_s, 0.0, 0.0,
+                             self.dispatch_s, self.st.fetch_s,
+                             "pipeline", 0, None, self.st.mesh_info(0),
+                             self.st.fetch_degraded)
+        elapsed = _time.monotonic() - self.t0
+        return _union_results("reach-lockstep", self.model,
+                              self.packed_list, self.live, self.dead,
+                              self.u, elapsed, self.max_states,
+                              self.max_slots, self.max_dense)
+
+
+def stage_check_many(model: Model,
+                     packed_list: Sequence[h.PackedHistory], *,
+                     max_states: int = 100_000, max_slots: int = 20,
+                     max_dense: int = 1 << 22,
+                     group: int = 0
+                     ) -> Optional["StagedMany | StagedVmapped"]:
+    """STAGE half of the pipelined :func:`check_many` lockstep route:
+    union prep + bucketed lane packing + every dispatch group's walk
+    queued on device, nothing fetched. Returns a :class:`StagedMany`
+    to collect later, or None when the batch is not stageable (gates
+    closed, too few live histories/returns, union prep declined) —
+    the caller then runs the ordinary blocking chain, which redoes
+    nothing but the cheap gate checks. A failure AFTER some groups
+    dispatched drains them best-effort and declines, so a staged probe
+    can never leak in-flight device work."""
+    from jepsen_tpu.checkers import preproc_native, reach_batch
+
+    if not dispatch_core.pipeline_enabled():
+        return None
+    if not (_use_pallas() and preproc_native.available()):
+        # no Pallas lockstep lane on this backend: stage the vmapped
+        # fast batch the blocking chain would route instead (the
+        # XLA:CPU serve path — async dispatch overlaps there too)
+        return _stage_many_vmapped(model, packed_list,
+                                   max_states=max_states,
+                                   max_slots=max_slots,
+                                   max_dense=max_dense)
+    live = [i for i, p in enumerate(packed_list) if p.n and p.n_ok]
+    if len(live) < 2:
+        return None
+    if sum(packed_list[i].n_ok for i in live) < _PALLAS_MIN_RETURNS:
+        return None
+    _ensure_persistent_caches()
+    t0 = _time.monotonic()
+    u = _union_prep_shared(model, packed_list, live, max_states,
+                           max_slots, None)
+    if u is None:
+        return None
+    (_memo_u, _S_pad, P, W, M, ret_flat, ops_flat, _key_W, key_R,
+     offsets, _opid_cat, _crs_cat, _offs, _noop_op) = u
+    groups = reach_batch.plan_buckets(
+        [int(r) for r in key_R], W, group=group or _BATCH_GROUP)
+    dead = np.full(len(live), -1, np.int64)
+    st = _LockstepDispatchState(None, dead)
+    gdiags: List[dict] = []
+    prep_s = dispatch_s = 0.0
+    try:
+        for gi, g in enumerate(groups):
+            ta = _time.monotonic()
+            with obs.span("lockstep.prep", lanes=len(g)):
+                prep = reach_batch.prepare_returns_batch(
+                    P,
+                    [ret_flat[offsets[k]:offsets[k + 1]] for k in g],
+                    [ops_flat[offsets[k]:offsets[k + 1]] for k in g],
+                    M)
+            tb = _time.monotonic()
+            prep_s += tb - ta
+            gdiags.append(st.stage(gi, g, prep,
+                                   reach_batch.dispatch_prepared))
+            dispatch_s += _time.monotonic() - tb
+    except Exception as e:                              # noqa: BLE001
+        # jtlint: ok fallback — stage probe declines; the caller's
+        # blocking chain re-runs the batch with its own fallback
+        # ladder, so nothing is lost but the attempted launches
+        obs.count("pipeline.stage_error")
+        _warn_pallas_failed(f"stage: {e!r}")
+        try:
+            st.collect(0)
+        # jtlint: ok fallback — draining a poisoned probe is best-effort; the blocking re-run owns the verdicts
+        except Exception:                               # noqa: BLE001
+            pass
+        return None
+    return StagedMany(model, packed_list, live, u, st, gdiags, prep_s,
+                      dispatch_s, t0, max_states, max_slots, max_dense,
+                      dead)
+
+
+def _stage_many_vmapped(model: Model,
+                        packed_list: Sequence[h.PackedHistory], *,
+                        max_states: int, max_slots: int,
+                        max_dense: int) -> Optional[StagedVmapped]:
+    """STAGE half of the vmapped-XLA :func:`check_many` fast batch:
+    per-key prep + the one batched walk launched, fetch deferred.
+    Mirrors ``check_many``'s single-device route gates EXACTLY —
+    declines whenever an earlier route (Pallas lockstep/keyed), the
+    slow event-walk tail, or an overflow would answer instead, so a
+    staged batch and the blocking re-run can never disagree on either
+    route or verdict. Routine budget overflows decline silently (the
+    blocking chain re-raises them under its own per-history fallback
+    ladder); only a genuine launch crash counts
+    ``pipeline.stage_error``."""
+    from jepsen_tpu.checkers.events import ConcurrencyOverflow
+    from jepsen_tpu.models.memo import StateExplosion
+
+    if len([i for i, p in enumerate(packed_list)
+            if p.n and p.n_ok]) < 2:
+        return None
+    _ensure_persistent_caches()
+    t0 = _time.monotonic()
+    try:
+        _seed_union_memo(model, [p for p in packed_list
+                                 if p.n and p.n_ok], max_states)
+        preps = []
+        for packed in packed_list:
+            if packed.n == 0 or packed.n_ok == 0:
+                preps.append(None)
+                continue
+            preps.append(_prep(model, packed, max_states=max_states,
+                               max_slots=max_slots,
+                               max_dense=max_dense))
+    # jtlint: ok fallback — routine budget overflow: the stage probe declines; the blocking re-run re-raises it under its own recorded ladder
+    except (DenseOverflow, ConcurrencyOverflow, StateExplosion):
+        return None
+    live = [i for i, p in enumerate(preps) if p is not None]
+    if not live:
+        return None
+    results: List[Optional[Dict[str, Any]]] = [
+        None if p is not None else
+        {"valid": True, "engine": "reach-batch", "events": 0,
+         "time-s": 0.0}
+        for p in preps]
+    S_pad = max(p[3] for i, p in enumerate(preps) if p is not None)
+    W = max(max(preps[i][1].W, 1) for i in live)
+    M = 1 << W
+    if S_pad * M > max_dense:
+        return None
+    O_pad = max(preps[i][0].n_ops for i in live)
+    if not _fast_ok(S_pad, W, M, O_pad):
+        return None
+    rss = [ev.returns_view(preps[i][1]) for i in live]
+    if (_use_pallas()
+            and sum(r.n_returns for r in rss) >= _PALLAS_MIN_RETURNS):
+        return None                     # keyed kernel would answer
+    try:
+        return _vmapped_fast_launch(preps, live, results, rss,
+                                    packed_list, S_pad, O_pad, W, M,
+                                    t0)
+    except Exception as e:                              # noqa: BLE001
+        # jtlint: ok fallback — stage probe declines; the blocking
+        # chain re-runs the batch under its own fallback ladder
+        obs.count("pipeline.stage_error")
+        logging.getLogger("jepsen.reach").warning(
+            "vmapped stage failed (%r); declining to blocking path", e)
+        return None
+
+
 def _check_many_mesh_lockstep(model: Model,
                               packed_list: Sequence[h.PackedHistory],
                               max_states: int, max_slots: int,
@@ -2804,6 +3004,129 @@ def _check_many_mesh_native(model: Model,
     return results  # type: ignore[return-value]
 
 
+class StagedVmapped:
+    """A staged-but-uncollected vmapped-XLA :func:`check_many` fast
+    batch: per-key prep ran and the ONE batched returns-walk call is
+    queued on device (async dispatch — CPU included), nothing fetched.
+    The non-Pallas twin of :class:`StagedMany`, so the serve lanes'
+    K-deep window overlaps host pack with device walks on every
+    backend the blocking route serves. ``collect()`` fetches the few
+    verdict words and assembles results exactly as the blocking branch
+    would — it IS the blocking branch's tail (one shared
+    implementation, :func:`_vmapped_fast_launch`), so verdicts are
+    bit-identical by construction. A collect-side device error
+    propagates to the caller's recovery ladder."""
+
+    __slots__ = ("futures", "_collect")
+
+    def __init__(self, futures, collect_fn):
+        self.futures = futures
+        self._collect = collect_fn
+
+    def ready(self) -> bool:
+        """True when the batched walk's verdict words are resident
+        (collect would not block on the device)."""
+        return all(dispatch_core.poll_ready(f) for f in self.futures)
+
+    def collect(self) -> List[Dict[str, Any]]:
+        return self._collect()
+
+
+def _vmapped_fast_launch(preps, live, results, rss, packed_list,
+                         S_pad, O_pad, W, M, t0,
+                         devices: Optional[Sequence] = None
+                         ) -> "StagedVmapped":
+    """LAUNCH half of the vmapped fast-path returns walk — host
+    operand build + the one batched device call, fetch deferred into
+    the returned handle's ``collect()``. :func:`check_many` calls
+    launch+collect back-to-back (the historical blocking branch);
+    :func:`stage_check_many` keeps the handle open so a serve lane
+    can stage the next group while this one walks."""
+    import jax.numpy as jnp
+
+    n_dev = len(devices) if devices is not None else 1
+    Ps, R0s = [], []
+    for i in live:
+        Ps.append(_build_P(preps[i][0], S_pad, O_pad))
+        R0 = np.zeros((S_pad, M), bool)
+        R0[0, 0] = True
+        R0s.append(R0)
+    # shared-alphabet fast path: uniform workloads produce the
+    # same P for every key — skip the per-key matrix batch
+    shared = all((Ps[k] == Ps[0]).all() for k in range(1, len(Ps)))
+    R_pad = max(64, _bucket(max(r.n_returns for r in rss), _UNROLL))
+    rss = [ev.pad_returns(r, R_pad, W) for r in rss]
+    xor_cols, bitmask = _xor_bitmask(W, M)
+    xc, bm = jnp.asarray(xor_cols), jnp.asarray(bitmask)
+    slot_np = np.stack([r.ret_slot for r in rss])
+    ops_np = np.stack([r.slot_ops for r in rss])
+    Ps_np = None if shared else np.stack(Ps)
+    R0s_np = np.stack(R0s)
+    K_live = len(rss)
+    if n_dev > 1:
+        # key-axis DP over the mesh: pad the key count to a
+        # multiple of the device count (pad keys replay key 0,
+        # whose verdict is discarded), shard the leading axis,
+        # replicate the shared operands
+        import jax
+        skey, srep, pad = _key_axis_shardings(devices, K_live)
+
+        def padk(a):
+            return np.concatenate(
+                [a, np.repeat(a[:1], pad, axis=0)]) if pad else a
+
+        slot_b = jax.device_put(padk(slot_np), skey)
+        ops_b = jax.device_put(padk(ops_np), skey)
+        if shared:
+            Ps_dev = jax.device_put(Ps[0], srep)
+            R0_b = jax.device_put(R0s[0], srep)
+        else:
+            Ps_dev = jax.device_put(padk(Ps_np), skey)
+            R0_b = jax.device_put(padk(R0s_np), skey)
+    else:
+        slot_b = jnp.asarray(slot_np)
+        ops_b = jnp.asarray(ops_np)
+        Ps_dev = jnp.asarray(Ps[0] if shared else Ps_np)
+        R0_b = jnp.asarray(R0s[0] if shared else R0s_np)
+    if shared:
+        ptrs, _, alives, R_blocks = \
+            _jitted_walk_returns_batch_shared()(
+                Ps_dev, xc, bm, slot_b, ops_b, R0_b)
+    else:
+        ptrs, _, alives, R_blocks = _jitted_walk_returns_batch()(
+            Ps_dev, xc, bm, slot_b, ops_b, R0_b)
+
+    def _collect() -> List[Dict[str, Any]]:
+        elapsed = _time.monotonic() - t0
+        ptrs_np = _fetch(ptrs)[:K_live]
+        alives_np = _fetch(alives)[:K_live]
+        R_blocks_np = None          # fetched lazily, only on failures
+        for k, i in enumerate(live):
+            memo, stream = preps[i][0], preps[i][1]
+            if bool(alives_np[k]):
+                results[i] = _result_valid("reach-batch", stream, memo,
+                                           elapsed)
+            else:
+                if R_blocks_np is None:
+                    R_blocks_np = _fetch(R_blocks)
+                Pk = (jnp.asarray(Ps[0]) if shared
+                      else jnp.asarray(Ps_np[k]))
+                dead_event = _refine_dead(Pk, xc, bm, rss[k],
+                                          int(ptrs_np[k]),
+                                          jnp.asarray(R_blocks_np[k]))
+                results[i] = _result_invalid(
+                    "reach-batch", stream, memo, packed_list[i],
+                    dead_event, elapsed)
+                dead_ret = int(np.searchsorted(
+                    rss[k].ret_event[:rss[k].n_returns], dead_event))
+                _attach_witness(results[i], memo, rss[k],
+                                Ps[k], S_pad, M, W, dead_ret,
+                                packed_list[i])
+        return results  # type: ignore[return-value]
+
+    return StagedVmapped([ptrs, alives], _collect)
+
+
 def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                max_states: int = 100_000, max_slots: int = 20,
                max_dense: int = 1 << 22,
@@ -2923,82 +3246,12 @@ def check_many(model: Model, packed_list: Sequence[h.PackedHistory], *,
                                         packed_list, M, W, max_states, t0)
                 if out is not None:
                     return out
-            Ps, R0s = [], []
-            for i in live:
-                Ps.append(_build_P(preps[i][0], S_pad, O_pad))
-                R0 = np.zeros((S_pad, M), bool)
-                R0[0, 0] = True
-                R0s.append(R0)
-            # shared-alphabet fast path: uniform workloads produce the
-            # same P for every key — skip the per-key matrix batch
-            shared = all((Ps[k] == Ps[0]).all() for k in range(1, len(Ps)))
-            R_pad = max(64, _bucket(max(r.n_returns for r in rss), _UNROLL))
-            rss = [ev.pad_returns(r, R_pad, W) for r in rss]
-            xor_cols, bitmask = _xor_bitmask(W, M)
-            xc, bm = jnp.asarray(xor_cols), jnp.asarray(bitmask)
-            slot_np = np.stack([r.ret_slot for r in rss])
-            ops_np = np.stack([r.slot_ops for r in rss])
-            Ps_np = None if shared else np.stack(Ps)
-            R0s_np = np.stack(R0s)
-            K_live = len(rss)
-            if n_dev > 1:
-                # key-axis DP over the mesh: pad the key count to a
-                # multiple of the device count (pad keys replay key 0,
-                # whose verdict is discarded), shard the leading axis,
-                # replicate the shared operands
-                import jax
-                skey, srep, pad = _key_axis_shardings(devices, K_live)
-
-                def padk(a):
-                    return np.concatenate(
-                        [a, np.repeat(a[:1], pad, axis=0)]) if pad else a
-
-                slot_b = jax.device_put(padk(slot_np), skey)
-                ops_b = jax.device_put(padk(ops_np), skey)
-                if shared:
-                    Ps_dev = jax.device_put(Ps[0], srep)
-                    R0_b = jax.device_put(R0s[0], srep)
-                else:
-                    Ps_dev = jax.device_put(padk(Ps_np), skey)
-                    R0_b = jax.device_put(padk(R0s_np), skey)
-            else:
-                slot_b = jnp.asarray(slot_np)
-                ops_b = jnp.asarray(ops_np)
-                Ps_dev = jnp.asarray(Ps[0] if shared else Ps_np)
-                R0_b = jnp.asarray(R0s[0] if shared else R0s_np)
-            if shared:
-                ptrs, _, alives, R_blocks = \
-                    _jitted_walk_returns_batch_shared()(
-                        Ps_dev, xc, bm, slot_b, ops_b, R0_b)
-            else:
-                ptrs, _, alives, R_blocks = _jitted_walk_returns_batch()(
-                    Ps_dev, xc, bm, slot_b, ops_b, R0_b)
-            elapsed = _time.monotonic() - t0
-            ptrs = _fetch(ptrs)[:K_live]
-            alives = _fetch(alives)[:K_live]
-            R_blocks_np = None          # fetched lazily, only on failures
-            for k, i in enumerate(live):
-                memo, stream = preps[i][0], preps[i][1]
-                if bool(alives[k]):
-                    results[i] = _result_valid("reach-batch", stream, memo,
-                                               elapsed)
-                else:
-                    if R_blocks_np is None:
-                        R_blocks_np = _fetch(R_blocks)
-                    Pk = (jnp.asarray(Ps[0]) if shared
-                          else jnp.asarray(Ps_np[k]))
-                    dead_event = _refine_dead(Pk, xc, bm, rss[k],
-                                              int(ptrs[k]),
-                                              jnp.asarray(R_blocks_np[k]))
-                    results[i] = _result_invalid(
-                        "reach-batch", stream, memo, packed_list[i],
-                        dead_event, elapsed)
-                    dead_ret = int(np.searchsorted(
-                        rss[k].ret_event[:rss[k].n_returns], dead_event))
-                    _attach_witness(results[i], memo, rss[k],
-                                    Ps[k], S_pad, M, W, dead_ret,
-                                    packed_list[i])
-            return results  # type: ignore[return-value]
+            # launch + immediate collect: the blocking branch IS the
+            # staged pair run back-to-back (one implementation, so the
+            # serve lanes' pipelined verdicts cannot drift from these)
+            return _vmapped_fast_launch(preps, live, results, rss,
+                                        packed_list, S_pad, O_pad, W, M,
+                                        t0, devices=devices).collect()
         E_pad = max(preps[i][1].E for i in live)
         Ts, kinds, slots, opids, R0s, slot0s, streams = \
             [], [], [], [], [], [], []

@@ -51,7 +51,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from jepsen_tpu import obs
-from jepsen_tpu.checkers import transfer
+from jepsen_tpu.checkers import dispatch_core, transfer
 from jepsen_tpu.checkers.reach_lane import (_BLOCK, _FAST_PASSES,
                                             _idx_dtype, _refine_dead)
 
@@ -539,12 +539,29 @@ def _pipe_walk_b(host_args, geom, n_pass: int, interpret: bool,
         transfer.count_put(actual, baseline)
     R_cur = dsegs["dR0"]
     ckpts = []
-    for i in range(nseg):
-        if fresh:
-            o_seg, r_seg = _seg_host(i)
+    # double-buffered wire: with pipelining on, segment i+1's host pack
+    # and device_put are issued BEFORE segment i's dispatch returns
+    # control, so the pack/transfer rides under segment i's device walk
+    # instead of serializing between launches.  JEPSEN_TPU_NO_PIPELINE
+    # restores the strict build-then-dispatch order.
+    prefetch = fresh and dispatch_core.pipeline_enabled()
+
+    def _seg_dev(k: int):
+        """Segment ``k``'s device operands, built and uploaded on
+        first use (cached in ``dsegs`` so rescue re-walks and the
+        dense-recover rebuild see prefetched segments identically)."""
+        while len(dsegs["segs"]) <= k:
+            o_seg, r_seg = _seg_host(len(dsegs["segs"]))
             dsegs["segs"].append(jax.device_put(
                 (transfer.pack_sextet(o_seg) if sextet else o_seg,
                  r_seg)))
+        return dsegs["segs"][k]
+
+    for i in range(nseg):
+        if fresh:
+            _seg_dev(i)
+            if prefetch and i + 1 < nseg:
+                _seg_dev(i + 1)
         a, b = dsegs["segs"][i]
         # dR0 is never donated (the rescue walk re-reads it); only the
         # pipeline-intermediate carried sets are

@@ -414,19 +414,46 @@ def _walk_dist(shard, P: np.ndarray, ret_slot: np.ndarray,
     return dead, diag
 
 
-def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
-                   slot_ops: np.ndarray, M: int, *,
-                   n_chunks: Optional[int] = None,
-                   e_pad: Optional[int] = None,
-                   suffix: Optional[int] = None,
-                   interpret: bool = False,
-                   shard: Optional[Any] = None
-                   ) -> Tuple[int, Dict[str, Any]]:
-    """Chunk-lockstep returns walk over one history. Returns
-    ``(dead, diag)``: ``dead`` is the first return index at which the
-    exact config set emptied (-1 = linearizable), bit-identical to
-    :func:`reach_lane.walk_returns`; ``diag`` carries chunk geometry
-    and rescue counts.
+class ChunklockInflight:
+    """A launched-but-unfetched chunk-lockstep walk: phases A/glue/B
+    and the fold are all queued on device, the ONE round trip (the
+    fold's packed verdict words) has not crossed the wire yet.
+    Produced by :func:`launch_chunklock`, consumed by
+    :func:`collect_chunklock` — the split lets a pipelined caller walk
+    the NEXT history's chunks while this one's fold drains.  The
+    multi-host shard path is inherently synchronous (the DCN gather IS
+    the fetch), so there ``result`` is already materialized and
+    ``collect`` just hands it back."""
+
+    __slots__ = ("packed", "final_b", "seeds_d", "P", "ret_slot",
+                 "slot_ops", "M", "C", "e_pad", "per", "interpret",
+                 "result")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+    def ready(self) -> bool:
+        """True when the fold's verdict words can be fetched without
+        blocking (conservative: unknown means ready)."""
+        if self.result is not None:
+            return True
+        return dispatch_core.poll_ready(self.packed)
+
+
+def launch_chunklock(P: np.ndarray, ret_slot: np.ndarray,
+                     slot_ops: np.ndarray, M: int, *,
+                     n_chunks: Optional[int] = None,
+                     e_pad: Optional[int] = None,
+                     suffix: Optional[int] = None,
+                     interpret: bool = False,
+                     shard: Optional[Any] = None
+                     ) -> "ChunklockInflight":
+    """Stage half of the chunk-lockstep walk: dispatch phases A, glue,
+    B (through the batch engine's double-buffered segment pipeline)
+    and the fold, returning a :class:`ChunklockInflight` WITHOUT
+    fetching the verdict words.  :func:`walk_chunklock` is the
+    blocking composition.
 
     ``shard`` (a :class:`jepsen_tpu.parallel.distributed.ChunkShard`,
     default auto-detected from the ``jax.distributed`` runtime) engages
@@ -513,12 +540,32 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
         return final_b
 
     if shard is not None and getattr(shard, "process_count", 1) > 1:
-        return _walk_dist(shard, P, ret_slot, slot_ops, M, C, e_pad,
-                          suffix, per, interpret, phase_b, seeds_d,
-                          cnt_d)
+        res = _walk_dist(shard, P, ret_slot, slot_ops, M, C, e_pad,
+                         suffix, per, interpret, phase_b, seeds_d,
+                         cnt_d)
+        return ChunklockInflight(result=res)
     final_b = phase_b(0, C)
     packed = _fold_call(C, M, S, e_pad)(final_b, seeds_d, cnt_d)
-    out = np.asarray(packed)                     # the ONE round trip
+    return ChunklockInflight(
+        packed=packed, final_b=final_b, seeds_d=seeds_d, P=P,
+        ret_slot=ret_slot, slot_ops=slot_ops, M=M, C=C, e_pad=e_pad,
+        per=per, interpret=interpret)
+
+
+def collect_chunklock(inf: "ChunklockInflight"
+                      ) -> Tuple[int, Dict[str, Any]]:
+    """Collect half: fetch the fold's packed verdict words (the ONE
+    round trip) and run the verdict / localize / host-refold tail.
+    Bit-identical to the pre-split walk — the split moves only WHEN
+    the fetch blocks, never what is fetched."""
+    if inf.result is not None:
+        return inf.result
+    P, ret_slot, slot_ops = inf.P, inf.ret_slot, inf.slot_ops
+    M, C, e_pad, per = inf.M, inf.C, inf.e_pad, inf.per
+    interpret, final_b, seeds_d = inf.interpret, inf.final_b, \
+        inf.seeds_d
+    S = int(P.shape[1])
+    out = np.asarray(inf.packed)                 # the ONE round trip
     MS = M * S
     dead_chunk = int(out[0, 0])
     inexact = out[0, 1:1 + C] > 0.5
@@ -550,6 +597,26 @@ def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
     dead = _host_fold(P, ret_slot, slot_ops, M, seeds_np, images_np,
                       all_v[start], start, C, per, interpret, diag)
     return dead, diag
+
+
+def walk_chunklock(P: np.ndarray, ret_slot: np.ndarray,
+                   slot_ops: np.ndarray, M: int, *,
+                   n_chunks: Optional[int] = None,
+                   e_pad: Optional[int] = None,
+                   suffix: Optional[int] = None,
+                   interpret: bool = False,
+                   shard: Optional[Any] = None
+                   ) -> Tuple[int, Dict[str, Any]]:
+    """Chunk-lockstep returns walk over one history (blocking
+    composition of :func:`launch_chunklock` and
+    :func:`collect_chunklock`). Returns ``(dead, diag)``: ``dead`` is
+    the first return index at which the exact config set emptied
+    (-1 = linearizable), bit-identical to
+    :func:`reach_lane.walk_returns`; ``diag`` carries chunk geometry
+    and rescue counts."""
+    return collect_chunklock(launch_chunklock(
+        P, ret_slot, slot_ops, M, n_chunks=n_chunks, e_pad=e_pad,
+        suffix=suffix, interpret=interpret, shard=shard))
 
 
 def check_packed(model, packed, *, max_states: int = 100_000,

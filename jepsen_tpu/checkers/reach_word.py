@@ -328,7 +328,19 @@ def mega_geometry(carry) -> Optional[tuple]:
 
 def advance_frontiers_mega(carries, blocks) -> list:
     """ONE kernel launch advances every member of a same-geometry
-    mega-group: member frontiers and their per-lane transition
+    mega-group — launch + collect in one blocking call. Composition
+    of :func:`launch_frontiers_mega` / :func:`collect_frontiers_mega`
+    (the stage/collect split the pipelined dispatcher uses), so the
+    two paths are bit-identical by construction."""
+    return collect_frontiers_mega(launch_frontiers_mega(carries,
+                                                        blocks))
+
+
+def launch_frontiers_mega(carries, blocks) -> "MegaInflight":
+    """LAUNCH half of the mega-group advance: host stacking + ONE put
+    + ONE batched kernel dispatch, nothing fetched.
+
+    Member frontiers and their per-lane transition
     tables are stacked along a lane axis ON HOST (numpy) and cross
     the wire as ONE put, walked through
     :func:`_jitted_walk_words_mega`, and scattered back to their
@@ -411,11 +423,52 @@ def advance_frontiers_mega(carries, blocks) -> list:
         jnp.asarray(T_h), jnp.asarray(R0_h), jnp.asarray(rs),
         jnp.asarray(so))
     obs.count("reach.word_walk_mega")
-    any_np = np.asarray(any_dead)
-    first_np = np.asarray(first)
+    return MegaInflight(carries, blocks, R, any_dead, first, L,
+                        L_pad, nw)
+
+
+class MegaInflight:
+    """A launched-but-unfetched mega-group advance: the batched walk
+    is queued on device, no result has crossed the wire. Produced by
+    :func:`launch_frontiers_mega`, consumed by
+    :func:`collect_frontiers_mega` — the stage/collect split of the
+    mega path (ISSUE 20): the dispatcher runs the next wave's host
+    bookkeeping between the two, so it overlaps the device walk
+    instead of serializing behind the fetch."""
+
+    __slots__ = ("carries", "blocks", "R", "any_dead", "first", "L",
+                 "L_pad", "nw")
+
+    def __init__(self, carries, blocks, R, any_dead, first, L, L_pad,
+                 nw):
+        self.carries = carries
+        self.blocks = blocks
+        self.R = R
+        self.any_dead = any_dead
+        self.first = first
+        self.L = L
+        self.L_pad = L_pad
+        self.nw = nw
+
+    def ready(self) -> bool:
+        from jepsen_tpu.checkers import dispatch_core
+        return all(dispatch_core.poll_ready(x)
+                   for x in (self.R, self.any_dead, self.first))
+
+
+def collect_frontiers_mega(inf: MegaInflight) -> list:
+    """COLLECT half of the mega advance: the ONE bulk fetch, the
+    numpy scatter back into each owning carry, and the per-member
+    exact dead indices — everything downstream of the kernel."""
+    if not inf:                         # empty group launched to []
+        return []
+    carries, blocks, nw = inf.carries, inf.blocks, inf.nw
+    any_np = np.asarray(inf.any_dead)
+    first_np = np.asarray(inf.first)
     # ONE bulk fetch brings every real lane's frontier home; the
     # scatter below is numpy views, not per-lane device slices
-    R_h = np.asarray(R[:L]) if L_pad > L else np.asarray(R)
+    R_h = np.asarray(inf.R[:inf.L]) if inf.L_pad > inf.L \
+        else np.asarray(inf.R)
     deads = []
     for i, c in enumerate(carries):
         n = len(blocks[i][0])

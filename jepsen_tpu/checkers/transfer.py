@@ -37,7 +37,7 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -179,6 +179,28 @@ def count_collective(actual: int, baseline: int) -> None:
     obs.count("transfer.collective_bytes_unpacked", int(baseline))
     obs.count("dist.dcn_bytes", int(actual))
     obs.count("dist.dcn_bytes_unpacked", int(baseline))
+
+
+def device_ready(x: Any) -> bool:
+    """Non-blocking readiness probe for one device value: True when a
+    fetch (``np.asarray``) would not stall on in-flight device compute.
+    jax arrays expose ``is_ready()``; anything without the probe (host
+    arrays, stubs, older backends) reports ready — the pipelined
+    collectors use this only to ORDER fetches, so a conservative True
+    costs at most an early block, never a wrong result."""
+    probe = getattr(x, "is_ready", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe())
+    except Exception:   # noqa: BLE001  # jtlint: ok fallback — probe-only; a broken is_ready() must degrade to "fetch now", not kill the collect loop
+        return True
+
+
+def all_ready(xs: Sequence[Any]) -> bool:
+    """:func:`device_ready` over a group's output leaves — the unit a
+    staged dispatch polls before committing to its blocking fetch."""
+    return all(device_ready(x) for x in xs)
 
 
 # -- device-resident operand cache ---------------------------------------

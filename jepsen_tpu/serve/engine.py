@@ -59,11 +59,41 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from jepsen_tpu import obs
+from jepsen_tpu.checkers import dispatch_core
 from jepsen_tpu.serve import faults, recovery
 from jepsen_tpu.serve import request as rq
 from jepsen_tpu.serve.coalesce import AdmissionQueue
 
 log = logging.getLogger("jepsen.serve")
+
+
+class _StagedDispatch:
+    """One serve group staged-but-uncollected on its lane: the engine
+    launch is queued on device (``handle`` — a
+    :class:`reach.StagedMany`), per-request dispatch bookkeeping is
+    done, and the queue slot is still held (released by
+    :meth:`Dispatcher._collect_one` after publish, so the drain
+    contract is unchanged). ``cap_recs`` carries the obs ledger
+    records the stage produced, merged with the collect's capture
+    into every member's stitched trace."""
+
+    __slots__ = ("batch", "lane_idx", "kw", "hang", "t0", "pad",
+                 "n_real", "handle", "cap_recs")
+
+    def __init__(self, batch, lane_idx, kw, hang, t0, pad, n_real,
+                 handle, cap_recs):
+        self.batch = batch
+        self.lane_idx = lane_idx
+        self.kw = kw
+        self.hang = hang
+        self.t0 = t0
+        self.pad = pad
+        self.n_real = n_real
+        self.handle = handle
+        self.cap_recs = cap_recs
+
+    def ready(self) -> bool:
+        return self.handle.ready()
 
 
 def _profiler_start(path: str) -> None:
@@ -144,6 +174,15 @@ class _Lane:
         self.breaker = breaker
         self.device_ran = False
         self.thread: Optional[threading.Thread] = None
+        # pipelined dispatch state (only ever touched by this lane's
+        # own thread, no lock): attr_mark is the attribution clock —
+        # the end of this lane's last collected interval, so a group
+        # whose stage→collect wall overlaps a predecessor books only
+        # the un-attributed slice (device_s + pad_waste_s keeps
+        # partitioning the lane's busy wall exactly; the overlapped
+        # remainder is the pipeline win, counted pipeline.overlap_s)
+        self.attr_mark = 0.0
+        self.window_peak = 0
 
 
 class Dispatcher:
@@ -198,6 +237,9 @@ class Dispatcher:
         self._thread: Optional[threading.Thread] = None
         self.dispatch_counts: Dict[str, int] = {}
         self._counts_lock = threading.Lock()
+        # max staged-window depth seen on any lane (pipeline evidence
+        # for /stats and the CI pipeline-smoke gate)
+        self._inflight_peak = 0
         self.ring = _TimeSeriesRing()
         # on-demand profiling (POST /profile): arm -> the next N
         # dispatches run under jax.profiler.trace, capture persisted
@@ -256,37 +298,142 @@ class Dispatcher:
 
     # -- the loop --------------------------------------------------------
     def _loop(self, lane: "_Lane") -> None:
+        """The lane thread: a bounded window of K staged groups in
+        flight (ISSUE 20 tentpole). While group k's walk runs on
+        device, this thread stages group k+1 (host pack + puts +
+        kernel launch via :meth:`_stage`) and collects any READY
+        predecessors; a full window blocks on the oldest group's
+        collect (counted ``pipeline.stall_s`` — the host running
+        ahead of the device). Batches the stage probe declines
+        (sessions, txn, singletons, breaker-open, ineligible engine
+        routes) drain the window first — publish order stays FIFO —
+        then run the unchanged blocking path. K=1
+        (``JEPSEN_TPU_NO_PIPELINE=1``) never stages: every iteration
+        is the historical pull→dispatch→mark_done loop, bit-identical
+        verdicts AND accounting."""
+        window: deque = deque()
         while not self._stop.is_set():
-            batch = self.queue.next_batch(timeout=0.1, lane=lane.idx)
-            if not batch:
+            k = dispatch_core.pipeline_k(
+                "serve", default=dispatch_core.SERVE_PIPE_K)
+            batch = None
+            if len(window) < k:
+                # with staged work pending, poll fast: a short pull
+                # timeout keeps ready predecessors draining even when
+                # the queue is idle
+                batch = self.queue.next_batch(
+                    timeout=(0.01 if window else 0.1), lane=lane.idx)
+            if batch:
+                self._profile_maybe_start()
+                staged = None
+                if k > 1:
+                    try:
+                        staged = self._stage(batch, lane)
+                    except Exception as e:              # noqa: BLE001
+                        # jtlint: ok fallback — a stage-probe crash
+                        # must not strand the batch: the blocking
+                        # path below redoes it from scratch
+                        log.warning("stage probe crashed: %r", e,
+                                    exc_info=e)
+                        obs.count("pipeline.stage_error")
+                        staged = None
+                if staged is not None:
+                    obs.count("pipeline.staged")
+                    window.append(staged)
+                    if len(window) > lane.window_peak:
+                        lane.window_peak = len(window)
+                        obs.gauge(
+                            f"pipeline.lane.{lane.idx}.inflight_peak",
+                            lane.window_peak)
+                        self._note_inflight_peak(lane.window_peak)
+                    # collect ready predecessors without blocking the
+                    # next stage
+                    while window and window[0].ready():
+                        self._collect_one(window.popleft(), lane)
+                    continue
+                # not stageable: preserve FIFO publish order — drain
+                # the window, then run the blocking path
+                while window:
+                    self._collect_one(window.popleft(), lane)
+                self._run_blocking(batch, lane)
                 continue
-            self._profile_maybe_start()
-            try:
-                self._dispatch(batch, lane)
-            except Exception as e:                      # noqa: BLE001
-                # LAST-resort containment: the recovery ladder inside
-                # _dispatch handles engine failures; anything escaping
-                # it (bookkeeping bugs, injected tick faults) must not
-                # kill the lane thread or strand the batch
-                log.error("dispatch iteration crashed: %r", e,
-                          exc_info=e)
-                obs.engine_fallback("serve-dispatch",
-                                    type(e).__name__,
-                                    lanes=len(batch), iteration=True)
-                now = time.monotonic()
-                for r in batch:
-                    if not r.terminal:
-                        self._finish(r, {"valid": "unknown",
-                                         "error": f"{type(e).__name__}"
-                                                  f": {e}"},
-                                     0.0, now)
-            finally:
-                self.queue.mark_done(batch, lane=lane.idx)
-                obs.gauge("serve.inflight", 0)
-                self._profile_maybe_stop()
-                snap = obs.core.GLOBAL.snapshot()
-                self.ring.sample(self.queue, snap)
-                self._write_stats_file(snap)
+            if window:
+                stalled = len(window) >= k
+                t_w = time.monotonic()
+                self._collect_one(window.popleft(), lane)
+                if stalled:
+                    obs.count("pipeline.stall_s",
+                              time.monotonic() - t_w)
+        # shutdown: collect everything still in flight so no request
+        # is stranded un-published
+        while window:
+            self._collect_one(window.popleft(), lane)
+
+    def _note_inflight_peak(self, peak: int) -> None:
+        with self._counts_lock:
+            if peak > self._inflight_peak:
+                self._inflight_peak = peak
+                obs.gauge("pipeline.inflight_peak", peak)
+
+    def _run_blocking(self, batch: List["rq.CheckRequest"],
+                      lane: "_Lane") -> None:
+        """One blocking dispatch iteration — the pre-pipeline loop
+        body, unchanged: dispatch, last-resort containment, queue
+        release + stats sampling."""
+        try:
+            self._dispatch(batch, lane)
+        except Exception as e:                          # noqa: BLE001
+            # LAST-resort containment: the recovery ladder inside
+            # _dispatch handles engine failures; anything escaping
+            # it (bookkeeping bugs, injected tick faults) must not
+            # kill the lane thread or strand the batch
+            log.error("dispatch iteration crashed: %r", e,
+                      exc_info=e)
+            obs.engine_fallback("serve-dispatch",
+                                type(e).__name__,
+                                lanes=len(batch), iteration=True)
+            now = time.monotonic()
+            for r in batch:
+                if not r.terminal:
+                    self._finish(r, {"valid": "unknown",
+                                     "error": f"{type(e).__name__}"
+                                              f": {e}"},
+                                 0.0, now)
+        finally:
+            self.queue.mark_done(batch, lane=lane.idx)
+            obs.gauge("serve.inflight", 0)
+            self._profile_maybe_stop()
+            snap = obs.core.GLOBAL.snapshot()
+            self.ring.sample(self.queue, snap)
+            self._write_stats_file(snap)
+
+    def _collect_one(self, staged: "_StagedDispatch",
+                     lane: "_Lane") -> None:
+        """Collect + publish one staged group, releasing its queue
+        slot only AFTER its results land (the drain contract:
+        depth==0 ∧ inflight=={} still means every verdict is
+        published)."""
+        batch = staged.batch
+        try:
+            self._collect_staged(staged, lane)
+        except Exception as e:                          # noqa: BLE001
+            # the same last-resort containment as the blocking loop
+            log.error("staged collect crashed: %r", e, exc_info=e)
+            obs.engine_fallback("serve-dispatch", type(e).__name__,
+                                lanes=len(batch), iteration=True)
+            now = time.monotonic()
+            for r in batch:
+                if not r.terminal:
+                    self._finish(r, {"valid": "unknown",
+                                     "error": f"{type(e).__name__}"
+                                              f": {e}"},
+                                 0.0, now)
+        finally:
+            self.queue.mark_done(batch, lane=lane.idx)
+            obs.gauge("serve.inflight", 0)
+            self._profile_maybe_stop()
+            snap = obs.core.GLOBAL.snapshot()
+            self.ring.sample(self.queue, snap)
+            self._write_stats_file(snap)
 
     # -- on-demand profiling ---------------------------------------------
     def arm_profile(self, dispatches: int) -> str:
@@ -669,25 +816,43 @@ class Dispatcher:
         obs.gauge("serve.inflight", len(batch))
         t0 = time.monotonic()
         waves = max(len(rs) for rs in by_sess.values())
-        for w in range(waves):
-            wave = [rs[w] for rs in by_sess.values() if w < len(rs)]
-            tw = time.monotonic()
-            for r in wave:
-                r.t_dispatch = tw
+        wave_list = [[rs[w] for rs in by_sess.values() if w < len(rs)]
+                     for w in range(waves)]
+        stamped: set = set()
+
+        def _stamp(w: int) -> None:
+            # per-wave admission bookkeeping (t_dispatch, queue-wait,
+            # tenant ledger).  Idempotent so the pipelined path can run
+            # it for wave w+1 while wave w walks on device, and the
+            # serial fallthrough below still covers every wave.
+            if w >= waves or w in stamped:
+                return
+            stamped.add(w)
+            ts = time.monotonic()
+            for r in wave_list[w]:
+                r.t_dispatch = ts
                 obs.histogram(
                     "serve.queue_wait_s",
-                    max(0.0, (r.t_coalesce or tw) - r.t_submit))
+                    max(0.0, (r.t_coalesce or ts) - r.t_submit))
                 self.registry.ledger_record(
                     r.tenant, "dispatched", id=r.id,
                     group=len(batch), ops=int(r.n_ops),
                     session=r.session.id, kind=r.kind,
-                    mega=len(wave))
+                    mega=len(wave_list[w]))
+
+        overlap = (dispatch_core.pipeline_k(
+            "session-mega", default=dispatch_core.SERVE_PIPE_K) > 1)
+        for w, wave in enumerate(wave_list):
+            tw = time.monotonic()
+            _stamp(w)
             with obs.capture() as cap:
                 try:
                     results = sessmod.advance_group(
                         [(r.session, list(r.history), r.seq)
                          for r in wave],
-                        should_abort=self._session_abort(tw))
+                        should_abort=self._session_abort(tw),
+                        overlap_fn=((lambda nw=w + 1: _stamp(nw))
+                                    if overlap else None))
                 except Exception as e:                  # noqa: BLE001
                     # the group advance's own ladders should have
                     # contained this; a residual crash is recorded,
@@ -824,6 +989,15 @@ class Dispatcher:
                 err = {"valid": "unknown",
                        "error": f"{type(e).__name__}: {e}"}
                 results = [dict(err) for _ in batch]
+        self._publish(batch, results, lane, t0, pad, n_real,
+                      cap.ledger, hang, lane.device_ran)
+
+    def _publish(self, batch: List["rq.CheckRequest"], results,
+                 lane: "_Lane", t0: float, pad: int, n_real: int,
+                 cap_ledger, hang, device_ran: bool) -> None:
+        """Results → attribution → stitched traces → finish/requeue:
+        the publish tail shared by the blocking dispatch and the
+        pipelined collect."""
         if len(results) != len(batch):
             # alignment is the publish contract: a short list would
             # silently strand the tail members un-finished forever
@@ -835,6 +1009,22 @@ class Dispatcher:
                       * len(batch))[:len(batch)]
         t_collect = time.monotonic()
         elapsed = t_collect - t0
+        # the lane attribution clock: with K groups staged on this
+        # lane their stage→collect walls overlap, so each group books
+        # only the slice of lane wall since the previous collect
+        # (collects are FIFO on the lane's own thread, so these
+        # intervals partition the lane's busy wall and the device_s +
+        # pad_waste_s == dispatch-wall identity stays EXACT under
+        # interleaving; serial dispatches have attr_mark <= t0 and
+        # book their full elapsed — bit-identical to the pre-pipeline
+        # accounting). The overlapped remainder is the pipeline's win,
+        # counted pipeline.overlap_s.
+        attributed = max(0.0, t_collect - max(t0, lane.attr_mark))
+        lane.attr_mark = t_collect
+        overlap = elapsed - attributed
+        if overlap > 1e-9:
+            obs.count("pipeline.overlap_s", overlap)
+            obs.count(f"pipeline.lane.{lane.idx}.overlap_s", overlap)
 
         # device-time attribution: the group's measured kernel wall is
         # amortized over its lanes — each member (one real lane) gets
@@ -847,10 +1037,10 @@ class Dispatcher:
         # every device second the daemon spent, attributed to the
         # lane that spent it.
         lanes = n_real + pad
-        if lane.device_ran:
-            share = elapsed / lanes
+        if device_ran:
+            share = attributed / lanes
             waste = share * pad
-            obs.histogram("serve.dispatch_wall_s", elapsed)
+            obs.histogram("serve.dispatch_wall_s", attributed)
             obs.count("serve.device_s", share * n_real)
             if self._n_ranks > 1:
                 obs.count("dist.device_s", share * n_real)
@@ -872,7 +1062,7 @@ class Dispatcher:
         # in the member's TENANT serve ledger, so "no silent fallback"
         # stays assertable from the client side (GET /check/<id> and
         # GET /stats), not just from inside the daemon process.
-        engine_recs = [r for r in cap.ledger
+        engine_recs = [r for r in cap_ledger
                        if r.get("event") in ("selected", "fallback",
                                              "swallowed", "route",
                                              "skipped")]
@@ -906,6 +1096,132 @@ class Dispatcher:
                 self._requeue(req)
             else:
                 self._finish(req, res, elapsed, now)
+
+    # -- the pipelined stage/collect pair --------------------------------
+    def _stage(self, batch: List["rq.CheckRequest"],
+               lane: "_Lane") -> Optional["_StagedDispatch"]:
+        """STAGE one one-shot group: probe the staged engine route
+        (:func:`facade.stage_check_many_packed` — host pack + device
+        puts + kernel launches, nothing fetched) and, if it admits,
+        commit the per-request dispatch bookkeeping and return the
+        in-flight handle. Returns None — with NO request-visible side
+        effects — when the group is not stageable (sessions, txn,
+        singletons, breaker-open lane, per-request opts that force
+        another route, engine gates closed), so the caller's blocking
+        path runs exactly as before the pipeline existed."""
+        from jepsen_tpu.checkers import facade
+        req0 = batch[0]
+        if req0.session is not None or len(batch) < 2:
+            return None
+        if self._is_txn(req0.model):
+            return None
+        if lane.breaker.route() == "host":
+            return None
+        if faults.enabled():
+            # armed fault injection exercises the blocking path's fire
+            # points (tick/dispatch/device) — staging would skip them
+            return None
+        kw = dict(self.engine_kw)
+        kw.update(req0.opts)
+        if kw.get("force_host"):
+            return None
+        t0 = time.monotonic()
+        hang = [False]
+
+        def _aborted() -> bool:
+            if self._stop.is_set():
+                return True
+            if self.dispatch_deadline_s is not None \
+                    and time.monotonic() - t0 > self.dispatch_deadline_s:
+                if not hang[0]:
+                    hang[0] = True
+                    obs.engine_fallback("serve-hang",
+                                        "DispatchDeadline",
+                                        lanes=len(batch),
+                                        deadline_s=self
+                                        .dispatch_deadline_s)
+                return True
+            now = time.monotonic()
+            return all(r.cancel_requested or r.expired(now)
+                       for r in batch)
+
+        kw["should_abort"] = _aborted
+        n_real = len(batch)
+        pad = self._pad_count(n_real, False)
+        packed_list, _pad = self._padded_list(batch)
+        with obs.capture() as cap:
+            with obs.span("pipeline.stage", model=req0.model_name,
+                          lanes=len(batch)):
+                handle = facade.stage_check_many_packed(
+                    req0.model, packed_list, kw)
+        if handle is None:
+            return None
+        # committed: the group IS dispatched — same bookkeeping as the
+        # blocking path's pre-engine half
+        faults.fire("tick")
+        sig = f"{req0.model_name}/H{len(batch)}"
+        with self._counts_lock:
+            self.dispatch_counts[sig] = \
+                self.dispatch_counts.get(sig, 0) + 1
+        obs.count("serve.dispatched", len(batch))
+        obs.count(f"serve.lane.{lane.idx}.dispatched")
+        obs.gauge("serve.inflight", len(batch))
+        if pad:
+            obs.count("serve.pad_lanes", pad)
+        for r in batch:
+            r.t_dispatch = t0
+            obs.histogram("serve.queue_wait_s",
+                          max(0.0, (r.t_coalesce or t0) - r.t_submit))
+            self.registry.ledger_record(
+                r.tenant, "dispatched", id=r.id, group=len(batch),
+                ops=int(r.packed.n))
+        return _StagedDispatch(batch, lane.idx, kw, hang, t0, pad,
+                               n_real, handle, list(cap.ledger))
+
+    def _collect_staged(self, staged: "_StagedDispatch",
+                        lane: "_Lane") -> None:
+        """COLLECT one staged group: fetch its verdict words, publish
+        through the shared tail. A collect-side failure (jax surfaces
+        walk errors at first fetch) feeds the lane breaker and drops
+        into the UNCHANGED recovery ladder — retry → bisect → host
+        rescue → quarantine — on the retained requests, so a staged
+        group that dies gets exactly the pre-pipeline treatment."""
+        batch = staged.batch
+        req0 = batch[0]
+        lane.device_ran = True      # the stage launched device work
+        with obs.capture() as cap:
+            try:
+                with obs.span("serve.dispatch",
+                              model=req0.model_name,
+                              lanes=len(batch)):
+                    results = staged.handle.collect()[:len(batch)]
+                lane.breaker.record_success()
+            except Exception as e:                      # noqa: BLE001
+                # jtlint: ok fallback — collect death enters the
+                # ordinary recovery ladder below; the fallback record
+                # mirrors the blocking path's per-attempt record
+                log.warning("staged collect failed (lanes=%d): %r",
+                            len(batch), e, exc_info=e)
+                obs.engine_fallback("serve-dispatch",
+                                    type(e).__name__,
+                                    lanes=len(batch), staged=True)
+                lane.breaker.record_failure()
+                try:
+                    results = self._run_recover(
+                        batch, staged.kw, self.retry.max_retries, lane)
+                except Exception as e2:                 # noqa: BLE001
+                    # the ladder itself must be crash-contained too
+                    log.warning("serve recovery ladder crashed: %r",
+                                e2, exc_info=e2)
+                    obs.engine_fallback("serve-dispatch",
+                                        type(e2).__name__,
+                                        lanes=len(batch))
+                    err = {"valid": "unknown",
+                           "error": f"{type(e2).__name__}: {e2}"}
+                    results = [dict(err) for _ in batch]
+        self._publish(batch, results, lane, staged.t0, staged.pad,
+                      staged.n_real, staged.cap_recs + cap.ledger,
+                      staged.hang, True)
 
     # -- completion ------------------------------------------------------
     def _requeue(self, req: "rq.CheckRequest") -> None:
@@ -1023,7 +1339,7 @@ class Dispatcher:
         counters = {k: v for k, v in snap["counters"].items()
                     if k.startswith(("serve.", "engine.", "lockstep.",
                                      "compile_cache.", "memo_cache.",
-                                     "transfer."))}
+                                     "transfer.", "pipeline."))}
         with self._counts_lock:
             dispatch = dict(self.dispatch_counts)
         out = {

@@ -894,7 +894,9 @@ def mega_crossover() -> int:
 
 def advance_group(entries: Sequence[tuple],
                   should_abort: Optional[Any] = None,
-                  force: bool = False) -> List[Dict[str, Any]]:
+                  force: bool = False,
+                  overlap_fn: Optional[Any] = None
+                  ) -> List[Dict[str, Any]]:
     """Advance one append block on EACH member session of a
     same-geometry mega-group through ONE batched frontier walk.
 
@@ -922,7 +924,16 @@ def advance_group(entries: Sequence[tuple],
     ``force=True`` bypasses the persisted crossover width and always
     takes the batched path (the bench probe measures mega-vs-solo at
     every width; honoring a previously recorded crossover there would
-    silently re-measure solo-vs-solo)."""
+    silently re-measure solo-vs-solo).
+
+    ``overlap_fn`` (ISSUE 20: the mega path's stage/collect overlap
+    window) runs between the batched LAUNCH and its fetch — host
+    bookkeeping the dispatcher would otherwise serialize behind the
+    walk (the next wave's stamps/ledger) executes while the device
+    walks this wave; its wall lands in ``pipeline.overlap_s``. It is
+    best-effort: a crash inside it is contained (the wave still
+    collects), and it is NOT called when the group takes the
+    per-session path."""
     results: List[Optional[Dict[str, Any]]] = [None] * len(entries)
     if not entries:
         return []
@@ -961,8 +972,9 @@ def advance_group(entries: Sequence[tuple],
             obs.count("serve.session.mega.groups")
             obs.count("serve.session.mega.lanes", len(staged))
             deads = None
+            inf = None
             try:
-                deads = reach_word.advance_frontiers_mega(
+                inf = reach_word.launch_frontiers_mega(
                     [st[0] for _k, _s, st in staged],
                     [(st[1], st[2]) for _k, _s, st in staged])
             except Exception as e:                      # noqa: BLE001
@@ -970,6 +982,27 @@ def advance_group(entries: Sequence[tuple],
                 # record; every staged member re-advances solo below
                 obs.engine_fallback("session-mega", type(e).__name__,
                                     lanes=len(staged))
+            if overlap_fn is not None and inf is not None:
+                t_ov = time.monotonic()
+                try:
+                    overlap_fn()
+                # jtlint: ok fallback — the overlap window is best-effort host bookkeeping; the wave's collect must not die for it
+                except Exception as e:                  # noqa: BLE001
+                    obs.checker_swallowed("session-mega-overlap",
+                                          type(e).__name__)
+                obs.count("pipeline.overlap_s",
+                          time.monotonic() - t_ov)
+            if inf is not None:
+                try:
+                    deads = reach_word.collect_frontiers_mega(inf)
+                except Exception as e:                  # noqa: BLE001
+                    # the batched FETCH died (async dispatch surfaces
+                    # walk errors at first consumption): the same ONE
+                    # session-mega record + per-member solo re-advance
+                    obs.engine_fallback("session-mega",
+                                        type(e).__name__,
+                                        lanes=len(staged),
+                                        collect=True)
             for j, (k, sess, st) in enumerate(staged):
                 ops_k, seq_k = entries[k][1], entries[k][2]
                 try:

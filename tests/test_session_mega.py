@@ -340,7 +340,7 @@ def test_group_batched_launch_death_degrades_not_members(monkeypatch):
     def _boom(carries, blks):
         raise RuntimeError("injected launch death")
 
-    monkeypatch.setattr(reach_word, "advance_frontiers_mega", _boom)
+    monkeypatch.setattr(reach_word, "launch_frontiers_mega", _boom)
     with obs.capture() as cap:
         out = sessmod.advance_group(
             [(s, blocks[1], 2) for s in mega])

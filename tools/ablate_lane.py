@@ -437,6 +437,120 @@ def body_sweep(ops: int, repeat: int, record: bool) -> int:
     return 0
 
 
+def _pipe_drive(model, batches, K: int):
+    """Process ``batches`` through the serve-lane window discipline at
+    in-flight depth K: stage batch b+1 while b walks, collect ready
+    predecessors, block on the oldest at a full window. K=1 is the
+    blocking degenerate (``check_many`` per batch) — the bit-identity
+    reference. Returns (results per batch, wall seconds)."""
+    from collections import deque
+
+    from jepsen_tpu.checkers import reach
+
+    os.environ["JEPSEN_TPU_PIPE_K"] = str(K)
+    try:
+        t0 = time.monotonic()
+        out = [None] * len(batches)
+        window: deque = deque()
+        for bi, b in enumerate(batches):
+            st = reach.stage_check_many(model, b) if K > 1 else None
+            if st is None:
+                while window:           # FIFO: drain before blocking
+                    i, hd = window.popleft()
+                    out[i] = hd.collect()
+                out[bi] = reach.check_many(model, b)
+                continue
+            window.append((bi, st))
+            while window and window[0][1].ready():
+                i, hd = window.popleft()
+                out[i] = hd.collect()
+            while len(window) >= K:
+                i, hd = window.popleft()
+                out[i] = hd.collect()
+        while window:
+            i, hd = window.popleft()
+            out[i] = hd.collect()
+        return out, time.monotonic() - t0
+    finally:
+        os.environ.pop("JEPSEN_TPU_PIPE_K", None)
+
+
+def pipeline_sweep(repeat: int, record: bool) -> int:
+    """ISSUE 20 satellite: measure the serve-lane in-flight depth
+    K ∈ {1,2,4,8} per geometry bucket with the REAL stage/collect
+    protocol (``reach.stage_check_many`` → window → collect), assert
+    every depth's verdicts bit-identical to the K=1 blocking
+    reference, and persist winners in the autotune table — the
+    per-bucket detail rows plus the aggregate ``pipeline|serve``
+    entry :func:`dispatch_core.pipeline_k` consults (staleness-guarded
+    like every other entry: a winner measured under another XLA is
+    ignored at lookup)."""
+    import json as _json
+
+    from jepsen_tpu import fixtures, models
+    from jepsen_tpu.checkers import autotune, events as ev
+    from jepsen_tpu.checkers import reach
+    from jepsen_tpu.history import pack
+
+    model = models.cas_register()
+    ks = (1, 2, 4, 8)
+    overall: dict = {}
+    # geometry buckets: return-count and slot-width vary with history
+    # length and process count (S is the model's)
+    shapes = [(240, 3), (900, 4), (2400, 5)]
+    for n_ops, procs in shapes:
+        batches = [[pack(fixtures.gen_history(
+            "cas", n_ops=n_ops + 40 * j, processes=procs,
+            seed=17 * bi + j))
+            for j in range(4)] for bi in range(6)]
+        memo, stream, _T, _S_pad, M = reach._prep(
+            model, batches[0][0], max_states=100_000, max_slots=20,
+            max_dense=1 << 22)
+        W = max(stream.W, 1)
+        rets = ev.returns_view(stream).n_returns
+        key = autotune.walk_key(memo.n_states, W, M, rets)
+        ref, _ = _pipe_drive(model, batches, 1)       # warm + reference
+        walls = {}
+        for K in ks:
+            best = float("inf")
+            for _ in range(max(1, repeat)):
+                out, wall = _pipe_drive(model, batches, K)
+                for rb, ob in zip(ref, out):
+                    for r, o in zip(rb, ob):
+                        assert r["valid"] == o["valid"], (K, r, o)
+                best = min(best, wall)
+            walls[K] = round(best, 4)
+        bestK = min(ks, key=lambda K: walls[K])
+        row = {"bucket": key, "walls_s": {str(K): walls[K] for K in ks},
+               "winner_k": bestK,
+               "speedup_vs_k1": round(
+                   walls[1] / max(walls[bestK], 1e-9), 2)}
+        if record:
+            row["recorded"] = autotune.record(
+                "pipeline", key, str(bestK),
+                metric=1.0 / max(walls[bestK], 1e-9),
+                detail={"walls_s": row["walls_s"]})
+        overall[key] = (bestK, walls[1] / max(walls[bestK], 1e-9))
+        print(_json.dumps(row), flush=True)
+    # the aggregate serve-lane entry pipeline_k("serve") consults:
+    # the depth that wins the most buckets (speedup breaks ties)
+    votes: dict = {}
+    for k, gain in overall.values():
+        n, g = votes.get(k, (0, 0.0))
+        votes[k] = (n + 1, g + gain)
+    serve_k = max(votes, key=lambda k: votes[k])
+    out = {"bucket": "serve", "winner_k": serve_k,
+           "buckets": {b: k for b, (k, _g) in overall.items()}}
+    if record:
+        out["recorded"] = autotune.record(
+            "pipeline", "serve", str(serve_k),
+            metric=sum(g for _n, g in votes.values()),
+            detail={"votes": {str(k): n for k, (n, _g)
+                              in votes.items()}})
+    print(_json.dumps(out), flush=True)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", type=int, default=100_000)
@@ -447,10 +561,18 @@ def main():
                          "kernel BODIES (any backend) and persist "
                          "the winner in the autotune table instead "
                          "of running the Pallas variant ladder")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="sweep the serve-lane in-flight depth "
+                         "K in {1,2,4,8} per geometry bucket over the "
+                         "real stage/collect protocol and persist "
+                         "winners (kind 'pipeline') in the autotune "
+                         "table")
     ap.add_argument("--no-record", action="store_true",
-                    help="with --bodies: measure only, do not write "
-                         "the autotune table")
+                    help="with --bodies/--pipeline: measure only, do "
+                         "not write the autotune table")
     args = ap.parse_args()
+    if args.pipeline:
+        return pipeline_sweep(args.repeat, record=not args.no_record)
     if args.bodies:
         return body_sweep(args.ops, args.repeat,
                           record=not args.no_record)

@@ -354,6 +354,78 @@ def warmup(url: str, pool: List[Dict], *, burst: int = 8,
     return {"requests": n, "wall_s": round(time.monotonic() - t0, 3)}
 
 
+def _flag_saturation(report: Dict[str, Any], rate: float) -> None:
+    """The throughput-regression tripwire (satellite of the pipelined
+    dispatch work): a daemon sustaining under 90% of the offered rate
+    while the queue-overload waiver stayed EMPTY — no 429s, no
+    backlog-regime p99 waiver — is quietly shedding throughput (the
+    r08 surface: sustained 13.9 of 20 offered with every gate green).
+    Sets ``report["saturated"]`` loudly instead of leaving the ratio
+    buried in the JSON."""
+    sus = report.get("sustained_req_s")
+    if sus is None or not rate:
+        return
+    waived = ((report.get("latency_crosscheck") or {})
+              .get("p99_gate") == "waived-queue-overloaded")
+    report["saturated"] = bool(
+        sus / float(rate) < 0.9
+        and not waived
+        and not report.get("rejected_429", 0))
+
+
+def find_capacity(url: str, pool: List[Dict], *, quick: bool = False,
+                  start_rate: float = 8.0, max_rate: float = 512.0,
+                  iters: int = 4,
+                  urls: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Binary-search the offered rate to the daemon's max sustained
+    req/s — the number the pipelined dispatch must actually move.
+    Doubling phase finds the first UNSUSTAINED rate (sustained below
+    90% of offered, or any 429/timeout), then bisection tightens the
+    bracket. Probes are short open-loop bursts over the same payload
+    pool as the fixed-rate run; every probe is recorded so a noisy
+    bracket is visible in the artifact."""
+    dur = 3.0 if quick else 6.0
+    probes: List[Dict[str, Any]] = []
+
+    def _probe(r: float) -> Tuple[bool, float]:
+        rep = run_load(url, rate=r, duration=dur, pool=pool,
+                       chaos_tolerant=False, urls=urls)
+        rep.pop("_admit_lats", None)
+        sus = float(rep.get("sustained_req_s") or 0.0)
+        ok = (sus >= 0.9 * r
+              and not rep.get("rejected_429", 0)
+              and not rep.get("timeouts", 0))
+        probes.append({"rate": round(r, 2),
+                       "sustained_req_s": round(sus, 2), "ok": ok})
+        return ok, sus
+
+    lo, lo_sus = 0.0, 0.0
+    r = max(1.0, float(start_rate))
+    hi = None
+    while hi is None and r <= max_rate:
+        ok, sus = _probe(r)
+        if ok:
+            lo, lo_sus = r, sus
+            r *= 2.0
+        else:
+            hi = r
+    if hi is None:
+        hi = r                  # sustained everything up to max_rate
+    for _ in range(max(0, int(iters))):
+        if hi - lo <= max(0.5, 0.05 * hi):
+            break
+        mid = (lo + hi) / 2.0
+        ok, sus = _probe(mid)
+        if ok:
+            lo, lo_sus = mid, sus
+        else:
+            hi = mid
+    return {"capacity_req_s": round(lo_sus or lo, 2),
+            "highest_sustained_rate": round(lo, 2),
+            "first_unsustained_rate": round(hi, 2),
+            "probes": probes}
+
+
 def run_load(url: str, *, rate: float, duration: float,
              pool: List[Dict], poll_s: float = 0.01,
              poll_timeout: float = 120.0,
@@ -1094,6 +1166,7 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
             # against the MERGED client quantiles: skip the
             # crosscheck in fleet mode (each replica's own histogram
             # stays scrapeable via its /metrics)
+            _flag_saturation(report, rate)
             report["url"] = url
             return report
         hist_after = fetch_hist_buckets(url)
@@ -1148,6 +1221,19 @@ def run_loadgen(opts: Dict[str, Any]) -> Dict[str, Any]:
                 xc["p99_gate"] = "waived-queue-overloaded"
                 xc["ok"] = True
             report["latency_crosscheck"] = xc
+        _flag_saturation(report, rate)
+        if opts.get("find_capacity"):
+            # capacity search AFTER the crosscheck scrape: its probe
+            # traffic must not leak into the measured window's
+            # histogram delta
+            try:
+                report["capacity"] = find_capacity(
+                    url, pool, quick=quick,
+                    start_rate=float(opts.get("capacity_start")
+                                     or max(4.0, rate / 2.0)))
+            except Exception as e:                      # noqa: BLE001
+                report["capacity"] = {
+                    "error": f"{type(e).__name__}: {e}"}
         report["url"] = url
         return report
     finally:
@@ -1224,6 +1310,10 @@ def main(argv=None) -> int:
     ap.add_argument("--session-workers", type=int, default=None,
                     help="driver worker threads for session traffic "
                          "(default: min(64, n_sessions))")
+    ap.add_argument("--find-capacity", action="store_true",
+                    help="after the fixed-rate run, binary-search the "
+                         "offered rate to the daemon's max sustained "
+                         "req/s and report it under 'capacity'")
     args = ap.parse_args(argv)
     if args.self_host and args.url:
         ap.error("--self-host and --url are mutually exclusive")
@@ -1248,6 +1338,7 @@ def main(argv=None) -> int:
         "session_ops": args.session_ops,
         "session_appends": args.session_appends,
         "session_workers": args.session_workers,
+        "find_capacity": args.find_capacity,
     })
     print(json.dumps(report, default=str))
     if report.get("error"):
